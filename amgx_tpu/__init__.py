@@ -1,0 +1,63 @@
+"""amgx_tpu — a TPU-native algebraic-multigrid / sparse-solver framework.
+
+A from-scratch JAX/XLA rebuild of the capability surface of NVIDIA AmgX
+(reference: /root/reference, C++/CUDA): algebraic multigrid (classical
+Ruge-Stuben, aggregation), Krylov methods, smoothers/preconditioners,
+eigensolvers, and multi-chip distribution via sharded halo exchange over a
+``jax.sharding.Mesh`` (replacing the reference's MPI halo exchange,
+src/distributed/).
+
+Architecture stance (TPU-first, not a translation):
+  * dtype polymorphism replaces the 16-way compile-time mode system
+    (reference include/amgx_config.h:103-121); mode names survive only as
+    aliases in :mod:`amgx_tpu.core.types`.
+  * matrices are pytrees of static-shape arrays; solve paths are jitted
+    end-to-end with ``lax.while_loop`` iteration; hierarchy setup is
+    host-side (numpy/scipy) producing per-level static shapes.
+  * distribution is SPMD ``shard_map`` over a device mesh with
+    ``ppermute``/``psum`` collectives riding ICI.
+"""
+
+from amgx_tpu.core.types import (
+    Mode,
+    ViewType,
+    mode_from_name,
+)
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.version import __version__
+
+_initialized = False
+
+
+def initialize():
+    """Library init: register all factories (reference: core.cu:723 amgx::initialize).
+
+    Idempotent. Factory registration in this rebuild happens at import time of
+    the subpackages; this exists for API parity and future lazy registration.
+    """
+    global _initialized
+    if _initialized:
+        return
+    # Importing the registries triggers registration (reference core.cu:552-688).
+    import amgx_tpu.solvers  # noqa: F401
+    import amgx_tpu.amg  # noqa: F401
+    _initialized = True
+
+
+def finalize():
+    """API-parity no-op (reference: core.cu:791 amgx::finalize)."""
+    global _initialized
+    _initialized = False
+
+
+__all__ = [
+    "Mode",
+    "ViewType",
+    "mode_from_name",
+    "SparseMatrix",
+    "AMGConfig",
+    "initialize",
+    "finalize",
+    "__version__",
+]

@@ -1,0 +1,2 @@
+"""AMG hierarchy engine (reference src/amg.cu, src/amg_level.cu,
+src/cycles/, src/classical/, src/aggregation/)."""

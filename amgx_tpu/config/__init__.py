@@ -1,0 +1,4 @@
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.config.params import PARAMS, ParameterDescription
+
+__all__ = ["AMGConfig", "PARAMS", "ParameterDescription"]

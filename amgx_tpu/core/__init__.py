@@ -1,0 +1,4 @@
+from amgx_tpu.core.types import Mode, ViewType, mode_from_name
+from amgx_tpu.core.matrix import SparseMatrix
+
+__all__ = ["Mode", "ViewType", "mode_from_name", "SparseMatrix"]

@@ -1,0 +1,106 @@
+"""Type/mode system.
+
+The reference builds 16 compile-time modes combining memory space x vector
+precision x matrix precision x index precision (TemplateConfig,
+reference include/basic_types.h:92-117; mode enum amgx_config.h:103-121).
+On TPU there is one memory space and dtypes are runtime properties of
+arrays, so a mode collapses to a (vec_dtype, mat_dtype, idx_dtype) triple
+used as defaults when building matrices/vectors. The AmgX mode *names*
+(dDDI, dDFI, ...) are kept as aliases for the C-API shim and config files.
+
+TPU note: float64 is emulated and slow on TPU; the practical default mode
+on TPU hardware is the dDFI/dFFI analogue (f32 matrix). f64 modes are
+fully supported under jax_enable_x64 (used by the CPU test mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+
+
+class ViewType(enum.IntEnum):
+    """Distributed row views as static index windows (reference vector.h:18-27).
+
+    A local matrix is stored owned-rows-first with halo rows appended, and
+    owned rows are ordered interior-first then boundary (rows with edges into
+    the halo).  Each view is a contiguous prefix window [0, size(view)).
+    """
+
+    INTERIOR = 1
+    BOUNDARY = 2
+    OWNED = 3      # INTERIOR + BOUNDARY
+    FULL = 4       # OWNED + 1-ring halo
+    ALL = 5        # everything incl. 2-ring halo
+
+
+@dataclasses.dataclass(frozen=True)
+class Mode:
+    """Precision triple replacing TemplateConfig (basic_types.h:92-117)."""
+
+    name: str
+    vec_dtype: jnp.dtype
+    mat_dtype: jnp.dtype
+    idx_dtype: jnp.dtype = jnp.int32
+
+    @property
+    def is_complex(self) -> bool:
+        return jnp.issubdtype(self.mat_dtype, jnp.complexfloating)
+
+
+def _m(name, vec, mat):
+    return name, Mode(name, jnp.dtype(vec), jnp.dtype(mat))
+
+
+# AmgX mode names (amgx_config.h:103-121).  The leading 'd'/'h' memory-space
+# letter is meaningless on TPU; both map to the same Mode.
+_MODES = dict(
+    _m(n, v, m)
+    for (n, v, m) in [
+        ("dDDI", jnp.float64, jnp.float64),
+        ("dDFI", jnp.float64, jnp.float32),
+        ("dFFI", jnp.float32, jnp.float32),
+        ("dIDI", jnp.float64, jnp.float64),
+        ("dIFI", jnp.float64, jnp.float32),
+        ("dZZI", jnp.complex128, jnp.complex128),
+        ("dZCI", jnp.complex128, jnp.complex64),
+        ("dCCI", jnp.complex64, jnp.complex64),
+        # TPU-native extra modes (no reference analogue): bf16 matrix storage.
+        ("dFBI", jnp.float32, jnp.bfloat16),
+    ]
+)
+for _name in list(_MODES):
+    if _name.startswith("d"):
+        _MODES["h" + _name[1:]] = dataclasses.replace(
+            _MODES[_name], name="h" + _name[1:]
+        )
+
+
+def mode_from_name(name: str) -> Mode:
+    try:
+        return _MODES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown mode {name!r}; known: {sorted(_MODES)}"
+        ) from None
+
+
+DEFAULT_MODE = _MODES["dFFI"]  # TPU-practical default; tests use dDDI on CPU.
+
+
+class NormType(enum.Enum):
+    """Vector norm types (reference include/types.h:16)."""
+
+    L1 = "L1"
+    L1_SCALED = "L1_SCALED"
+    L2 = "L2"
+    LMAX = "LMAX"
+
+
+class BlockFormat(enum.Enum):
+    """Block storage order (reference matrix row-major/col-major blocks)."""
+
+    ROW_MAJOR = "ROW_MAJOR"
+    COL_MAJOR = "COL_MAJOR"

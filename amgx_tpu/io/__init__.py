@@ -1,0 +1,11 @@
+from amgx_tpu.io.poisson import poisson_2d_5pt, poisson_3d_7pt, poisson_3d_27pt
+from amgx_tpu.io.matrix_market import read_mtx, read_system, write_system
+
+__all__ = [
+    "poisson_2d_5pt",
+    "poisson_3d_7pt",
+    "poisson_3d_27pt",
+    "read_mtx",
+    "read_system",
+    "write_system",
+]

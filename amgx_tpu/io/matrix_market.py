@@ -1,0 +1,218 @@
+"""MatrixMarket IO with the reference's %%NVAMG extensions.
+
+Reference parity: src/matrix_io.cu (readers/writers), AMGX_read_system /
+AMGX_write_system (amgx_c.h:424-460).  Supported:
+
+  * standard ``%%MatrixMarket matrix coordinate real|complex|integer|pattern
+    general|symmetric|hermitian|skew-symmetric`` files;
+  * the AmgX header extension line ``%%AMGX``/``%%NVAMG <flags>`` carrying
+    tokens like ``sorted``, ``diagonal``, ``rhs``, ``solution``,
+    ``block_dimx N``/``block_dimy N`` (matrix_io.cu:93-160): when ``rhs`` /
+    ``solution`` appear, the vectors follow the matrix entries in the same
+    file; ``diagonal`` means external diagonal blocks follow the entries.
+
+Parsing is vectorized (numpy over the whole body) — the ingest path must
+handle SuiteSparse-scale files (tens of millions of nnz).
+Returns host numpy; callers build SparseMatrix from it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from amgx_tpu.core.matrix import SparseMatrix
+
+
+class MatrixIOError(ValueError):
+    pass
+
+
+def _parse_header(lines):
+    header = lines[0].strip().split()
+    if not header or header[0] != "%%MatrixMarket":
+        raise MatrixIOError(f"bad MatrixMarket header: {lines[0]!r}")
+    field, sym = header[3].lower(), header[4].lower()
+    flags = []
+    i = 1
+    while i < len(lines) and lines[i].lstrip().startswith("%"):
+        if lines[i].startswith(("%%AMGX", "%%NVAMG")):
+            tok = lines[i].strip("%").strip().split()
+            flags = tok[1:] if tok and tok[0] in ("AMGX", "NVAMG") else tok
+        i += 1
+    return field, sym, flags, i
+
+
+def _tokens_to_floats(body_lines):
+    """One pass over whitespace-separated numeric tokens (C-level parse)."""
+    blob = " ".join(body_lines)
+    return np.array(blob.split(), dtype=np.float64)
+
+
+def read_system(path):
+    """Read matrix (+ optional external diagonal / rhs / solution).
+
+    Returns (A_dict, rhs, sol) where A_dict has keys rows, cols, vals,
+    n_rows, n_cols, block_dims.  Complex fields keep full complex values
+    everywhere (entries, diagonal, rhs, solution).
+    """
+    with open(path) as f:
+        lines = f.read().splitlines()
+    field, sym, flags, i = _parse_header(lines)
+
+    block_dimx = block_dimy = 1
+    for j, tok in enumerate(flags):
+        if tok == "block_dimx":
+            block_dimx = int(flags[j + 1])
+        if tok == "block_dimy":
+            block_dimy = int(flags[j + 1])
+    has_rhs = "rhs" in flags
+    has_sol = "solution" in flags
+    has_ext_diag = "diagonal" in flags
+
+    sizes = lines[i].split()
+    n_rows, n_cols, nnz = int(sizes[0]), int(sizes[1]), int(sizes[2])
+    i += 1
+
+    body = [
+        s
+        for s in (ln.strip() for ln in lines[i:])
+        if s and not s.startswith("%")
+    ]
+    bsz = block_dimx * block_dimy
+    is_complex = field == "complex"
+    vdt = np.complex128 if is_complex else np.float64
+    # values per entry line after the two indices
+    vtok = 0 if field == "pattern" else (2 * bsz if is_complex else bsz)
+
+    # ---- matrix entries: one vectorized parse --------------------------
+    toks = _tokens_to_floats(body[:nnz])
+    per_line = 2 + vtok
+    if toks.shape[0] != nnz * per_line:
+        raise MatrixIOError(
+            f"expected {nnz} entries x {per_line} tokens, got "
+            f"{toks.shape[0]} tokens"
+        )
+    toks = toks.reshape(nnz, per_line)
+    rows = toks[:, 0].astype(np.int64) - 1
+    cols = toks[:, 1].astype(np.int64) - 1
+    if field == "pattern":
+        vals = np.ones((nnz, bsz) if bsz > 1 else nnz, vdt)
+    elif is_complex:
+        c = toks[:, 2::2] + 1j * toks[:, 3::2]
+        vals = c if bsz > 1 else c[:, 0]
+    else:
+        vals = toks[:, 2:] if bsz > 1 else toks[:, 2]
+    pos = nnz
+
+    def _read_block_lines(count, width):
+        t = _tokens_to_floats(body[pos : pos + count])
+        w = 2 * width if is_complex else width
+        if t.shape[0] != count * w:
+            raise MatrixIOError("truncated auxiliary section")
+        t = t.reshape(count, w)
+        if is_complex:
+            t = t[:, 0::2] + 1j * t[:, 1::2]
+        return t if width > 1 else t[:, 0]
+
+    if has_ext_diag:
+        dvals = _read_block_lines(n_rows, bsz)
+        pos += n_rows
+        drows = np.arange(n_rows, dtype=np.int64)
+        rows = np.concatenate([rows, drows])
+        cols = np.concatenate([cols, drows])
+        vals = np.concatenate([vals, dvals])
+
+    if sym in ("symmetric", "hermitian", "skew-symmetric"):
+        off = rows != cols
+        mvals = vals[off]
+        if bsz > 1:
+            # mirrored block is the (conjugate-)transposed block
+            mvals = (
+                mvals.reshape(-1, block_dimx, block_dimy)
+                .transpose(0, 2, 1)
+                .reshape(-1, bsz)
+            )
+        if sym == "hermitian":
+            mvals = np.conj(mvals)
+        elif sym == "skew-symmetric":
+            mvals = -mvals
+        rows, cols = (
+            np.concatenate([rows, cols[off]]),
+            np.concatenate([cols, rows[off]]),
+        )
+        vals = np.concatenate([vals, mvals])
+
+    rhs = sol = None
+    nb = n_rows * block_dimx
+    if has_rhs:
+        rhs = _read_block_lines(nb, 1)
+        pos += nb
+    if has_sol:
+        sol = _read_block_lines(nb, 1)
+        pos += nb
+
+    A = dict(
+        rows=rows,
+        cols=cols,
+        vals=vals,
+        n_rows=n_rows,
+        n_cols=n_cols,
+        block_dims=(block_dimx, block_dimy),
+    )
+    return A, rhs, sol
+
+
+def read_mtx(path, dtype=None, build_ell=True) -> SparseMatrix:
+    A, _, _ = read_system(path)
+    bx, by = A["block_dims"]
+    if bx != by:
+        raise MatrixIOError(
+            f"rectangular blocks {bx}x{by} are not supported"
+        )
+    vals = A["vals"]
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    return SparseMatrix.from_coo(
+        A["rows"],
+        A["cols"],
+        vals,
+        n_rows=A["n_rows"],
+        n_cols=A["n_cols"],
+        block_size=bx,
+        build_ell=build_ell,
+    )
+
+
+def write_system(path, A: SparseMatrix, rhs=None, sol=None):
+    """Write matrix (+rhs/solution) with the %%AMGX extension header."""
+    flags = ["sorted"]
+    if rhs is not None:
+        flags.append("rhs")
+    if sol is not None:
+        flags.append("solution")
+    b = A.block_size
+    if b > 1:
+        flags += ["block_dimx", str(b), "block_dimy", str(b)]
+    indptr = np.asarray(A.row_offsets)
+    indices = np.asarray(A.col_indices)
+    data = np.asarray(A.values)
+    field = "complex" if np.iscomplexobj(data) else "real"
+    with open(path, "w") as f:
+        f.write(f"%%MatrixMarket matrix coordinate {field} general\n")
+        f.write("%%AMGX " + " ".join(flags) + "\n")
+        f.write(f"{A.n_rows} {A.n_cols} {A.nnz}\n")
+        for i in range(A.n_rows):
+            for p in range(indptr[i], indptr[i + 1]):
+                v = data[p].reshape(-1) if b > 1 else [data[p]]
+                if field == "complex":
+                    vtxt = " ".join(f"{c.real:.17g} {c.imag:.17g}" for c in v)
+                else:
+                    vtxt = " ".join(f"{c:.17g}" for c in v)
+                f.write(f"{i + 1} {indices[p] + 1} {vtxt}\n")
+        for vec in (rhs, sol):
+            if vec is not None:
+                for v in np.asarray(vec):
+                    if np.iscomplexobj(vec):
+                        f.write(f"{v.real:.17g} {v.imag:.17g}\n")
+                    else:
+                        f.write(f"{v:.17g}\n")

@@ -1,0 +1,84 @@
+"""Poisson stencil generators.
+
+Reference parity: AMGX_generate_distributed_poisson_7pt (amgx_c.h:510-522),
+examples/generate_poisson.cu, and the 5-pt/7-pt/27-pt generators used across
+src/tests.  Host-side numpy building scipy CSR, then converted to the device
+pytree; the distributed variant slices rows per partition.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sps
+
+from amgx_tpu.core.matrix import SparseMatrix
+
+
+def _poisson_1d(n):
+    return sps.diags_array(
+        [-np.ones(n - 1), 2.0 * np.ones(n), -np.ones(n - 1)],
+        offsets=[-1, 0, 1],
+        format="csr",
+    )
+
+
+def poisson_scipy(shape, stencil="star"):
+    """Kronecker-assembled Laplacian; shape is (nx,), (nx,ny) or (nx,ny,nz).
+
+    stencil='star' gives the 5/7-point operator; '27pt' the dense 3D brick.
+    """
+    dims = [int(s) for s in shape]
+    if stencil == "star":
+        A = None
+        for axis, n in enumerate(dims):
+            term = None
+            for j, m in enumerate(dims):
+                f = _poisson_1d(m) if j == axis else sps.eye_array(m)
+                term = f if term is None else sps.kron(term, f, format="csr")
+            A = term if A is None else A + term
+        return A.tocsr()
+    if stencil == "27pt":
+        assert len(dims) == 3
+        return _poisson_27pt_direct(dims)
+    raise ValueError(stencil)
+
+
+def _poisson_27pt_direct(dims):
+    nx, ny, nz = dims
+
+    def adj(n):
+        return sps.diags_array(
+            [np.ones(n - 1), np.ones(n), np.ones(n - 1)],
+            offsets=[-1, 0, 1],
+            format="csr",
+        )
+
+    B = sps.kron(sps.kron(adj(nx), adj(ny)), adj(nz), format="csr")
+    A = (-B + sps.eye_array(nx * ny * nz) * 27.0).tocsr()
+    return A
+
+
+def poisson_2d_5pt(nx, ny=None, dtype=np.float64, **kw) -> SparseMatrix:
+    ny = nx if ny is None else ny
+    A = poisson_scipy((nx, ny)).astype(dtype)
+    return SparseMatrix.from_scipy(A, **kw)
+
+
+def poisson_3d_7pt(nx, ny=None, nz=None, dtype=np.float64, **kw) -> SparseMatrix:
+    ny = nx if ny is None else ny
+    nz = nx if nz is None else nz
+    A = poisson_scipy((nx, ny, nz)).astype(dtype)
+    return SparseMatrix.from_scipy(A, **kw)
+
+
+def poisson_3d_27pt(nx, ny=None, nz=None, dtype=np.float64, **kw) -> SparseMatrix:
+    ny = nx if ny is None else ny
+    nz = nx if nz is None else nz
+    A = _poisson_27pt_direct((nx, ny, nz)).astype(dtype)
+    return SparseMatrix.from_scipy(A, **kw)
+
+
+def poisson_rhs(n, dtype=np.float64, seed=0):
+    """Deterministic smooth-ish RHS used by tests/benchmarks."""
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(n).astype(dtype)

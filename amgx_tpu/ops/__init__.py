@@ -1,0 +1,17 @@
+from amgx_tpu.ops.spmv import spmv, multiply
+from amgx_tpu.ops.blas import axpy, axpby, axpbypcz, axmb, dot, scal, fill
+from amgx_tpu.ops.norms import norm, get_norm
+
+__all__ = [
+    "spmv",
+    "multiply",
+    "axpy",
+    "axpby",
+    "axpbypcz",
+    "axmb",
+    "dot",
+    "scal",
+    "fill",
+    "norm",
+    "get_norm",
+]

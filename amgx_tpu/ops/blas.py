@@ -1,0 +1,53 @@
+"""BLAS-1 vector ops (reference src/blas.cu, include/blas.h:16-85).
+
+These are trivial jnp expressions; they exist as named functions for parity
+with the reference call sites and so solvers read like the algorithms they
+implement.  All are pure and jit-safe.  Offset/size view windows from the
+reference are expressed by slicing at the call site (static shapes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def axpy(y, x, alpha):
+    """y + alpha*x."""
+    return y + alpha * x
+
+
+def axpby(x, y, alpha, beta):
+    """alpha*x + beta*y."""
+    return alpha * x + beta * y
+
+
+def axpbypcz(x, y, z, alpha, beta, gamma):
+    """alpha*x + beta*y + gamma*z."""
+    return alpha * x + beta * y + gamma * z
+
+
+def axmb(A, x, b):
+    """A@x - b (reference axmb; note sign: reference computes r = b - Ax via
+    axmb then negates — we return A x - b literally)."""
+    from amgx_tpu.ops.spmv import spmv
+
+    return spmv(A, x) - b
+
+
+def dot(x, y):
+    """<x, y> with complex conjugation on the first argument."""
+    if jnp.iscomplexobj(x):
+        return jnp.vdot(x, y)
+    return jnp.dot(x, y)
+
+
+def scal(x, alpha):
+    return alpha * x
+
+
+def fill(x, value):
+    return jnp.full_like(x, value)
+
+
+def copy(x):
+    return jnp.asarray(x)

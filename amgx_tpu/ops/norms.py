@@ -1,0 +1,53 @@
+"""Vector norms (reference src/norm.cu, types.h:16: L1/L1_SCALED/L2/LMAX).
+
+Block norms: the reference can compute one norm per block component
+(use_scalar_norm=0).  ``norm`` returns the scalar norm; ``block_norm``
+returns a (block_size,) vector of per-component norms.
+
+Distributed callers wrap these with a ``psum``/``pmax`` over the mesh axis
+(reference: Comms::global_reduce, distributed_comms.h:216).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from amgx_tpu.core.types import NormType
+
+
+def norm(x, norm_type: NormType = NormType.L2):
+    a = jnp.abs(x)
+    if norm_type == NormType.L1:
+        return jnp.sum(a)
+    if norm_type == NormType.L1_SCALED:
+        return jnp.sum(a) / x.shape[0]
+    if norm_type == NormType.L2:
+        return jnp.sqrt(jnp.sum(a * a))
+    if norm_type == NormType.LMAX:
+        return jnp.max(a)
+    raise ValueError(f"unknown norm {norm_type}")
+
+
+def block_norm(x, block_size: int, norm_type: NormType = NormType.L2):
+    """Per-block-component norms; x flat (n*b,) -> (b,)."""
+    xb = jnp.abs(x.reshape(-1, block_size))
+    if norm_type == NormType.L1:
+        return jnp.sum(xb, axis=0)
+    if norm_type == NormType.L1_SCALED:
+        return jnp.sum(xb, axis=0) / xb.shape[0]
+    if norm_type == NormType.L2:
+        return jnp.sqrt(jnp.sum(xb * xb, axis=0))
+    if norm_type == NormType.LMAX:
+        return jnp.max(xb, axis=0)
+    raise ValueError(f"unknown norm {norm_type}")
+
+
+def get_norm(A, r, norm_type: NormType = NormType.L2, use_scalar_norm=False):
+    """Reference get_norm(A, r, ...) (norm.h) — block-aware entry point.
+
+    Default matches the registered config default use_scalar_norm=0: block
+    matrices get per-component norms unless the caller forces scalar.
+    """
+    if use_scalar_norm or A is None or A.block_size == 1:
+        return norm(r, norm_type)
+    return block_norm(r, A.block_size, norm_type)

@@ -1,0 +1,79 @@
+"""Sparse matrix-vector product.
+
+Reference parity: multiply(A, B, C, view) with block-size dispatch
+(src/multiply.cu:49-110) and cuSPARSE bsrmv (src/amgx_cusparse.cu:49-145).
+
+TPU formulation: two data layouts, both fully static-shape and jittable.
+
+  * ELL path (preferred): fixed-width padded rows.  ``x[ell_cols]`` is a
+    dense (n, w[, b]) gather, the product reduces over the width axis —
+    a shape XLA fuses and tiles onto the VPU/MXU directly.  Padding slots
+    carry value 0 so no masking is needed.
+  * CSR path (fallback for irregular matrices): gather per-nnz, then
+    ``segment_sum`` over precomputed sorted row ids.
+
+The distributed SpMV with halo overlap (reference
+multiply.cu:95-110 exchange_halo_split_gather -> interior -> boundary)
+lives in :mod:`amgx_tpu.distributed.spmv`; this module is the
+single-shard compute kernel it calls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from amgx_tpu.core.matrix import SparseMatrix
+
+
+def spmv(A: SparseMatrix, x: jnp.ndarray, n_rows: int | None = None):
+    """y = A @ x.
+
+    x is flat (n_cols * block_size,).  Returns flat (n_rows * block_size,).
+    ``n_rows`` restricts output to a leading row window (the view
+    mechanism); default all rows.
+    """
+    b = A.block_size
+    nr = A.n_rows if n_rows is None else n_rows
+    if b == 1:
+        y = _spmv_scalar(A, x)
+    else:
+        y = _spmv_block(A, x.reshape(A.n_cols, b)).reshape(-1)
+    if nr != A.n_rows:
+        y = y[: nr * b]
+    return y
+
+
+def _spmv_scalar(A, x):
+    if A.has_ell:
+        xg = x[A.ell_cols]  # (n, w)
+        return jnp.sum(A.ell_vals * xg, axis=1)
+    contrib = A.values * x[A.col_indices]
+    return jax.ops.segment_sum(
+        contrib, A.row_ids, num_segments=A.n_rows, indices_are_sorted=True
+    )
+
+
+def _spmv_block(A, x2d):
+    if A.has_ell:
+        xg = x2d[A.ell_cols]  # (n, w, b)
+        return jnp.einsum(
+            "nwij,nwj->ni", A.ell_vals, xg, preferred_element_type=x2d.dtype
+        )
+    xg = x2d[A.col_indices]  # (nnz, b)
+    contrib = jnp.einsum(
+        "nij,nj->ni", A.values, xg, preferred_element_type=x2d.dtype
+    )
+    return jax.ops.segment_sum(
+        contrib, A.row_ids, num_segments=A.n_rows, indices_are_sorted=True
+    )
+
+
+def multiply(A: SparseMatrix, x, n_rows=None):
+    """Alias matching the reference free function multiply() (multiply.h:14)."""
+    return spmv(A, x, n_rows=n_rows)
+
+
+def residual(A: SparseMatrix, b, x):
+    """r = b - A x  (reference axmb / compute_residual, solver.cu)."""
+    return b - spmv(A, x)

@@ -1,0 +1,13 @@
+"""Solver algorithms and the name->factory registry.
+
+Importing this package registers all built-in solvers (the analogue of
+registerClasses at amgx::initialize, reference core.cu:552-688).
+"""
+
+from amgx_tpu.solvers.registry import (
+    SolverRegistry,
+    register_solver,
+    create_solver,
+)
+
+__all__ = ["SolverRegistry", "register_solver", "create_solver"]

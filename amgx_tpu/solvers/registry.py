@@ -1,0 +1,51 @@
+"""Factory registry (reference SolverFactory, solver.h:281-310).
+
+Maps registry names (the strings appearing in config files, e.g. "PCG",
+"BLOCK_JACOBI") to solver classes.  ``create_solver`` resolves a scoped
+config parameter naming a solver and instantiates it, mirroring
+SolverFactory::allocate's (config, scope) contract.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_SOLVERS: Dict[str, Callable] = {}
+
+
+class SolverRegistry:
+    @staticmethod
+    def register(name: str, cls):
+        _SOLVERS[name] = cls
+
+    @staticmethod
+    def get(name: str):
+        try:
+            return _SOLVERS[name]
+        except KeyError:
+            raise KeyError(
+                f"unregistered solver {name!r}; known: {sorted(_SOLVERS)}"
+            ) from None
+
+    @staticmethod
+    def names():
+        return sorted(_SOLVERS)
+
+
+def register_solver(name: str):
+    """Class decorator: @register_solver("PCG")."""
+
+    def deco(cls):
+        SolverRegistry.register(name, cls)
+        cls.registry_name = name
+        return cls
+
+    return deco
+
+
+def create_solver(cfg, scope: str = "default", param: str = "solver"):
+    """Allocate the solver named by cfg param in scope
+    (reference SolverFactory::allocate, solver.h:281-310)."""
+    name, new_scope = cfg.get_scoped(param, scope)
+    cls = SolverRegistry.get(name)
+    return cls(cfg, new_scope)

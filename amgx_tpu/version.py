@@ -1,0 +1,12 @@
+"""Version info (reference parity: ReleaseVersion.txt, AMGX_get_api_version)."""
+
+__version__ = "0.1.0"
+
+# The reference API version this framework tracks feature-parity against
+# (reference: ReleaseVersion.txt:1 -> 2.5.0).
+REFERENCE_API_VERSION = (2, 5)
+
+
+def get_api_version():
+    """Returns (major, minor) like AMGX_get_api_version (amgx_c.h:160-163)."""
+    return REFERENCE_API_VERSION

@@ -1,0 +1,62 @@
+"""Test harness config.
+
+Mirrors the reference test strategy (SURVEY.md §4): tests run on a virtual
+8-device CPU mesh so the distributed path (the analogue of the reference's
+single-process multi-partition simulation, generated_matrix_distributed_io.cu)
+is exercised without TPU hardware, and fp64 modes (dDDI) are enabled.
+Must set env before importing jax anywhere.
+"""
+
+import os
+
+# Force CPU: the session env pins JAX_PLATFORMS=axon (the real TPU tunnel);
+# tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The axon sitecustomize force-prepends its TPU platform to jax_platforms;
+# override after import so tests really run on the CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+from amgx_tpu.core.matrix import SparseMatrix
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)
+
+
+def random_csr(n, density=0.05, seed=0, spd=False, dtype=np.float64,
+               block_size=1):
+    """Random test matrix (reference test_utils random generators)."""
+    rng = np.random.default_rng(seed)
+    m = sps.random(
+        n, n, density=density, random_state=rng, format="csr", dtype=np.float64
+    )
+    m = m + sps.eye_array(n) * (n * density + 1.0)
+    if spd:
+        m = (m + m.T) * 0.5
+        m = m + sps.eye_array(n) * n * density
+    m = m.tocsr().astype(dtype)
+    m.sort_indices()
+    return m
+
+
+@pytest.fixture
+def small_spd():
+    return random_csr(64, density=0.1, seed=7, spd=True)
+
+
+def to_matrix(sp, **kw) -> SparseMatrix:
+    return SparseMatrix.from_scipy(sp, **kw)

@@ -1,0 +1,53 @@
+"""BLAS-1 / norm tests (reference src/tests/norm_tests.cu)."""
+
+import numpy as np
+import pytest
+
+from amgx_tpu.core.types import NormType
+from amgx_tpu.ops import blas
+from amgx_tpu.ops.norms import norm, block_norm
+
+
+@pytest.fixture
+def vecs():
+    rng = np.random.default_rng(0)
+    return rng.standard_normal(64), rng.standard_normal(64)
+
+
+def test_axpby(vecs):
+    x, y = vecs
+    np.testing.assert_allclose(
+        np.asarray(blas.axpby(x, y, 2.0, -3.0)), 2 * x - 3 * y
+    )
+
+
+def test_dot_real(vecs):
+    x, y = vecs
+    np.testing.assert_allclose(np.asarray(blas.dot(x, y)), x @ y)
+
+
+def test_dot_complex():
+    x = np.array([1 + 2j, 3 - 1j])
+    y = np.array([2 - 1j, 1 + 1j])
+    np.testing.assert_allclose(np.asarray(blas.dot(x, y)), np.vdot(x, y))
+
+
+@pytest.mark.parametrize(
+    "nt,ref",
+    [
+        (NormType.L1, lambda x: np.abs(x).sum()),
+        (NormType.L1_SCALED, lambda x: np.abs(x).sum() / x.size),
+        (NormType.L2, lambda x: np.linalg.norm(x)),
+        (NormType.LMAX, lambda x: np.abs(x).max()),
+    ],
+)
+def test_norms(vecs, nt, ref):
+    x, _ = vecs
+    np.testing.assert_allclose(np.asarray(norm(x, nt)), ref(x), rtol=1e-12)
+
+
+def test_block_norm():
+    x = np.arange(12, dtype=np.float64)
+    got = np.asarray(block_norm(x, 3, NormType.L2))
+    want = np.linalg.norm(x.reshape(-1, 3), axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-12)

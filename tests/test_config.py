@@ -1,0 +1,109 @@
+"""Config system tests (reference src/tests/config_parsing.cu)."""
+
+import pytest
+
+from amgx_tpu.config.amg_config import AMGConfig, ConfigError
+
+
+FGMRES_AGG = """
+{
+    "config_version": 2,
+    "solver": {
+        "preconditioner": {
+            "algorithm": "AGGREGATION",
+            "solver": "AMG",
+            "smoother": "MULTICOLOR_DILU",
+            "presweeps": 0,
+            "selector": "SIZE_2",
+            "coarse_solver": "DENSE_LU_SOLVER",
+            "max_iters": 1,
+            "postsweeps": 3,
+            "min_coarse_rows": 32,
+            "relaxation_factor": 0.75,
+            "scope": "amg",
+            "max_levels": 50,
+            "cycle": "V"
+        },
+        "use_scalar_norm": 1,
+        "solver": "FGMRES",
+        "max_iters": 100,
+        "gmres_n_restart": 10,
+        "convergence": "RELATIVE_INI",
+        "scope": "main",
+        "tolerance": 1e-06,
+        "norm": "L2"
+    }
+}
+"""
+
+
+def test_json_scoped_parse():
+    cfg = AMGConfig.from_string(FGMRES_AGG)
+    solver, scope = cfg.get_scoped("solver", "default")
+    assert solver == "FGMRES" and scope == "main"
+    assert cfg.get("max_iters", "main") == 100
+    assert cfg.get("tolerance", "main") == 1e-6
+    precond, pscope = cfg.get_scoped("preconditioner", "main")
+    assert precond == "AMG" and pscope == "amg"
+    assert cfg.get("max_levels", "amg") == 50
+    assert cfg.get("relaxation_factor", "amg") == 0.75
+    smoother, sscope = cfg.get_scoped("smoother", "amg")
+    assert smoother == "MULTICOLOR_DILU" and sscope == "amg"
+
+
+def test_defaults_fall_through():
+    cfg = AMGConfig.from_string(FGMRES_AGG)
+    # not set anywhere -> registry default
+    assert cfg.get("presweeps", "main") == 1
+    # set in amg scope only
+    assert cfg.get("presweeps", "amg") == 0
+    # global default scope fallback
+    assert cfg.get("determinism_flag", "whatever") == 0
+
+
+def test_nested_inline_smoother_scope():
+    cfg = AMGConfig.from_string(
+        """
+        {"config_version": 2,
+         "solver": {"scope": "main", "solver": "PCG",
+           "preconditioner": {"scope": "amg", "solver": "AMG",
+             "smoother": {"scope": "jac", "solver": "BLOCK_JACOBI",
+                          "relaxation_factor": 0.5}}}}
+        """
+    )
+    sm, sscope = cfg.get_scoped("smoother", "amg")
+    assert sm == "BLOCK_JACOBI" and sscope == "jac"
+    assert cfg.get("relaxation_factor", "jac") == 0.5
+
+
+def test_legacy_string():
+    cfg = AMGConfig.from_string(
+        "max_iters=50, tolerance=1e-8, solver(s1)=PCG, s1:preconditioner=AMG"
+    )
+    assert cfg.get("max_iters") == 50
+    assert cfg.get("tolerance") == 1e-8
+    v, s = cfg.get_scoped("solver", "default")
+    assert v == "PCG" and s == "s1"
+    assert cfg.get("preconditioner", "s1") == "AMG"
+
+
+def test_unknown_param_rejected():
+    with pytest.raises(ConfigError):
+        AMGConfig.from_string("no_such_param=3")
+
+
+def test_type_checking():
+    with pytest.raises(ConfigError):
+        AMGConfig.from_string('{"max_iters": "abc"}')
+
+
+def test_allowed_values():
+    with pytest.raises(ConfigError):
+        AMGConfig.from_string('{"norm": "L7"}')
+
+
+def test_write_parameters_description():
+    from amgx_tpu.config.params import write_parameters_description
+
+    text = write_parameters_description()
+    assert "max_iters" in text and "tolerance" in text

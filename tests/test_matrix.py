@@ -1,0 +1,96 @@
+"""Matrix container tests (reference src/tests/matrix_tests.cu parity)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.core.types import mode_from_name
+from tests.conftest import random_csr
+
+
+def test_from_csr_roundtrip():
+    sp = random_csr(50, density=0.1, seed=1)
+    A = SparseMatrix.from_scipy(sp)
+    assert A.n_rows == 50 and A.n_cols == 50
+    assert A.nnz == sp.nnz
+    np.testing.assert_allclose(A.to_dense(), sp.todense())
+
+
+def test_diag_extraction():
+    sp = random_csr(40, density=0.15, seed=2)
+    A = SparseMatrix.from_scipy(sp)
+    np.testing.assert_allclose(np.asarray(A.diag), sp.diagonal())
+
+
+def test_from_coo_duplicates_summed():
+    rows = [0, 0, 1, 1, 1]
+    cols = [0, 0, 1, 0, 1]
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    A = SparseMatrix.from_coo(rows, cols, vals, n_rows=2, n_cols=2)
+    dense = A.to_dense()
+    np.testing.assert_allclose(dense, [[3.0, 0.0], [4.0, 8.0]])
+
+
+def test_ell_built_for_regular_matrix():
+    sp = random_csr(30, density=0.2, seed=3)
+    A = SparseMatrix.from_scipy(sp)
+    assert A.has_ell
+    # padded entries contribute zero
+    x = np.ones(30)
+    y_ell = np.asarray(A.ell_vals @ np.ones(A.ell_cols.shape[1]))
+    np.testing.assert_allclose(
+        np.asarray(A.ell_vals).sum(axis=1), sp @ x
+    )
+
+
+def test_ell_skipped_for_skewed_matrix():
+    # one dense row in an otherwise diagonal matrix -> padding too costly
+    n = 4000
+    diag = sps.eye_array(n, format="lil") * 2.0
+    diag[0, :] = 1.0
+    A = SparseMatrix.from_scipy(diag.tocsr())
+    assert not A.has_ell
+
+
+def test_replace_values_keeps_structure():
+    sp = random_csr(25, density=0.2, seed=4)
+    A = SparseMatrix.from_scipy(sp)
+    new_vals = np.asarray(A.values) * 2.0
+    B = A.replace_values(new_vals)
+    np.testing.assert_allclose(B.to_dense(), 2.0 * sp.todense())
+    np.testing.assert_allclose(np.asarray(B.diag), 2.0 * sp.diagonal())
+    if A.has_ell:
+        np.testing.assert_allclose(
+            np.asarray(B.ell_vals), 2.0 * np.asarray(A.ell_vals)
+        )
+
+
+def test_block_matrix_roundtrip():
+    b = 3
+    n_blocks = 10
+    sp = random_csr(n_blocks * b, density=0.3, seed=5)
+    A = SparseMatrix.from_scipy(sp, block_size=b)
+    assert A.block_size == b
+    assert A.n_rows == n_blocks
+    got = A.to_dense()
+    np.testing.assert_allclose(got, sp.todense())
+
+
+def test_pytree_flattens():
+    import jax
+
+    sp = random_csr(20, density=0.2, seed=6)
+    A = SparseMatrix.from_scipy(sp)
+    leaves, treedef = jax.tree_util.tree_flatten(A)
+    A2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    np.testing.assert_allclose(A2.to_dense(), A.to_dense())
+
+
+def test_modes():
+    m = mode_from_name("dDDI")
+    assert m.vec_dtype == np.float64
+    m2 = mode_from_name("dDFI")
+    assert m2.mat_dtype == np.float32 and m2.vec_dtype == np.float64
+    with pytest.raises(ValueError):
+        mode_from_name("xXXX")

@@ -1,0 +1,65 @@
+"""SpMV tests (reference src/tests/matrix_vector_multiply_tests.cu)."""
+
+import jax
+import numpy as np
+import pytest
+
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.ops.spmv import spmv, residual
+from tests.conftest import random_csr
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("density", [0.02, 0.2])
+def test_spmv_matches_dense(seed, density):
+    n = 100
+    sp = random_csr(n, density=density, seed=seed)
+    A = SparseMatrix.from_scipy(sp)
+    x = np.random.default_rng(seed).standard_normal(n)
+    np.testing.assert_allclose(np.asarray(spmv(A, x)), sp @ x, rtol=1e-12)
+
+
+def test_spmv_csr_fallback_path():
+    n = 100
+    sp = random_csr(n, density=0.1, seed=3)
+    A = SparseMatrix.from_scipy(sp, build_ell=False)
+    assert not A.has_ell
+    x = np.random.default_rng(3).standard_normal(n)
+    np.testing.assert_allclose(np.asarray(spmv(A, x)), sp @ x, rtol=1e-12)
+
+
+@pytest.mark.parametrize("b", [2, 4])
+def test_spmv_block(b):
+    nb = 12
+    sp = random_csr(nb * b, density=0.3, seed=4)
+    A = SparseMatrix.from_scipy(sp, block_size=b)
+    x = np.random.default_rng(4).standard_normal(nb * b)
+    np.testing.assert_allclose(np.asarray(spmv(A, x)), sp @ x, rtol=1e-12)
+
+
+def test_spmv_jittable():
+    sp = random_csr(64, density=0.1, seed=5)
+    A = SparseMatrix.from_scipy(sp)
+    x = np.random.default_rng(5).standard_normal(64)
+    f = jax.jit(spmv)
+    np.testing.assert_allclose(np.asarray(f(A, x)), sp @ x, rtol=1e-12)
+
+
+def test_residual():
+    sp = random_csr(32, density=0.2, seed=6)
+    A = SparseMatrix.from_scipy(sp)
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal(32)
+    b = rng.standard_normal(32)
+    np.testing.assert_allclose(
+        np.asarray(residual(A, b, x)), b - sp @ x, rtol=1e-12
+    )
+
+
+def test_complex_spmv():
+    n = 40
+    sp = random_csr(n, density=0.2, seed=7).astype(np.complex128)
+    sp.data = sp.data * (1.0 + 0.5j)
+    A = SparseMatrix.from_scipy(sp)
+    x = np.random.default_rng(7).standard_normal(n) + 1j
+    np.testing.assert_allclose(np.asarray(spmv(A, x)), sp @ x, rtol=1e-12)
