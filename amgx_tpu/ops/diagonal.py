@@ -1,0 +1,30 @@
+"""Shared (block-)diagonal helpers for smoothers.
+
+Zero-pivot policy: a zero diagonal entry gets reciprocal 1.0 (the
+reference's zero_in_diagonal_handling behavior — solvers proceed, tests
+zero_in_diagonal_handling.cu assert no crash).  Centralized so the policy
+changes in one place.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def invert_diag(A):
+    """Inverse of the (block) diagonal, host-side at setup."""
+    d = np.asarray(A.diag)
+    if A.block_size == 1:
+        with np.errstate(divide="ignore"):
+            inv = np.where(d != 0, 1.0 / d, 1.0)
+        return jnp.asarray(inv)
+    return jnp.asarray(np.linalg.inv(d))
+
+
+def apply_dinv(dinv, r, block_size):
+    """z = D^{-1} r for flat vectors (block-aware)."""
+    if block_size == 1:
+        return dinv * r
+    rb = r.reshape(-1, block_size)
+    return jnp.einsum("nij,nj->ni", dinv, rb).reshape(-1)

@@ -2,6 +2,13 @@
 
 Importing this package registers all built-in solvers (the analogue of
 registerClasses at amgx::initialize, reference core.cu:552-688).
+
+Registered here: PCG, CG, PCGF, PBICGSTAB, BICGSTAB, FGMRES, GMRES,
+BLOCK_JACOBI, JACOBI_L1, GS, MULTICOLOR_GS, FIXCOLOR_GS, MULTICOLOR_DILU,
+MULTICOLOR_ILU, CHEBYSHEV, CHEBYSHEV_POLY, DENSE_LU_SOLVER, NOSOLVER.
+The AMG solver registers when amgx_tpu.amg is imported (amgx_tpu.initialize
+does both).  Pending reference parity: IDR/IDRMSYNC, KACZMARZ,
+POLYNOMIAL/KPZ_POLYNOMIAL, CF_JACOBI.
 """
 
 from amgx_tpu.solvers.registry import (
@@ -9,5 +16,24 @@ from amgx_tpu.solvers.registry import (
     register_solver,
     create_solver,
 )
+from amgx_tpu.solvers.base import Solver, SolveResult
 
-__all__ = ["SolverRegistry", "register_solver", "create_solver"]
+# registration side effects
+from amgx_tpu.solvers import (  # noqa: F401
+    chebyshev,
+    dense_lu,
+    dilu,
+    dummy,
+    gmres,
+    gs,
+    jacobi,
+    krylov,
+)
+
+__all__ = [
+    "SolverRegistry",
+    "register_solver",
+    "create_solver",
+    "Solver",
+    "SolveResult",
+]

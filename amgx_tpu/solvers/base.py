@@ -1,0 +1,390 @@
+"""Solver framework (reference Solver<TConfig>, solver.h:21-278, solver.cu).
+
+The reference contract — setup / solve_init / solve_iteration /
+solve_finalize with a monitored outer loop (solver.cu:586-860) — maps to a
+jit-first design:
+
+  * ``setup(A)`` is host-side: builds preconditioner state (inverted
+    diagonals, hierarchies, colorings) as pytrees of device arrays.
+  * ``solve(b, x0)`` runs ONE fully-jitted function containing the entire
+    iteration loop (``lax.while_loop``), residual monitoring, convergence
+    and divergence checks, and residual-history recording.  One compile
+    per (structure, shape) signature, cached.
+  * Solvers used as preconditioners/smoothers expose pure functions:
+      - ``make_apply()``  -> fn(params, r) -> z        (zero initial guess)
+      - ``make_smooth()`` -> fn(params, b, x, sweeps) -> x
+    with all arrays flowing through ``params`` (= ``apply_params()``), so
+    outer solvers can embed them in their own jitted loops.
+
+Stationary solvers (Jacobi/GS/DILU/Chebyshev-poly...) implement
+``make_step`` and inherit the generic monitored loop; Krylov solvers
+override ``make_solve`` wholesale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.core.types import NormType
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.ops.norms import norm as _norm, block_norm as _block_norm
+from amgx_tpu.solvers.convergence import make_convergence_check
+
+# AMGX_SOLVE_* status codes (reference amgx_c.h / AMGX_STATUS)
+SUCCESS = 0
+FAILED = 1  # diverged or NaN
+NOT_CONVERGED = 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SolveResult:
+    x: jnp.ndarray
+    iters: jnp.ndarray  # i32 scalar
+    status: jnp.ndarray  # i32 scalar: SUCCESS/FAILED/NOT_CONVERGED
+    final_norm: jnp.ndarray  # (ncomp,) real
+    initial_norm: jnp.ndarray  # (ncomp,) real
+    history: jnp.ndarray  # (max_iters+1, ncomp) real, NaN-padded
+
+    @property
+    def converged(self):
+        return self.status == SUCCESS
+
+
+class Solver:
+    """Base solver. Subclasses register via @register_solver(NAME)."""
+
+    registry_name = "?"
+    # True if this solver ignores its operator (e.g. NOSOLVER)
+    is_identity = False
+
+    def __init__(self, cfg, scope: str = "default"):
+        self.cfg = cfg
+        self.scope = scope
+        g = lambda k: cfg.get(k, scope)
+        self.max_iters = int(g("max_iters"))
+        self.tolerance = float(g("tolerance"))
+        self.conv_type = str(g("convergence"))
+        self.norm_type = NormType(str(g("norm")))
+        self.monitor_residual = bool(g("monitor_residual"))
+        self.store_res_history = bool(g("store_res_history"))
+        self.use_scalar_norm = bool(g("use_scalar_norm"))
+        self.relaxation_factor = float(g("relaxation_factor"))
+        self.print_solve_stats = bool(g("print_solve_stats"))
+        self.obtain_timings = bool(g("obtain_timings"))
+        self.rel_div_tolerance = float(g("rel_div_tolerance"))
+        self.alt_rel_tolerance = float(g("alt_rel_tolerance"))
+        self._conv_check = make_convergence_check(
+            self.conv_type, self.tolerance, self.alt_rel_tolerance
+        )
+        self.A: Optional[SparseMatrix] = None
+        self._params: Any = None
+        self._jit_cache: dict = {}
+        self.setup_time = 0.0
+        self.solve_time = 0.0
+
+    # ------------------------------------------------------------------
+    # overridables
+
+    def _setup_impl(self, A: SparseMatrix):
+        """Host-side setup; must set self._params (pytree of arrays)."""
+        self._params = A
+
+    def make_step(self) -> Callable:
+        """Pure fn(params, b, x) -> x : one relaxation sweep."""
+        rstep = self.make_residual_step()
+        if rstep is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} provides no stationary step"
+            )
+
+        def step(params, b, x):
+            A = self.operator_of(params)
+            return rstep(params, b, x, b - spmv(A, x))
+
+        return step
+
+    def make_residual_step(self) -> Optional[Callable]:
+        """Pure fn(params, b, x, r) -> x consuming the precomputed residual
+        r = b - A x.  Solvers that can use it (Jacobi, DILU) return it so
+        the monitored loop shares one SpMV per iteration between the step
+        and the norm; others return None."""
+        return None
+
+    def make_solve(self) -> Callable:
+        """Pure fn(params, b, x0) -> SolveResult. Default: monitored
+        stationary iteration of make_step (reference solver.cu:795-855)."""
+        norm_of = self.make_norm()
+
+        if not self.monitor_residual:
+            smooth = self.make_smooth()
+            iters = self.max_iters
+
+            def solve_plain(params, b, x0):
+                x = smooth(params, b, x0, iters)
+                return self._fixed_result(x, b, iters)
+
+            return solve_plain
+
+        rstep = self.make_residual_step()
+        if rstep is not None:
+            # residual-carrying loop: ONE SpMV per iteration shared between
+            # the step and the norm
+            def solve_r(params, b, x0):
+                A = self.operator_of(params)
+                r0 = b - spmv(A, x0)
+
+                def body(c):
+                    it, x, (r,), nrm, ini, mx, hist, st = c
+                    x = rstep(params, b, x, r)
+                    r = b - spmv(A, x)
+                    nrm = norm_of(r)
+                    return self._monitor_update(
+                        it + 1, x, (r,), nrm, ini, mx, hist, st
+                    )
+
+                return self._monitored_loop(
+                    norm_of(r0), body, b, x0, (r0,)
+                )
+
+            return solve_r
+
+        step = self.make_step()
+
+        def solve(params, b, x0):
+            A = self.operator_of(params)
+
+            def compute_nrm(x):
+                return norm_of(b - spmv(A, x))
+
+            def body(c):
+                it, x, extra, nrm, ini, mx, hist, st = c
+                x = step(params, b, x)
+                nrm = compute_nrm(x)
+                it = it + 1
+                return self._monitor_update(
+                    it, x, extra, nrm, ini, mx, hist, st
+                )
+
+            return self._monitored_loop(compute_nrm(x0), body, b, x0, ())
+
+        return solve
+
+    def make_apply(self) -> Callable:
+        """Pure fn(params, r) -> z, preconditioner application with zero
+        initial guess; default = max_iters unmonitored sweeps."""
+        smooth = self.make_smooth()
+        iters = max(self.max_iters, 1)
+
+        def apply(params, r):
+            z = jnp.zeros_like(r)
+            return smooth(params, r, z, iters)
+
+        return apply
+
+    # few-sweep loops unroll (cycle smoothers, sweeps 1-4); longer ones use
+    # fori_loop to bound trace size
+    _UNROLL_LIMIT = 8
+
+    def make_smooth(self) -> Callable:
+        """Pure fn(params, b, x, sweeps) -> x (sweeps is static)."""
+        step = self.make_step()
+
+        def smooth(params, b, x, sweeps):
+            if sweeps <= self._UNROLL_LIMIT:
+                for _ in range(sweeps):
+                    x = step(params, b, x)
+                return x
+            return jax.lax.fori_loop(
+                0, sweeps, lambda i, x: step(params, b, x), x
+            )
+
+        return smooth
+
+    # ------------------------------------------------------------------
+    # shared machinery
+
+    def operator_of(self, params):
+        """By convention params is the matrix or a tuple starting with it."""
+        return params[0] if isinstance(params, tuple) else params
+
+    @property
+    def norm_components(self) -> int:
+        if (
+            self.A is not None
+            and self.A.block_size > 1
+            and not self.use_scalar_norm
+        ):
+            return self.A.block_size
+        return 1
+
+    def make_norm(self):
+        nt = self.norm_type
+        ncomp = self.norm_components
+        if ncomp > 1:
+            b = self.A.block_size
+            return lambda r: _block_norm(r, b, nt)
+        return lambda r: jnp.atleast_1d(_norm(r, nt))
+
+    def _monitor_update(
+        self, it, x, extra, nrm, nrm_ini, nrm_max, hist, status
+    ):
+        """Common tail of a monitored loop body: record history, update
+        max-norm, derive status."""
+        nrm_max = jnp.maximum(nrm_max, nrm)
+        hist = hist.at[it].set(nrm)
+        done_ok = self._conv_check(nrm, nrm_ini, nrm_max)
+        bad = ~jnp.all(jnp.isfinite(nrm))
+        if self.rel_div_tolerance > 0:
+            bad = bad | jnp.any(nrm > self.rel_div_tolerance * nrm_ini)
+        status = jnp.where(
+            bad,
+            jnp.int32(FAILED),
+            jnp.where(done_ok, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)),
+        )
+        return (it, x, extra, nrm, nrm_ini, nrm_max, hist, status)
+
+    def _fixed_result(self, x, b, iters) -> SolveResult:
+        """Result shell for unmonitored fixed-iteration solves."""
+        rdt = jnp.real(b).dtype
+        ncomp = self.norm_components
+        zero = jnp.zeros((ncomp,), rdt)
+        return SolveResult(
+            x=x,
+            iters=jnp.int32(iters),
+            status=jnp.int32(SUCCESS),
+            final_norm=zero,
+            initial_norm=zero,
+            history=jnp.full((self.max_iters + 1, ncomp), jnp.nan, rdt),
+        )
+
+    def _monitored_loop(self, nrm0, body, b, x0, extra0):
+        """Generic monitored while_loop (reference solver.cu:586-860).
+
+        carry = (it, x, extra, nrm, nrm_ini, nrm_max, hist, status); body
+        must end with _monitor_update.  ``extra0`` is solver-specific loop
+        state (Krylov vectors etc.).
+        """
+        rdt = jnp.real(b).dtype
+        ncomp = self.norm_components
+        hist = jnp.full((self.max_iters + 1, ncomp), jnp.nan, rdt)
+        hist = hist.at[0].set(nrm0)
+        done0 = self._conv_check(nrm0, nrm0, nrm0)
+        status0 = jnp.where(
+            done0, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
+        )
+
+        def cond(c):
+            it, status = c[0], c[7]
+            return (status == NOT_CONVERGED) & (it < self.max_iters)
+
+        c0 = (jnp.int32(0), x0, extra0, nrm0, nrm0, nrm0, hist, status0)
+        it, x, _, nrm, ini, mx, hist, status = jax.lax.while_loop(
+            cond, body, c0
+        )
+        return SolveResult(
+            x=x,
+            iters=it,
+            status=status,
+            final_norm=nrm,
+            initial_norm=ini,
+            history=hist,
+        )
+
+    # ------------------------------------------------------------------
+    # public API (reference Solver::setup / solve, solver.cu:333,586)
+
+    def setup(self, A: SparseMatrix):
+        t0 = time.perf_counter()
+        self.A = A
+        self._setup_impl(A)
+        self._jit_cache.clear()
+        self.setup_time = time.perf_counter() - t0
+        return self
+
+    def apply_params(self):
+        return self._params
+
+    def solve(self, b, x0=None, zero_initial_guess=False) -> SolveResult:
+        if self.A is None:
+            raise RuntimeError("solve() before setup()")
+        b = jnp.asarray(b)
+        if x0 is None or zero_initial_guess:
+            x0 = jnp.zeros_like(b)
+        else:
+            x0 = jnp.asarray(x0)
+        key = (b.shape, b.dtype.name)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self.make_solve())
+            self._jit_cache[key] = fn
+        t0 = time.perf_counter()
+        res = fn(self.apply_params(), b, x0)
+        res.x.block_until_ready()
+        self.solve_time = time.perf_counter() - t0
+        if self.print_solve_stats:
+            self._print_stats(res)
+        if self.obtain_timings:
+            print(
+                f"Total Time: {self.setup_time + self.solve_time:10.6f}\n"
+                f"    setup: {self.setup_time:10.6f} s\n"
+                f"    solve: {self.solve_time:10.6f} s\n"
+                f"    solve(per iteration): "
+                f"{self.solve_time / max(1, int(res.iters)):10.6f} s"
+            )
+        return res
+
+    def _print_stats(self, res: SolveResult):
+        """Residual table in the reference output format (README.md:118-131)."""
+        import numpy as np
+
+        hist = np.asarray(res.history)
+        iters = int(res.iters)
+        print("           iter      residual           rate")
+        print("         --------------------------------------")
+        for i in range(min(iters, self.max_iters) + 1):
+            row = hist[i]
+            if np.all(np.isnan(row)):
+                continue
+            r = float(np.max(row))
+            if i == 0:
+                print(f"            Ini {r:18.6e}")
+            else:
+                prev = float(np.max(hist[i - 1]))
+                rate = r / prev if prev > 0 else 0.0
+                print(f"            {i:3d} {r:18.6e} {rate:14.4f}")
+        st = int(res.status)
+        label = {0: "success", 1: "failed (diverged/nan)", 2: "not converged"}[st]
+        print("         --------------------------------------")
+        print(
+            f"         Total Iterations: {iters}\n"
+            f"         Avg Convergence Rate: "
+            f"{self._avg_rate(hist, iters):18.4f}\n"
+            f"         Final Residual: {float(np.max(hist[iters])):18.6e}\n"
+            f"         Residual reduction: "
+            f"{float(np.max(hist[iters]) / max(np.max(hist[0]), 1e-300)):18.6e}\n"
+            f"         Solve status: {label}"
+        )
+
+    @staticmethod
+    def _avg_rate(hist, iters):
+        import numpy as np
+
+        if iters < 1:
+            return 0.0
+        r0, rn = np.max(hist[0]), np.max(hist[iters])
+        if r0 <= 0:
+            return 0.0
+        return float((rn / r0) ** (1.0 / iters))
+
+
+class IdentitySolverMixin:
+    """For NOSOLVER-style solvers: apply is identity, smooth is no-op."""
+
+    is_identity = True

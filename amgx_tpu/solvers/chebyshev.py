@@ -1,0 +1,83 @@
+"""Chebyshev iteration (reference cheb_solver.cu, chebyshev_poly.cu).
+
+One step applies an order-k Chebyshev polynomial in the Jacobi-
+preconditioned operator D^{-1}A over the interval [lmin, lmax].  Interval:
+user-provided (chebyshev_lambda_estimate_mode=1: cheby_min/max_lambda) or
+estimated at setup by power iteration on D^{-1}A (mode 0), with
+lmin = cheby_min_lambda * lmax (the reference default ratio 0.125).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from amgx_tpu.ops.diagonal import invert_diag
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.solvers.base import Solver
+from amgx_tpu.solvers.registry import register_solver
+
+
+def estimate_lambda_max(A, dinv, iters=20, seed=0):
+    """Power iteration on D^{-1}A (host loop over device ops; setup-time)."""
+    rng = np.random.default_rng(seed)
+    v = jnp.asarray(rng.standard_normal(A.n_rows * A.block_size).astype(
+        np.asarray(A.values).real.dtype
+    ))
+    lam = 1.0
+    for _ in range(iters):
+        w = dinv * spmv(A, v)
+        lam = float(jnp.linalg.norm(w))
+        v = w / jnp.maximum(lam, 1e-30)
+    return lam
+
+
+@register_solver("CHEBYSHEV")
+class ChebyshevSolver(Solver):
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.order = int(cfg.get("chebyshev_polynomial_order", scope))
+        self.lambda_mode = int(
+            cfg.get("chebyshev_lambda_estimate_mode", scope)
+        )
+        self.user_max = float(cfg.get("cheby_max_lambda", scope))
+        self.user_min = float(cfg.get("cheby_min_lambda", scope))
+
+    def _setup_impl(self, A):
+        if A.block_size != 1:
+            raise NotImplementedError("Chebyshev block matrices TBD")
+        dinv = invert_diag(A)
+        if self.lambda_mode == 0:
+            lmax = 1.1 * estimate_lambda_max(A, dinv)
+            lmin = self.user_min * lmax  # ratio semantics, default 0.125
+        else:
+            lmax, lmin = self.user_max, self.user_min
+        self.lmax, self.lmin = float(lmax), float(lmin)
+        self._params = (A, dinv)
+
+    def make_step(self):
+        k = max(self.order, 1)
+        theta = (self.lmax + self.lmin) / 2.0
+        delta = (self.lmax - self.lmin) / 2.0
+        sigma = theta / delta
+
+        def step(params, b, x):
+            A, dinv = params
+            rho_old = 1.0 / sigma
+            r = b - spmv(A, x)
+            d = dinv * r / theta
+            x = x + d
+            for _ in range(k - 1):
+                rho = 1.0 / (2.0 * sigma - rho_old)
+                r = b - spmv(A, x)
+                d = rho * rho_old * d + (2.0 * rho / delta) * (dinv * r)
+                x = x + d
+                rho_old = rho
+            return x
+
+        return step
+
+
+@register_solver("CHEBYSHEV_POLY")
+class ChebyshevPolySolver(ChebyshevSolver):
+    """Polynomial-smoother registration alias (reference chebyshev_poly.cu)."""

@@ -1,0 +1,40 @@
+"""Convergence criteria (reference src/convergence/, convergence.h:64-108).
+
+Registered types: ABSOLUTE, RELATIVE_INI[_CORE], RELATIVE_MAX[_CORE],
+COMBINED_REL_INI_ABS.  Each becomes a pure jit-safe predicate
+``check(nrm, nrm_ini, nrm_max) -> bool`` built once per solver from static
+config; block norms (nrm a vector) must converge in every component.
+The divergence check (rel_div_tolerance, CHANGELOG:26) is layered on in
+the solve loop, not here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def make_convergence_check(conv: str, tolerance: float, alt_rel_tol: float):
+    conv = conv.upper()
+
+    if conv == "ABSOLUTE":
+        raw = lambda nrm, nrm_ini, nrm_max: jnp.all(nrm < tolerance)
+    elif conv in ("RELATIVE_INI", "RELATIVE_INI_CORE"):
+        raw = lambda nrm, nrm_ini, nrm_max: jnp.all(
+            nrm < tolerance * nrm_ini
+        )
+    elif conv in ("RELATIVE_MAX", "RELATIVE_MAX_CORE"):
+        raw = lambda nrm, nrm_ini, nrm_max: jnp.all(
+            nrm < tolerance * nrm_max
+        )
+    elif conv == "COMBINED_REL_INI_ABS":
+        raw = lambda nrm, nrm_ini, nrm_max: jnp.all(
+            (nrm < tolerance) | (nrm < alt_rel_tol * nrm_ini)
+        )
+    else:
+        raise ValueError(f"unknown convergence criterion {conv!r}")
+
+    # an exactly-zero residual is always converged (relative criteria with
+    # nrm_ini == 0, e.g. b == 0 and x0 == 0, would otherwise never stop)
+    return lambda nrm, nrm_ini, nrm_max: raw(nrm, nrm_ini, nrm_max) | jnp.all(
+        nrm == 0
+    )

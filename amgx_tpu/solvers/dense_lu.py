@@ -1,0 +1,55 @@
+"""Dense LU coarse solver (reference dense_lu_solver.cu: cuSOLVER
+getrf/getrs on the densified coarse matrix).
+
+TPU form: densify at setup (host), LU-factorize once with
+``jax.scipy.linalg.lu_factor`` (batched MXU-friendly), apply is a pair of
+triangular solves inside the jitted cycle.  Size guards
+dense_lu_num_rows/dense_lu_max_rows live in the AMG driver (amg.cu:76-85).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from amgx_tpu.solvers.base import Solver
+from amgx_tpu.solvers.registry import register_solver
+
+
+@register_solver("DENSE_LU_SOLVER")
+class DenseLUSolver(Solver):
+    def _setup_impl(self, A):
+        dense = jnp.asarray(A.to_dense())
+        lu, piv = jax.scipy.linalg.lu_factor(dense)
+        self._params = (A, lu, piv)
+
+    def make_apply(self):
+        def apply(params, r):
+            _, lu, piv = params
+            return jax.scipy.linalg.lu_solve((lu, piv), r)
+
+        return apply
+
+    def make_smooth(self):
+        apply = self.make_apply()
+
+        def smooth(params, b, x, sweeps):
+            # direct solve: the result does not depend on x or sweeps
+            return apply(params, b)
+
+        return smooth
+
+    def make_solve(self):
+        apply = self.make_apply()
+
+        def solve(params, b, x0):
+            x = apply(params, b)
+            return self._fixed_result(x, b, 1)
+
+        return solve
+
+
+@register_solver("DENSE_LU")
+class DenseLUAlias(DenseLUSolver):
+    pass

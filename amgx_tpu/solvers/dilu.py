@@ -1,0 +1,126 @@
+"""Multicolor DILU (diagonal-ILU(0)) smoother — the reference's workhorse
+preconditioner (multicolor_dilu_solver.cu, 4259 LoC of block-size
+specialized CUDA).
+
+Math: with coloring-induced ordering and E the DILU diagonal,
+
+    E_i = a_ii - sum_{j in N(i), color(j) < color(i)} a_ij E_j^{-1} a_ji
+    M   = (E + L) E^{-1} (E + U)
+
+where L/U are the strictly lower/upper (by color order) parts of A.
+Apply M^{-1} r: forward color sweep solves (E+L) y = r, backward sweep
+solves (E+U) z = E y.
+
+TPU form: E is computed at setup with a host loop over colors (vectorized
+scipy per color — the analogue of the reference's per-color setup
+kernels); L/U are the same CSR structure with masked values, so each
+sweep stage is one masked SpMV + select, ``2 * num_colors`` stages per
+application, all fused under jit.  Scalar (block_size 1) for now.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.ops.coloring import color_matrix
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.solvers.base import Solver
+from amgx_tpu.solvers.registry import register_solver
+
+
+@register_solver("MULTICOLOR_DILU")
+class MulticolorDILUSolver(Solver):
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.scheme = str(cfg.get("matrix_coloring_scheme", scope))
+        self.deterministic = bool(cfg.get("determinism_flag", scope))
+
+    def _setup_impl(self, A: SparseMatrix):
+        if A.block_size != 1:
+            raise NotImplementedError("DILU block matrices TBD")
+        colors = color_matrix(A, self.scheme, self.deterministic)
+        self.num_colors = int(colors.max()) + 1
+
+        indptr = np.asarray(A.row_offsets)
+        indices = np.asarray(A.col_indices)
+        vals = np.asarray(A.values)
+        n = A.n_rows
+        row_ids = np.asarray(A.row_ids)
+
+        lower = colors[indices] < colors[row_ids]
+        upper = colors[indices] > colors[row_ids]
+
+        # E via W = A .* A^T on the intersected sparsity (host scipy)
+        import scipy.sparse as sps
+
+        Asp = sps.csr_matrix((vals, indices, indptr), shape=(n, n))
+        W = Asp.multiply(Asp.T).tocsr()  # w_ij = a_ij * a_ji
+        W.sort_indices()
+        E = np.array(np.asarray(A.diag), copy=True)
+        for c in range(1, self.num_colors):
+            rows_c = np.nonzero(colors == c)[0]
+            if rows_c.size == 0:
+                continue
+            with np.errstate(divide="ignore", invalid="ignore"):
+                einv = np.where(
+                    (E != 0) & (colors < c), 1.0 / E, 0.0
+                )
+            corr = W[rows_c] @ einv
+            E[rows_c] = np.asarray(A.diag)[rows_c] - corr
+        E = np.where(E == 0, 1.0, E)  # zero-pivot guard
+
+        A_L = SparseMatrix.from_csr(
+            indptr, indices, np.where(lower, vals, 0.0),
+            n_cols=A.n_cols, build_ell=A.has_ell,
+        )
+        A_U = SparseMatrix.from_csr(
+            indptr, indices, np.where(upper, vals, 0.0),
+            n_cols=A.n_cols, build_ell=A.has_ell,
+        )
+        einv = (1.0 / E).astype(vals.dtype)
+        self._params = (A, A_L, A_U, jnp.asarray(einv), jnp.asarray(colors))
+
+    def _apply_M_inv(self, params, r):
+        A, A_L, A_U, einv, colors = params
+        ncol = self.num_colors
+        # forward: (E+L) y = r
+        y = jnp.zeros_like(r)
+        for c in range(ncol):
+            cand = (r - spmv(A_L, y)) * einv
+            y = jnp.where(colors == c, cand, y)
+        # backward: (E+U) z = E y  ->  z = y - Einv (U z)
+        z = y
+        for c in range(ncol - 1, -1, -1):
+            cand = y - einv * spmv(A_U, z)
+            z = jnp.where(colors == c, cand, z)
+        return z
+
+    def make_residual_step(self):
+        omega = self.relaxation_factor
+
+        def rstep(params, b, x, r):
+            return x + omega * self._apply_M_inv(params, r)
+
+        return rstep
+
+    def make_apply(self):
+        omega = self.relaxation_factor
+        step = self.make_step()
+        iters = max(self.max_iters, 1)
+
+        def apply(params, r):
+            z = omega * self._apply_M_inv(params, r)
+            for _ in range(iters - 1):
+                z = step(params, r, z)
+            return z
+
+        return apply
+
+
+@register_solver("MULTICOLOR_ILU")
+class MulticolorILUSolver(MulticolorDILUSolver):
+    """ILU(0) approximation: the reference multicolor_ilu_solver.cu keeps
+    full L/U factors; DILU is its diagonal variant and a good stand-in
+    until the factorized version lands (ilu_sparsity_level=0 only)."""

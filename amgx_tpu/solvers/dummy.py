@@ -1,0 +1,32 @@
+"""NOSOLVER (reference dummy_solver.cu) and user-solver hook
+(user_solver.cu)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from amgx_tpu.solvers.base import IdentitySolverMixin, Solver
+from amgx_tpu.solvers.registry import register_solver
+
+
+@register_solver("NOSOLVER")
+class DummySolver(IdentitySolverMixin, Solver):
+    """Does nothing (reference zeroes x on zero guess and returns).  Outer
+    solvers special-case the name NOSOLVER and skip preconditioning
+    entirely (reference pcg_solver.cu:21-29); when invoked anyway the
+    apply is the zero map, matching the reference."""
+
+    def _setup_impl(self, A):
+        self._params = A
+
+    def make_step(self):
+        return lambda params, b, x: x
+
+    def make_apply(self):
+        return lambda params, r: jnp.zeros_like(r)
+
+    def make_solve(self):
+        def solve(params, b, x0):
+            return self._fixed_result(x0, b, 0)
+
+        return solve

@@ -1,0 +1,212 @@
+"""GMRES / FGMRES with restarts (reference gmres_solver.cu,
+fgmres_solver.cu).
+
+Structure: restart cycles of Arnoldi with modified Gram-Schmidt and Givens
+rotations.  The reference runs Givens on host (fgmres_solver.cu:233-250);
+here the whole solve — outer restart ``while_loop``, inner Arnoldi
+``while_loop`` with masked MGS over the static Krylov dimension, and the
+masked triangular solve — is one jitted program, so nothing syncs with the
+host per iteration.
+
+GMRES is left-preconditioned (Krylov space of M A); FGMRES is flexible
+right-preconditioned, storing the preconditioned vectors Z_j so the
+preconditioner may change between iterations.  Real dtypes only (complex
+Givens TBD).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.solvers.base import NOT_CONVERGED, SUCCESS, SolveResult
+from amgx_tpu.solvers.krylov import KrylovSolver
+from amgx_tpu.solvers.registry import register_solver
+
+
+@register_solver("FGMRES")
+class FGMRESSolver(KrylovSolver):
+    flexible = True
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.restart = int(cfg.get("gmres_n_restart", scope))
+
+    def make_solve(self):
+        return self._build_solve(self.max_iters, self.monitor_residual)
+
+    def _build_solve(self, max_iters, monitored):
+        M = self._make_M()
+        m = self.restart
+        flexible = self.flexible
+        conv_check = (
+            self._conv_check
+            if monitored
+            else (lambda *a: jnp.asarray(False))
+        )
+        rel_div = self.rel_div_tolerance
+
+        def solve(params, b, x0):
+            A, Mp = params
+            n = b.shape[0]
+            dt = b.dtype
+
+            def precond_resid(x):
+                r = b - spmv(A, x)
+                return r if flexible else M(Mp, r)
+
+            def arnoldi_step(c):
+                (j, V, Z, H, g, cs, sn, it, hist, status, ini, mx) = c
+                v = V[j]
+                if flexible:
+                    z = M(Mp, v)
+                    w = spmv(A, z)
+                    Z = Z.at[j].set(z)
+                else:
+                    w = M(Mp, spmv(A, v))
+                # masked modified Gram-Schmidt over the static dimension
+                hcol = jnp.zeros(m + 1, dt)
+
+                def mgs(i, wc):
+                    w, hcol = wc
+                    h = jnp.where(i <= j, jnp.dot(V[i], w), 0.0)
+                    w = w - h * V[i]
+                    return (w, hcol.at[i].set(h))
+
+                w, hcol = jax.lax.fori_loop(0, m, mgs, (w, hcol))
+                hlast = jnp.sqrt(jnp.dot(w, w))
+                hcol = hcol.at[j + 1].set(hlast)
+                V = V.at[j + 1].set(w / jnp.where(hlast > 0, hlast, 1.0))
+
+                # apply existing Givens rotations to the new column
+                def rot(i, hc):
+                    t = cs[i] * hc[i] + sn[i] * hc[i + 1]
+                    u = -sn[i] * hc[i] + cs[i] * hc[i + 1]
+                    do = i < j
+                    return hc.at[i].set(jnp.where(do, t, hc[i])).at[
+                        i + 1
+                    ].set(jnp.where(do, u, hc[i + 1]))
+
+                hcol = jax.lax.fori_loop(0, m, rot, hcol)
+                hj, hj1 = hcol[j], hcol[j + 1]
+                denom = jnp.sqrt(hj * hj + hj1 * hj1)
+                denom = jnp.where(denom > 0, denom, 1.0)
+                c_new, s_new = hj / denom, hj1 / denom
+                hcol = hcol.at[j].set(denom).at[j + 1].set(0.0)
+                cs = cs.at[j].set(c_new)
+                sn = sn.at[j].set(s_new)
+                gj = g[j]
+                g = g.at[j].set(c_new * gj).at[j + 1].set(-s_new * gj)
+                H = H.at[:, j].set(hcol)
+
+                res_est = jnp.abs(g[j + 1])
+                it = it + 1
+                hist = hist.at[it, 0].set(res_est)
+                nrm = jnp.atleast_1d(res_est)
+                mx = jnp.maximum(mx, nrm)
+                done = conv_check(nrm, ini, mx)
+                bad = ~jnp.isfinite(res_est)
+                if rel_div > 0:
+                    bad = bad | jnp.any(nrm > rel_div * ini)
+                status = jnp.where(
+                    bad,
+                    jnp.int32(1),
+                    jnp.where(
+                        done, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
+                    ),
+                )
+                return (j + 1, V, Z, H, g, cs, sn, it, hist, status, ini, mx)
+
+            def arnoldi_cond(c):
+                j, it, status = c[0], c[7], c[9]
+                return (
+                    (j < m) & (status == NOT_CONVERGED) & (it < max_iters)
+                )
+
+            def restart_body(c):
+                x, it, hist, status, ini, mx = c
+                r = precond_resid(x)
+                beta = jnp.sqrt(jnp.dot(r, r))
+                V = jnp.zeros((m + 1, n), dt)
+                V = V.at[0].set(r / jnp.where(beta > 0, beta, 1.0))
+                Z = jnp.zeros((m if flexible else 1, n), dt)
+                H = jnp.zeros((m + 1, m), dt)
+                g = jnp.zeros(m + 1, dt).at[0].set(beta)
+                cs = jnp.ones(m, dt)
+                sn = jnp.zeros(m, dt)
+                inner0 = (
+                    jnp.int32(0), V, Z, H, g, cs, sn, it, hist, status,
+                    ini, mx,
+                )
+                (
+                    j, V, Z, H, g, cs, sn, it, hist, status, ini, mx
+                ) = jax.lax.while_loop(arnoldi_cond, arnoldi_step, inner0)
+
+                # masked upper-triangular solve H[:m,:m] y = g[:m]
+                idx = jnp.arange(m)
+                diag_fix = jnp.where(idx >= j, 1.0, 0.0)
+                R = H[:m, :m] + jnp.diag(diag_fix)
+                gm = jnp.where(idx < j, g[:m], 0.0)
+                y = jax.scipy.linalg.solve_triangular(R, gm, lower=False)
+                basis = Z if flexible else V[:m]
+                x = x + basis.T @ y
+                return (x, it, hist, status, ini, mx)
+
+            def outer_cond(c):
+                it, status = c[1], c[3]
+                return (status == NOT_CONVERGED) & (it < max_iters)
+
+            rdt = jnp.zeros((), dt).real.dtype
+            hist = jnp.full((max_iters + 1, 1), jnp.nan, rdt)
+            r0 = precond_resid(x0)
+            nrm0 = jnp.atleast_1d(jnp.sqrt(jnp.dot(r0, r0)))
+            hist = hist.at[0].set(nrm0)
+            status0 = jnp.where(
+                conv_check(nrm0, nrm0, nrm0) & monitored,
+                jnp.int32(SUCCESS),
+                jnp.int32(NOT_CONVERGED),
+            )
+            c0 = (x0, jnp.int32(0), hist, status0, nrm0, nrm0)
+            x, it, hist, status, ini, mx = jax.lax.while_loop(
+                outer_cond, restart_body, c0
+            )
+            final = hist[jnp.minimum(it, max_iters)]
+            if not monitored:
+                status = jnp.int32(SUCCESS)
+            return SolveResult(
+                x=x,
+                iters=it,
+                status=status,
+                final_norm=final,
+                initial_norm=ini,
+                history=hist,
+            )
+
+        return solve
+
+    def make_apply(self):
+        """Nested-solver usage: fixed max_iters iterations, unmonitored."""
+        solve = self._build_solve(max(self.max_iters, 1), monitored=False)
+
+        def apply(params, r):
+            return solve(params, r, jnp.zeros_like(r)).x
+
+        return apply
+
+    def make_smooth(self):
+        """sweeps GMRES iterations (restarting as needed), unmonitored —
+        honors the base contract fn(params, b, x, sweeps)."""
+        cache = {}
+
+        def smooth(params, b, x, sweeps):
+            if sweeps not in cache:
+                cache[sweeps] = self._build_solve(sweeps, monitored=False)
+            return cache[sweeps](params, b, x).x
+
+        return smooth
+
+
+@register_solver("GMRES")
+class GMRESSolver(FGMRESSolver):
+    flexible = False

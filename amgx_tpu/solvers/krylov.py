@@ -1,0 +1,252 @@
+"""Krylov solvers: CG, PCG, PCGF, BiCGStab, PBiCGStab.
+
+Reference parity: cg_solver.cu, pcg_solver.cu, pcgf_solver.cu,
+bicgstab_solver.cu, pbicgstab_solver.cu.  Each iteration is a pure
+function over (params, b, x, extra); the generic monitored loop in
+``Solver`` drives convergence/history.  Preconditioners are embedded as
+pure apply functions whose arrays ride in ``params[1]`` — so a PCG with
+an AMG preconditioner is ONE jitted program.
+
+The NOSOLVER name disables preconditioning (reference
+pcg_solver.cu:21-29).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from amgx_tpu.ops.blas import dot
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.solvers.base import Solver
+from amgx_tpu.solvers.registry import SolverRegistry, register_solver
+
+
+def resolve_preconditioner(cfg, scope):
+    """Allocate the preconditioner named in config, or None for NOSOLVER."""
+    name, pscope = cfg.get_scoped("preconditioner", scope)
+    if name == "NOSOLVER":
+        return None
+    return SolverRegistry.get(name)(cfg, pscope)
+
+
+class KrylovSolver(Solver):
+    uses_preconditioner = True
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.precond = (
+            resolve_preconditioner(cfg, scope)
+            if self.uses_preconditioner
+            else None
+        )
+
+    def _setup_impl(self, A):
+        if self.precond is not None:
+            self.precond.setup(A)
+            self._params = (A, self.precond.apply_params())
+        else:
+            self._params = (A, None)
+
+    def _make_M(self):
+        """Pure fn(Mp, r) -> z; identity when unpreconditioned."""
+        if self.precond is None:
+            return lambda Mp, r: r
+        return self.precond.make_apply()
+
+    # -- iteration protocol (subclasses) --------------------------------
+    # extra is solver state; extra[0] must be the current residual r.
+
+    def _make_init(self):
+        raise NotImplementedError
+
+    def _make_iter(self):
+        raise NotImplementedError
+
+    def make_solve(self):
+        init = self._make_init()
+        iterate = self._make_iter()
+        norm_of = self.make_norm()
+        monitored = self.monitor_residual
+
+        def solve(params, b, x0):
+            extra0 = init(params, b, x0)
+            if not monitored:
+                def fori_body(i, c):
+                    x, extra = c
+                    return iterate(params, b, x, extra)
+
+                x, _ = jax.lax.fori_loop(
+                    0, self.max_iters, fori_body, (x0, extra0)
+                )
+                return self._fixed_result(x, b, self.max_iters)
+
+            nrm0 = norm_of(extra0[0])
+
+            def body(c):
+                it, x, extra, nrm, ini, mx, hist, st = c
+                x, extra = iterate(params, b, x, extra)
+                nrm = norm_of(extra[0])
+                return self._monitor_update(
+                    it + 1, x, extra, nrm, ini, mx, hist, st
+                )
+
+            return self._monitored_loop(nrm0, body, b, x0, extra0)
+
+        return solve
+
+    def make_apply(self):
+        """Fixed-iteration zero-guess run (nested-solver usage)."""
+        init = self._make_init()
+        iterate = self._make_iter()
+        iters = max(self.max_iters, 1)
+
+        def apply(params, r):
+            x = jnp.zeros_like(r)
+            extra = init(params, r, x)
+
+            def fori_body(i, c):
+                x, extra = c
+                return iterate(params, r, x, extra)
+
+            x, _ = jax.lax.fori_loop(0, iters, fori_body, (x, extra))
+            return x
+
+        return apply
+
+    def make_smooth(self):
+        init = self._make_init()
+        iterate = self._make_iter()
+
+        def smooth(params, b, x, sweeps):
+            extra = init(params, b, x)
+            for _ in range(sweeps):
+                x, extra = iterate(params, b, x, extra)
+            return x
+
+        return smooth
+
+
+@register_solver("PCG")
+class PCGSolver(KrylovSolver):
+    """Preconditioned conjugate gradient (reference pcg_solver.cu)."""
+
+    def _make_init(self):
+        M = self._make_M()
+
+        def init(params, b, x):
+            A, Mp = params
+            r = b - spmv(A, x)
+            z = M(Mp, r)
+            p = z
+            rho = dot(r, z)
+            return (r, p, rho)
+
+        return init
+
+    def _make_iter(self):
+        M = self._make_M()
+
+        def iterate(params, b, x, extra):
+            A, Mp = params
+            r, p, rho = extra
+            q = spmv(A, p)
+            alpha = rho / dot(p, q)
+            x = x + alpha * p
+            r = r - alpha * q
+            z = M(Mp, r)
+            rho_new = dot(r, z)
+            beta = rho_new / rho
+            p = z + beta * p
+            return x, (r, p, rho_new)
+
+        return iterate
+
+
+@register_solver("CG")
+class CGSolver(PCGSolver):
+    """Unpreconditioned CG (reference cg_solver.cu)."""
+
+    uses_preconditioner = False
+
+
+@register_solver("PCGF")
+class PCGFSolver(KrylovSolver):
+    """Flexible PCG (reference pcgf_solver.cu): Polak-Ribiere beta
+    <z_new, r_new - r_old> / rho tolerates a changing preconditioner."""
+
+    def _make_init(self):
+        M = self._make_M()
+
+        def init(params, b, x):
+            A, Mp = params
+            r = b - spmv(A, x)
+            z = M(Mp, r)
+            p = z
+            rho = dot(r, z)
+            return (r, p, rho)
+
+        return init
+
+    def _make_iter(self):
+        M = self._make_M()
+
+        def iterate(params, b, x, extra):
+            A, Mp = params
+            r, p, rho = extra
+            q = spmv(A, p)
+            alpha = rho / dot(p, q)
+            x = x + alpha * p
+            r_new = r - alpha * q
+            z = M(Mp, r_new)
+            rho_new = dot(r_new, z)
+            beta = dot(z, r_new - r) / rho
+            p = z + beta * p
+            return x, (r_new, p, rho_new)
+
+        return iterate
+
+
+@register_solver("PBICGSTAB")
+class PBiCGStabSolver(KrylovSolver):
+    """Preconditioned BiCGStab (reference pbicgstab_solver.cu)."""
+
+    def _make_init(self):
+        def init(params, b, x):
+            A, Mp = params
+            r = b - spmv(A, x)
+            one = jnp.ones((), r.dtype)
+            zeros = jnp.zeros_like(r)
+            # (r, r0hat, p, v, rho, alpha, omega)
+            return (r, r, zeros, zeros, one, one, one)
+
+        return init
+
+    def _make_iter(self):
+        M = self._make_M()
+
+        def iterate(params, b, x, extra):
+            A, Mp = params
+            r, r0, p, v, rho, alpha, omega = extra
+            rho1 = dot(r0, r)
+            beta = (rho1 / rho) * (alpha / omega)
+            p = r + beta * (p - omega * v)
+            phat = M(Mp, p)
+            v = spmv(A, phat)
+            alpha = rho1 / dot(r0, v)
+            s = r - alpha * v
+            shat = M(Mp, s)
+            t = spmv(A, shat)
+            omega = dot(t, s) / dot(t, t)
+            x = x + alpha * phat + omega * shat
+            r = s - omega * t
+            return x, (r, r0, p, v, rho1, alpha, omega)
+
+        return iterate
+
+
+@register_solver("BICGSTAB")
+class BiCGStabSolver(PBiCGStabSolver):
+    """Unpreconditioned BiCGStab (reference bicgstab_solver.cu)."""
+
+    uses_preconditioner = False

@@ -1,0 +1,215 @@
+"""Solver convergence tests on generated Poisson systems (reference
+src/tests/fgmres_convergence_poisson.cu, scalar_smoother_poisson.cu,
+preconditioner_usage.cu)."""
+
+import numpy as np
+import pytest
+
+import amgx_tpu
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.io.poisson import poisson_2d_5pt, poisson_3d_7pt, poisson_rhs
+from amgx_tpu.solvers import create_solver
+from amgx_tpu.solvers.base import SUCCESS
+
+amgx_tpu.initialize()
+
+
+def _solve_cfg(cfg_text, A, b, scope="default"):
+    cfg = AMGConfig.from_string(cfg_text)
+    s = create_solver(cfg, scope)
+    s.setup(A)
+    return s, s.solve(b)
+
+
+def _check(A, res, b, tol=1e-5):
+    x = np.asarray(res.x)
+    r = b - A.to_scipy() @ x
+    assert int(res.status) == SUCCESS, f"status={int(res.status)}"
+    assert np.linalg.norm(r) / np.linalg.norm(b) < tol
+
+
+BASE = (
+    '{{"config_version": 2, "solver": {{"scope": "main", "solver": "{name}",'
+    ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+    ' "tolerance": 1e-06, "norm": "L2", "max_iters": {iters}'
+    ' {extra} }} }}'
+)
+
+
+def cfgs(name, iters=100, extra=""):
+    return BASE.format(name=name, iters=iters, extra=extra)
+
+
+@pytest.fixture(scope="module")
+def poisson2d():
+    A = poisson_2d_5pt(24)
+    b = poisson_rhs(A.n_rows)
+    return A, b
+
+
+@pytest.fixture(scope="module")
+def poisson3d():
+    A = poisson_3d_7pt(10)
+    b = poisson_rhs(A.n_rows)
+    return A, b
+
+
+# ---- the minimum end-to-end slice: PCG + Jacobi --------------------------
+
+
+def test_pcg_block_jacobi_poisson(poisson3d):
+    A, b = poisson3d
+    cfg_text = """
+    {"config_version": 2,
+     "solver": {"scope": "main", "solver": "PCG", "max_iters": 200,
+        "monitor_residual": 1, "convergence": "RELATIVE_INI",
+        "tolerance": 1e-08, "norm": "L2",
+        "preconditioner": {"scope": "jac", "solver": "BLOCK_JACOBI",
+                           "max_iters": 4, "monitor_residual": 0}}}
+    """
+    s, res = _solve_cfg(cfg_text, A, b)
+    _check(A, res, b, 1e-7)
+    # residual history is recorded and decreasing overall
+    hist = np.asarray(res.history)[: int(res.iters) + 1, 0]
+    assert hist[0] > hist[-1]
+
+
+def test_pcg_noprec_equals_cg(poisson2d):
+    A, b = poisson2d
+    _, r1 = _solve_cfg(
+        '{"config_version": 2, "solver": {"scope": "main", "solver": "PCG",'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        ' "tolerance": 1e-08, "max_iters": 300,'
+        ' "preconditioner": {"scope": "p", "solver": "NOSOLVER"}}}',
+        A,
+        b,
+    )
+    _, r2 = _solve_cfg(
+        cfgs("CG", 300).replace('"max_iters": 300', '"max_iters": 300,'
+                                ' "tolerance": 1e-08'),
+        A,
+        b,
+    )
+    assert int(r1.iters) == int(r2.iters)
+    np.testing.assert_allclose(
+        np.asarray(r1.x), np.asarray(r2.x), rtol=1e-10
+    )
+
+
+@pytest.mark.parametrize(
+    "name,iters",
+    [
+        ("CG", 300),
+        ("PBICGSTAB", 300),
+        ("BICGSTAB", 300),
+        ("FGMRES", 400),
+        ("GMRES", 400),
+    ],
+)
+def test_krylov_poisson(poisson2d, name, iters):
+    A, b = poisson2d
+    extra = ""
+    if "GMRES" in name:
+        extra = ', "gmres_n_restart": 20'
+        extra += ', "preconditioner": {"scope": "p", "solver": "NOSOLVER"}'
+    elif name in ("PBICGSTAB",):
+        extra = ', "preconditioner": {"scope": "p", "solver": "NOSOLVER"}'
+    s, res = _solve_cfg(cfgs(name, iters, extra), A, b)
+    _check(A, res, b)
+
+
+@pytest.mark.parametrize(
+    "smoother,iters",
+    [
+        ("BLOCK_JACOBI", 2000),
+        ("JACOBI_L1", 2000),
+        ("MULTICOLOR_GS", 800),
+        ("GS", 800),
+        ("MULTICOLOR_DILU", 800),
+        ("CHEBYSHEV", 300),
+    ],
+)
+def test_stationary_solvers_converge(smoother, iters):
+    A = poisson_2d_5pt(16)
+    b = poisson_rhs(A.n_rows)
+    extra = ', "relaxation_factor": 0.9'
+    if smoother == "JACOBI_L1":
+        extra = ', "relaxation_factor": 1.0'
+    s, res = _solve_cfg(cfgs(smoother, iters, extra), A, b, "default")
+    _check(A, res, b, 1e-5)
+
+
+def test_preconditioned_krylov_combos(poisson2d):
+    """PCG/PBiCGStab/FGMRES x {BLOCK_JACOBI, MULTICOLOR_DILU} — the
+    preconditioner_usage.cu matrix."""
+    A, b = poisson2d
+    for outer in ["PCG", "PBICGSTAB", "FGMRES"]:
+        for prec in ["BLOCK_JACOBI", "MULTICOLOR_DILU"]:
+            cfg_text = (
+                '{"config_version": 2, "solver": {"scope": "main",'
+                f' "solver": "{outer}", "monitor_residual": 1,'
+                ' "convergence": "RELATIVE_INI", "tolerance": 1e-06,'
+                ' "max_iters": 150, "gmres_n_restart": 20,'
+                ' "preconditioner": {"scope": "amg",'
+                f' "solver": "{prec}", "max_iters": 2,'
+                ' "monitor_residual": 0}}}'
+            )
+            s, res = _solve_cfg(cfg_text, A, b)
+            _check(A, res, b)
+
+
+def test_precond_speeds_up_pcg(poisson2d):
+    A, b = poisson2d
+    _, plain = _solve_cfg(cfgs("CG", 500, ', "tolerance": 1e-8'), A, b)
+    cfg_text = (
+        '{"config_version": 2, "solver": {"scope": "main", "solver": "PCG",'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        ' "tolerance": 1e-8, "max_iters": 500,'
+        ' "preconditioner": {"scope": "p", "solver": "MULTICOLOR_DILU",'
+        ' "max_iters": 1, "monitor_residual": 0}}}'
+    )
+    _, prec = _solve_cfg(cfg_text, A, b)
+    assert int(prec.iters) < int(plain.iters)
+
+
+def test_dense_lu_direct(poisson2d):
+    A, b = poisson2d
+    s, res = _solve_cfg(cfgs("DENSE_LU_SOLVER", 1), A, b)
+    x = np.asarray(res.x)
+    r = b - A.to_scipy() @ x
+    assert np.linalg.norm(r) / np.linalg.norm(b) < 1e-10
+
+
+def test_divergence_detection():
+    # -Laplacian is negative definite; plain Jacobi on it with a bad
+    # relaxation factor diverges -> status FAILED via rel_div_tolerance
+    A = poisson_2d_5pt(12)
+    import scipy.sparse as sps
+
+    sp = A.to_scipy() - 5.0 * sps.eye_array(A.n_rows)  # indefinite
+    from amgx_tpu.core.matrix import SparseMatrix
+
+    Ai = SparseMatrix.from_scipy(sp)
+    b = poisson_rhs(Ai.n_rows)
+    cfg_text = (
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "BLOCK_JACOBI", "monitor_residual": 1,'
+        ' "relaxation_factor": 1.9, "rel_div_tolerance": 100.0,'
+        ' "convergence": "RELATIVE_INI", "tolerance": 1e-10,'
+        ' "max_iters": 2000}}'
+    )
+    cfg = AMGConfig.from_string(cfg_text)
+    s = create_solver(cfg, "default")
+    s.setup(Ai)
+    res = s.solve(b)
+    assert int(res.status) == 1  # FAILED
+    assert int(res.iters) < 2000  # bailed early
+
+
+def test_absolute_convergence(poisson2d):
+    A, b = poisson2d
+    cfg_text = cfgs("CG", 400).replace(
+        '"convergence": "RELATIVE_INI"', '"convergence": "ABSOLUTE"'
+    )
+    s, res = _solve_cfg(cfg_text, A, b)
+    assert float(np.max(np.asarray(res.final_norm))) < 1e-6
