@@ -1,2 +1,9 @@
 """AMG hierarchy engine (reference src/amg.cu, src/amg_level.cu,
-src/cycles/, src/classical/, src/aggregation/)."""
+src/cycles/, src/classical/, src/aggregation/).
+
+Importing registers the "AMG" solver.
+"""
+
+from amgx_tpu.amg.hierarchy import AMGSolver, AMGLevel  # noqa: F401
+
+__all__ = ["AMGSolver", "AMGLevel"]

@@ -1,0 +1,152 @@
+"""Aggregation-AMG coarsening (reference src/aggregation/**, 11.4k LoC).
+
+Selectors SIZE_2/SIZE_4/SIZE_8 are pairwise-matching passes (the reference
+composes size-4/8 from repeated pairwise phases, size2_selector.cu /
+size8_selector.cu); MULTI_PAIRWISE generalizes to ``aggregation_passes``.
+Setup is host-side numpy/scipy (data-dependent shapes — the solve path
+never sees it); the deterministic greedy matching corresponds to the
+reference's determinism_flag=1 path.
+
+Edge weights (weight_formula 0, core.cu registration):
+    w_ij = 0.5*(|a_ij| + |a_ji|) / max(|a_ii|, |a_jj|)
+Prolongation is the binary aggregate map; R = P^T; A_c = R A P
+(coarse generators LOW_DEG/THRUST/HYBRID differ only in GPU kernel
+strategy — one scipy product here).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sps
+
+
+def edge_weights(Asp: sps.csr_matrix, formula: int = 0) -> sps.csr_matrix:
+    """Symmetric positive weight graph (zero diagonal)."""
+    n = Asp.shape[0]
+    absA = abs(Asp)
+    d = np.abs(Asp.diagonal())
+    d = np.where(d > 0, d, 1.0)
+    if formula == 1:
+        # w_ij = -0.5*(a_ij/a_ii + a_ji/a_jj)
+        Dinv = sps.diags_array(1.0 / np.where(Asp.diagonal() != 0,
+                                              Asp.diagonal(), 1.0))
+        W = -(Dinv @ Asp + (Dinv @ Asp).T) * 0.5
+        W = W.tocsr()
+        W.data = np.maximum(W.data, 0.0)
+    else:
+        S = (absA + absA.T) * 0.5
+        # divide each w_ij by max(d_i, d_j): do it entrywise
+        S = S.tocoo()
+        denom = np.maximum(d[S.row], d[S.col])
+        W = sps.csr_matrix(
+            (S.data / denom, (S.row, S.col)), shape=(n, n)
+        )
+    W.setdiag(0.0)
+    W.eliminate_zeros()
+    W.sort_indices()
+    return W
+
+
+def pairwise_match(W: sps.csr_matrix, merge_singletons: bool = True):
+    """One deterministic greedy pairwise matching pass.
+
+    Returns agg (n,) int32 aggregate ids, 0..n_agg-1.  Vertices pair with
+    their strongest unmatched neighbour (greedy in heavy-edge order);
+    leftover singletons merge into their strongest neighbour's aggregate
+    when merge_singletons (reference merge_singletons=1 default).
+    """
+    n = W.shape[0]
+    coo = W.tocoo()
+    mask = coo.row < coo.col
+    r, c, w = coo.row[mask], coo.col[mask], coo.data[mask]
+    # heavy-edge first; ties broken by (row, col) for determinism
+    order = np.lexsort((c, r, -w))
+    partner = np.full(n, -1, dtype=np.int64)
+    for k in order:
+        i, j = r[k], c[k]
+        if partner[i] == -1 and partner[j] == -1:
+            partner[i] = j
+            partner[j] = i
+    agg = np.full(n, -1, dtype=np.int64)
+    next_agg = 0
+    for i in range(n):
+        if agg[i] != -1:
+            continue
+        if partner[i] != -1:
+            agg[i] = agg[partner[i]] = next_agg
+            next_agg += 1
+        else:
+            agg[i] = next_agg
+            next_agg += 1
+    if merge_singletons:
+        # singletons (their own aggregate alone) join strongest neighbour
+        sizes = np.bincount(agg, minlength=next_agg)
+        indptr, indices, data = W.indptr, W.indices, W.data
+        for i in range(n):
+            if sizes[agg[i]] != 1:
+                continue
+            s, e = indptr[i], indptr[i + 1]
+            if s == e:
+                continue
+            nb = indices[s:e]
+            best = nb[np.argmax(data[s:e])]
+            sizes[agg[i]] -= 1
+            agg[i] = agg[best]
+            sizes[agg[best]] += 1
+        # compact ids
+        uniq, agg = np.unique(agg, return_inverse=True)
+    return agg.astype(np.int32)
+
+
+def aggregate(Asp: sps.csr_matrix, passes: int, formula: int = 0,
+              merge_singletons: bool = True) -> np.ndarray:
+    """Compose `passes` pairwise matchings -> aggregates of size ~2^passes
+    (reference SIZE_2=1, SIZE_4=2, SIZE_8=3 passes)."""
+    n = Asp.shape[0]
+    agg = np.arange(n, dtype=np.int32)
+    W = edge_weights(Asp, formula)
+    for p in range(passes):
+        sub = pairwise_match(W, merge_singletons)
+        agg = sub[agg]
+        if p + 1 < passes:
+            nc = int(sub.max()) + 1
+            Pb = sps.csr_matrix(
+                (np.ones(W.shape[0]), (np.arange(W.shape[0]), sub)),
+                shape=(W.shape[0], nc),
+            )
+            W = (Pb.T @ W @ Pb).tocsr()
+            W.setdiag(0.0)
+            W.eliminate_zeros()
+    return agg
+
+
+SELECTOR_PASSES = {
+    "SIZE_2": 1,
+    "SIZE_4": 2,
+    "SIZE_8": 3,
+    "MULTI_PAIRWISE": None,  # uses aggregation_passes config
+    "DUMMY": 1,
+}
+
+
+def build_aggregation_level(Asp, cfg, scope):
+    """Returns (P, R, A_coarse) scipy matrices for one aggregation level
+    (reference aggregation_amg_level.cu:238-371 R/P from aggregate map +
+    coarseAGenerator computeAOperator)."""
+    selector = str(cfg.get("selector", scope)).upper()
+    passes = SELECTOR_PASSES.get(selector, 1)
+    if passes is None:
+        passes = int(cfg.get("aggregation_passes", scope))
+    formula = int(cfg.get("weight_formula", scope))
+    merge = bool(cfg.get("merge_singletons", scope))
+    agg = aggregate(Asp, passes, formula, merge)
+    n = Asp.shape[0]
+    nc = int(agg.max()) + 1
+    P = sps.csr_matrix(
+        (np.ones(n, dtype=Asp.dtype), (np.arange(n), agg)), shape=(n, nc)
+    )
+    R = P.T.tocsr()
+    Ac = (R @ Asp @ P).tocsr()
+    Ac.sum_duplicates()
+    Ac.sort_indices()
+    return P, R, Ac

@@ -1,0 +1,259 @@
+"""Classical Ruge-Stuben coarsening (reference src/classical/**, 12k LoC):
+strength of connection (strength/ahat), PMIS/HMIS C/F selection
+(selectors/pmis.cu), direct distance-1 interpolation (interpolators/
+distance1.cu) with truncation, Galerkin RAP.
+
+Host-side setup (numpy/scipy) with deterministic hashes — the reference's
+determinism_flag path; D2/multipass interpolation and aggressive
+coarsening arrive with later milestones (D2 currently falls back to D1
+with a warning).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import scipy.sparse as sps
+
+
+def strength_ahat(Asp: sps.csr_matrix, theta: float, max_row_sum: float):
+    """Strong-connection mask S (csr bool) — AHAT default
+    (reference strength/ahat.cu): j strong for i iff
+    -a_ij >= theta * max_k(-a_ik); falls back to |a_ij| for rows with no
+    negative off-diagonals.  Rows whose row-sum ratio exceeds max_row_sum
+    get no strong connections (weakened dependencies, core.cu
+    'max_row_sum')."""
+    n = Asp.shape[0]
+    indptr, indices, data = Asp.indptr, Asp.indices, Asp.data
+    row_ids = np.repeat(np.arange(n), np.diff(indptr))
+    offdiag = indices != row_ids
+    neg = np.where(offdiag, -data, 0.0)
+    # per-row max of negative off-diagonals
+    mneg = np.zeros(n, data.dtype)
+    np.maximum.at(mneg, row_ids, neg)
+    mabs = np.zeros(n, data.dtype)
+    np.maximum.at(mabs, row_ids, np.where(offdiag, np.abs(data), 0.0))
+    use_abs = mneg <= 0
+    thresh = np.where(use_abs, mabs, mneg) * theta
+    val = np.where(use_abs[row_ids], np.abs(data), -data)
+    strong = offdiag & (val >= thresh[row_ids]) & (thresh[row_ids] > 0)
+
+    if max_row_sum < 1.0 + 1e-12:
+        diag = Asp.diagonal()
+        rs = np.asarray(np.abs(Asp.sum(axis=1))).ravel()
+        weak_rows = rs > max_row_sum * np.abs(np.where(diag != 0, diag, 1))
+        strong &= ~weak_rows[row_ids]
+
+    # copies: csr_matrix((data, indices, indptr)) shares the arrays, and
+    # eliminate_zeros() mutates them in place — must not corrupt Asp
+    S = sps.csr_matrix(
+        (strong.astype(np.int8), indices.copy(), indptr.copy()),
+        shape=(n, n),
+    )
+    S.eliminate_zeros()
+    return S
+
+
+def strength_all(Asp: sps.csr_matrix):
+    """ALL: every off-diagonal is strong (reference strength ALL)."""
+    n = Asp.shape[0]
+    S = Asp.copy().tocsr()
+    S.setdiag(0)
+    S.eliminate_zeros()
+    S.data = np.ones_like(S.data, dtype=np.int8)
+    return S
+
+
+def _hash_weights(n: int, seed: int = 0x9E3779B9) -> np.ndarray:
+    """Deterministic pseudo-random tie-break weights in [0,1)."""
+    idx = np.arange(n, dtype=np.uint64)
+    z = (idx + np.uint64(seed)) * np.uint64(0xBF58476D1CE4E5B9)
+    z ^= z >> np.uint64(31)
+    z *= np.uint64(0x94D049BB133111EB)
+    z ^= z >> np.uint64(29)
+    return (z % np.uint64(1 << 30)).astype(np.float64) / float(1 << 30)
+
+
+def pmis_select(S: sps.csr_matrix, deterministic: bool = True,
+                seed: int = 0) -> np.ndarray:
+    """PMIS C/F splitting (reference selectors/pmis.cu): parallel MIS on
+    the symmetrized strength graph with weights = strong-transpose-degree
+    + hash.  Returns int8 array: 1 = coarse, 0 = fine."""
+    n = S.shape[0]
+    Ssym = ((S + S.T) > 0).astype(np.int8).tocsr()
+    lam = np.asarray(S.T.sum(axis=1)).ravel().astype(np.float64)
+    rnd = _hash_weights(n, seed=0 if deterministic else seed)
+    w = lam + rnd
+    state = np.zeros(n, dtype=np.int8)  # 0 undecided, 1 C, -1 F
+    # isolated vertices (no strong links at all) become fine points handled
+    # by the interpolator as identity/zero rows
+    iso = np.asarray(Ssym.sum(axis=1)).ravel() == 0
+    state[iso] = 1  # isolated points must be coarse (nothing to interp from)
+    coo = Ssym.tocoo()
+    coo_row, coo_col = coo.row, coo.col
+    for _ in range(200):
+        und = state == 0
+        if not und.any():
+            break
+        # local max among undecided neighbours
+        wu = np.where(und, w, -1.0)
+        act = und[coo_row] & und[coo_col]
+        nbmax = np.full(n, -1.0)
+        np.maximum.at(nbmax, coo_row[act], wu[coo_col[act]])
+        new_c = und & (wu > nbmax)
+        state[new_c] = 1
+        # fine: undecided with a C neighbour
+        cnb = (Ssym @ (state == 1).astype(np.int8)) > 0
+        state[(state == 0) & cnb] = -1
+    state[state == 0] = 1  # leftovers become coarse
+    return (state == 1).astype(np.int8)
+
+
+def direct_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
+                         cf: np.ndarray) -> sps.csr_matrix:
+    """Distance-1 direct interpolation (reference interpolators/
+    distance1.cu; hypre-style sign-split weights):
+
+      C point i: P[i, cmap[i]] = 1
+      F point i: P[i, cmap[j]] = -alpha(beta) * a_ij / a~_ii over strong C
+                 neighbours j, with alpha = sum(neg a_i*)/sum(neg a_iC),
+                 beta for positive entries; positive sums fold into the
+                 diagonal when no positive C-connection exists.
+    """
+    n = Asp.shape[0]
+    cmap = np.cumsum(cf) - 1  # coarse index for C points
+    nc = int(cf.sum())
+    indptr, indices, data = Asp.indptr, Asp.indices, Asp.data
+    row_ids = np.repeat(np.arange(n), np.diff(indptr))
+    offd = indices != row_ids
+
+    # strong flag per A entry: membership of (i,j) in S's sparsity
+    Scoo = S.tocoo()
+    s_keys = Scoo.row.astype(np.int64) * n + Scoo.col
+    a_keys = row_ids.astype(np.int64) * n + indices
+    strong_flag = np.isin(a_keys, s_keys)
+
+    is_C_col = cf[indices] == 1
+    neg = data < 0
+    pos = offd & (data > 0)
+
+    sum_neg = np.zeros(n)
+    np.add.at(sum_neg, row_ids, np.where(offd & neg, data, 0.0))
+    sum_pos = np.zeros(n)
+    np.add.at(sum_pos, row_ids, np.where(pos, data, 0.0))
+    strongC = strong_flag & is_C_col
+    sum_negC = np.zeros(n)
+    np.add.at(sum_negC, row_ids, np.where(strongC & neg, data, 0.0))
+    sum_posC = np.zeros(n)
+    np.add.at(sum_posC, row_ids, np.where(strongC & pos, data, 0.0))
+
+    diag = Asp.diagonal().astype(np.float64).copy()
+    no_posC = sum_posC == 0
+    diag = diag + np.where(no_posC, sum_pos, 0.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        alpha = np.where(sum_negC != 0, sum_neg / sum_negC, 0.0)
+        beta = np.where(sum_posC != 0, sum_pos / sum_posC, 0.0)
+    diag = np.where(diag != 0, diag, 1.0)
+
+    keep = strongC & (cf[row_ids] == 0)
+    coef = np.where(data < 0, alpha[row_ids], beta[row_ids])
+    pvals = -coef * data / diag[row_ids]
+    rows_f = row_ids[keep]
+    cols_f = cmap[indices[keep]]
+    vals_f = pvals[keep]
+
+    rows_c = np.nonzero(cf == 1)[0]
+    cols_c = cmap[rows_c]
+    vals_c = np.ones(rows_c.shape[0])
+
+    P = sps.csr_matrix(
+        (
+            np.concatenate([vals_f, vals_c]),
+            (
+                np.concatenate([rows_f, rows_c]),
+                np.concatenate([cols_f, cols_c]),
+            ),
+        ),
+        shape=(n, nc),
+    )
+    P.sum_duplicates()
+    P.sort_indices()
+    return P
+
+
+def truncate_interp(P: sps.csr_matrix, trunc_factor: float,
+                    max_elements: int) -> sps.csr_matrix:
+    """Interpolation truncation (reference truncate.cu + interp_max_elements):
+    drop entries below trunc_factor*max|row| and/or keep the max_elements
+    largest per row; surviving entries are rescaled to preserve row sums."""
+    if (trunc_factor >= 1.0 and max_elements < 0) or P.nnz == 0:
+        return P
+    P = P.tocsr()
+    n = P.shape[0]
+    indptr, indices, data = P.indptr, P.indices, P.data
+    row_ids = np.repeat(np.arange(n), np.diff(indptr))
+    absd = np.abs(data)
+    keep = np.ones(len(data), dtype=bool)
+    if trunc_factor < 1.0:
+        rmax = np.zeros(n)
+        np.maximum.at(rmax, row_ids, absd)
+        keep &= absd >= trunc_factor * rmax[row_ids]
+    if max_elements >= 0:
+        # rank within row by descending magnitude (stable, deterministic)
+        order = np.lexsort((np.arange(len(data)), -absd, row_ids))
+        counts = np.diff(indptr)
+        rank = np.empty(len(data), dtype=np.int64)
+        rank[order] = np.arange(len(data)) - np.repeat(
+            np.concatenate([[0], np.cumsum(counts)[:-1]]), counts
+        )
+        keep &= rank < max_elements
+    rs_old = np.zeros(n)
+    np.add.at(rs_old, row_ids, data)
+    rs_new = np.zeros(n)
+    np.add.at(rs_new, row_ids, np.where(keep, data, 0.0))
+    scale = np.where(rs_new != 0, rs_old / np.where(rs_new != 0, rs_new, 1),
+                     1.0)
+    newdata = data * keep * scale[row_ids]
+    # copied arrays: eliminate_zeros mutates them and must not corrupt P
+    Pt = sps.csr_matrix(
+        (newdata, indices.copy(), indptr.copy()), shape=P.shape
+    )
+    Pt.eliminate_zeros()
+    Pt.sort_indices()
+    return Pt
+
+
+def build_classical_level(Asp, cfg, scope):
+    """One classical level: S -> C/F -> P -> R=P^T -> RAP (reference
+    classical_amg_level.cu:213-489)."""
+    theta = float(cfg.get("strength_threshold", scope))
+    max_row_sum = float(cfg.get("max_row_sum", scope))
+    strength = str(cfg.get("strength", scope)).upper()
+    selector = str(cfg.get("selector", scope)).upper()
+    interp = str(cfg.get("interpolator", scope)).upper()
+    deterministic = bool(cfg.get("determinism_flag", scope))
+    trunc = float(cfg.get("interp_truncation_factor", scope))
+    max_el = int(cfg.get("interp_max_elements", scope))
+
+    if strength == "ALL":
+        S = strength_all(Asp)
+    else:  # AHAT default; AFFINITY TBD
+        S = strength_ahat(Asp, theta, max_row_sum)
+
+    if selector not in ("PMIS", "HMIS", "AGGRESSIVE_PMIS",
+                        "AGGRESSIVE_HMIS", "RS", "CR", "DUMMY"):
+        warnings.warn(f"selector {selector}: using PMIS")
+    cf = pmis_select(S, deterministic)
+
+    if interp not in ("D1",):
+        warnings.warn(
+            f"interpolator {interp} not yet implemented; using D1"
+        )
+    P = direct_interpolation(Asp, S, cf)
+    P = truncate_interp(P, trunc, max_el)
+    R = P.T.tocsr()
+    Ac = (R @ Asp @ P).tocsr()
+    Ac.sum_duplicates()
+    Ac.sort_indices()
+    return P, R, Ac

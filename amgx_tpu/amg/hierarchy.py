@@ -1,0 +1,315 @@
+"""AMG driver: hierarchy setup loop, cycles, and the registered "AMG"
+solver (reference src/amg.cu setup loop :201-418, AMG_Level amg_level.h,
+cycles src/cycles/).
+
+TPU design: setup is host-side (scipy coarsening per level — shapes are
+data-dependent), producing a list of levels with static shapes; the solve
+path builds ONE jitted cycle function by Python recursion over the static
+level list, so a V-cycle with nested smoothers, restriction, prolongation
+and the dense coarse solve is a single XLA program.  Hierarchy rebuild =
+retrace; value-only updates reuse structure (reference
+structure_reuse_levels / replace_coefficients).
+
+Cycles: V, W, F (reference cycles/{v,w,f}_cycle.h); CG/CGF K-cycles TBD.
+W/F recursion is unrolled over levels (depth is small: ~log n).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import scipy.sparse as sps
+
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.solvers.base import Solver
+from amgx_tpu.solvers.registry import SolverRegistry, register_solver
+
+
+class AMGLevel:
+    """One hierarchy level (reference AMG_Level, amg_level.h:50)."""
+
+    def __init__(self, A: SparseMatrix, level_id: int):
+        self.A = A
+        self.level_id = level_id
+        self.P: SparseMatrix | None = None
+        self.R: SparseMatrix | None = None
+        self.smoother: Solver | None = None
+
+    @property
+    def n_rows(self):
+        return self.A.n_rows
+
+    @property
+    def nnz(self):
+        return self.A.nnz
+
+
+@register_solver("AMG")
+class AMGSolver(Solver):
+    """Algebraic multigrid as a Solver (reference
+    algebraic_multigrid_solver.cu + AMG<> driver amg.cu)."""
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        g = lambda k: cfg.get(k, scope)
+        self.algorithm = str(g("algorithm")).upper()
+        self.cycle_type = str(g("cycle")).upper()
+        self.max_levels = int(g("max_levels"))
+        self.min_coarse_rows = int(g("min_coarse_rows"))
+        self.min_fine_rows = int(g("min_fine_rows"))
+        self.presweeps = int(g("presweeps"))
+        self.postsweeps = int(g("postsweeps"))
+        self.finest_sweeps = int(g("finest_sweeps"))
+        self.coarsest_sweeps = int(g("coarsest_sweeps"))
+        self.dense_lu_num_rows = int(g("dense_lu_num_rows"))
+        self.dense_lu_max_rows = int(g("dense_lu_max_rows"))
+        self.print_grid_stats = bool(g("print_grid_stats"))
+        self.intensive_smoothing = bool(g("intensive_smoothing"))
+        if self.intensive_smoothing:
+            self.presweeps = max(self.presweeps, 4)
+            self.postsweeps = max(self.postsweeps, 4)
+            self.coarsest_sweeps = max(self.coarsest_sweeps, 8)
+        self.levels: list[AMGLevel] = []
+        self.coarse_solver: Solver | None = None
+
+    # ------------------------------------------------------------------
+    # setup (reference AMG_Setup::setup, amg.cu:147-418)
+
+    def _build_coarse(self, Asp):
+        if self.algorithm == "AGGREGATION":
+            from amgx_tpu.amg.aggregation import build_aggregation_level
+
+            return build_aggregation_level(Asp, self.cfg, self.scope)
+        if self.algorithm == "ENERGYMIN":
+            raise NotImplementedError("ENERGYMIN algorithm TBD")
+        from amgx_tpu.amg.classical import build_classical_level
+
+        return build_classical_level(Asp, self.cfg, self.scope)
+
+    def _make_smoother(self, A: SparseMatrix) -> Solver:
+        name, sscope = self.cfg.get_scoped("smoother", self.scope)
+        sm = SolverRegistry.get(name)(self.cfg, sscope)
+        sm.setup(A)
+        return sm
+
+    def _make_coarse_solver(self, A: SparseMatrix):
+        name, cscope = self.cfg.get_scoped("coarse_solver", self.scope)
+        if name == "NOSOLVER":
+            return None
+        if name in ("DENSE_LU_SOLVER", "DENSE_LU"):
+            # size guards (reference amg.cu:76-85): fall back to smoothing
+            # if the coarsest level ended up too large to densify
+            cap = self.dense_lu_max_rows or max(
+                self.dense_lu_num_rows, 4096
+            )
+            if A.n_rows > cap:
+                return None
+        cs = SolverRegistry.get(name)(self.cfg, cscope)
+        cs.setup(A)
+        return cs
+
+    def _setup_impl(self, A: SparseMatrix):
+        if A.block_size != 1:
+            raise NotImplementedError(
+                "AMG on block matrices: scalarize for now"
+            )
+        self.levels = [AMGLevel(A, 0)]
+        Asp = A.to_scipy()
+        while True:
+            lvl = self.levels[-1]
+            n = lvl.n_rows
+            if (
+                len(self.levels) >= self.max_levels
+                or n <= self.min_coarse_rows
+                or n <= self.min_fine_rows
+            ):
+                break
+            P, R, Ac = self._build_coarse(Asp)
+            nc = Ac.shape[0]
+            if nc >= n or nc == 0:  # coarsening stalled
+                break
+            dtype = np.asarray(lvl.A.values).dtype
+            lvl.P = SparseMatrix.from_scipy(P.astype(dtype))
+            lvl.R = SparseMatrix.from_scipy(R.astype(dtype))
+            Ac = Ac.astype(dtype)
+            self.levels.append(
+                AMGLevel(SparseMatrix.from_scipy(Ac), len(self.levels))
+            )
+            Asp = Ac
+
+        # smoothers on all but the coarsest; coarse solver on the last
+        for lvl in self.levels[:-1]:
+            lvl.smoother = self._make_smoother(lvl.A)
+        coarsest = self.levels[-1]
+        self.coarse_solver = self._make_coarse_solver(coarsest.A)
+        if self.coarse_solver is None and len(self.levels) > 0:
+            # coarsest-level smoothing fallback (coarse_solver=NOSOLVER)
+            coarsest.smoother = self._make_smoother(coarsest.A)
+
+        self._params = self._collect_params()
+        if self.print_grid_stats:
+            print(self.grid_stats())
+
+    def _collect_params(self):
+        per_level = []
+        for lvl in self.levels:
+            per_level.append(
+                (
+                    lvl.A,
+                    lvl.P,
+                    lvl.R,
+                    lvl.smoother.apply_params() if lvl.smoother else None,
+                )
+            )
+        coarse = (
+            self.coarse_solver.apply_params() if self.coarse_solver else None
+        )
+        return (tuple(per_level), coarse)
+
+    # ------------------------------------------------------------------
+    # cycles (reference fixed_cycle.cu FixedCycle::cycle)
+
+    # W/F cycles branch twice per level; full branching unrolls 2^depth
+    # coarse visits into the XLA program.  Branch only on the top levels
+    # (truncated gamma-cycle) to bound trace size; below that the walk
+    # degenerates to V, where the extra visits are numerically negligible
+    # (coarse solves are near-exact there anyway).
+    _W_MAX_BRANCH_LEVELS = 6
+
+    def _level_sweeps(self, lvl_id):
+        pre, post = self.presweeps, self.postsweeps
+        if lvl_id == 0 and self.finest_sweeps >= 0:
+            # reference fixed_cycle.cu:197-201: finest_sweeps overrides both
+            # sweep counts on the finest level (kept zero if configured zero)
+            pre = 0 if pre == 0 else self.finest_sweeps
+            post = 0 if post == 0 else self.finest_sweeps
+        return pre, post
+
+    def make_cycle(self):
+        """Pure fn(params, b, x) -> x : one multigrid cycle."""
+        n_levels = len(self.levels)
+        smooth_fns = [
+            lvl.smoother.make_smooth() if lvl.smoother else None
+            for lvl in self.levels
+        ]
+        coarse_apply = (
+            self.coarse_solver.make_apply() if self.coarse_solver else None
+        )
+        cycle_type = self.cycle_type
+
+        def cycle(params, b, x, lvl_id=0):
+            level_params, coarse_params = params
+            A, P, R, smp = level_params[lvl_id]
+            if lvl_id == n_levels - 1:
+                if coarse_apply is not None:
+                    # error-correction form is exact for direct solvers and
+                    # safe for nonzero x (reference launchCoarseSolver)
+                    return x + coarse_apply(coarse_params, b - spmv(A, x))
+                return smooth_fns[lvl_id](
+                    smp, b, x, self.coarsest_sweeps
+                )
+            pre, post = self._level_sweeps(lvl_id)
+            if pre > 0:
+                x = smooth_fns[lvl_id](smp, b, x, pre)
+            r = b - spmv(A, x)
+            bc = spmv(R, r)
+            xc = jnp.zeros(
+                (R.n_rows * R.block_size,), dtype=b.dtype
+            )
+            branch = lvl_id < min(
+                n_levels - 2, self._W_MAX_BRANCH_LEVELS
+            )
+            if cycle_type == "W" and branch:
+                xc = cycle(params, bc, xc, lvl_id + 1)
+                xc = cycle(params, bc, xc, lvl_id + 1)
+            elif cycle_type == "F" and branch:
+                xc = cycle(params, bc, xc, lvl_id + 1)
+                xc = _v_cycle(params, bc, xc, lvl_id + 1)
+            else:
+                xc = cycle(params, bc, xc, lvl_id + 1)
+            x = x + spmv(P, xc)
+            if post > 0:
+                x = smooth_fns[lvl_id](smp, b, x, post)
+            return x
+
+        def _v_cycle(params, b, x, lvl_id):
+            level_params, coarse_params = params
+            A, P, R, smp = level_params[lvl_id]
+            if lvl_id == n_levels - 1:
+                if coarse_apply is not None:
+                    return x + coarse_apply(coarse_params, b - spmv(A, x))
+                return smooth_fns[lvl_id](smp, b, x, self.coarsest_sweeps)
+            pre, post = self._level_sweeps(lvl_id)
+            if pre > 0:
+                x = smooth_fns[lvl_id](smp, b, x, pre)
+            r = b - spmv(A, x)
+            bc = spmv(R, r)
+            xc = jnp.zeros((R.n_rows * R.block_size,), dtype=b.dtype)
+            xc = _v_cycle(params, bc, xc, lvl_id + 1)
+            x = x + spmv(P, xc)
+            if post > 0:
+                x = smooth_fns[lvl_id](smp, b, x, post)
+            return x
+
+        return cycle
+
+    # ------------------------------------------------------------------
+    # Solver interface: one cycle per iteration (reference
+    # AlgebraicMultigrid_Solver::solve_iteration, amg.cu:1102-1117)
+
+    def operator_of(self, params):
+        level_params, _ = params
+        return level_params[0][0]  # finest-level A
+
+    def make_step(self):
+        cycle = self.make_cycle()
+
+        def step(params, b, x):
+            return cycle(params, b, x)
+
+        return step
+
+    # make_apply: inherited — base Solver composes make_smooth over
+    # make_step (= one cycle per iteration), matching the reference's
+    # AMG-preconditioner usage with max_iters cycles.
+
+    # ------------------------------------------------------------------
+
+    def grid_stats(self) -> str:
+        """Grid statistics table (reference AMG::printGridStatistics,
+        README.md:104-117 output contract)."""
+        rows = []
+        total_rows = total_nnz = 0
+        bytes_total = 0
+        for lvl in self.levels:
+            n, nnz = lvl.n_rows, lvl.nnz
+            total_rows += n
+            total_nnz += nnz
+            itemsize = np.dtype(
+                np.asarray(lvl.A.values).dtype
+            ).itemsize
+            bytes_total += nnz * (itemsize + 4) + 4 * (n + 1)
+            sp = nnz / (n * n) if n else 0.0
+            rows.append(
+                f"         {lvl.level_id:>5}(D)"
+                f" {n:>10} {nnz:>12} {sp:>10.3g}"
+                f" {nnz * itemsize / 2**30:>9.2e}"
+            )
+        fine = self.levels[0]
+        grid_cx = total_rows / fine.n_rows if fine.n_rows else 0
+        op_cx = total_nnz / fine.nnz if fine.nnz else 0
+        head = (
+            "         Number of Levels: %d\n" % len(self.levels)
+            + "            LVL         ROWS          NNZ    SPRSTY"
+            "       Mem (GB)\n"
+            + "         " + "-" * 56
+        )
+        tail = (
+            "         " + "-" * 56 + "\n"
+            f"         Grid Complexity: {grid_cx:.5g}\n"
+            f"         Operator Complexity: {op_cx:.5g}\n"
+            f"         Total Memory Usage: "
+            f"{bytes_total / 2**30:.6g} GB"
+        )
+        return "\n".join([head] + rows + [tail])

@@ -260,9 +260,11 @@ class SparseMatrix:
         import scipy.sparse as sps
 
         b = self.block_size
-        indptr = np.asarray(self.row_offsets)
-        indices = np.asarray(self.col_indices)
-        data = np.asarray(self.values)
+        # copies: jax device buffers are read-only and scipy mutates in
+        # place (sort_indices / eliminate_zeros)
+        indptr = np.array(self.row_offsets)
+        indices = np.array(self.col_indices)
+        data = np.array(self.values)
         if b == 1:
             return sps.csr_matrix(
                 (data, indices, indptr), shape=(self.n_rows, self.n_cols)
