@@ -47,54 +47,76 @@ def edge_weights(Asp: sps.csr_matrix, formula: int = 0) -> sps.csr_matrix:
     return W
 
 
-def pairwise_match(W: sps.csr_matrix, merge_singletons: bool = True):
-    """One deterministic greedy pairwise matching pass.
+def _first_per_row(rows_sorted, n):
+    """Index of the first occurrence of each row id in a row-sorted array;
+    -1 for absent rows."""
+    first = np.full(n, -1, dtype=np.int64)
+    uniq, idx = np.unique(rows_sorted, return_index=True)
+    first[uniq] = idx
+    return first
 
-    Returns agg (n,) int32 aggregate ids, 0..n_agg-1.  Vertices pair with
-    their strongest unmatched neighbour (greedy in heavy-edge order);
-    leftover singletons merge into their strongest neighbour's aggregate
-    when merge_singletons (reference merge_singletons=1 default).
+
+def pairwise_match(W: sps.csr_matrix, merge_singletons: bool = True,
+                   max_rounds: int = 15):
+    """Deterministic pairwise matching via mutual-strongest-neighbour
+    rounds (the handshaking scheme of the reference's size2 selector,
+    fully vectorized; max_rounds mirrors max_matching_iterations).
+
+    Returns agg (n,) int32 aggregate ids 0..n_agg-1.
     """
     n = W.shape[0]
     coo = W.tocoo()
-    mask = coo.row < coo.col
-    r, c, w = coo.row[mask], coo.col[mask], coo.data[mask]
-    # heavy-edge first; ties broken by (row, col) for determinism
-    order = np.lexsort((c, r, -w))
+    r, c, w = coo.row, coo.col, coo.data
+    # per-row preference: heavy edges first; ties broken by a symmetric
+    # per-edge hash (deterministic).  Without it, uniform-weight graphs
+    # (Poisson) deadlock the handshake into chains — the reference breaks
+    # ties with random edge weights for the same reason.
+    lo = np.minimum(r, c).astype(np.uint64)
+    hi = np.maximum(r, c).astype(np.uint64)
+    z = lo * np.uint64(n) + hi + np.uint64(0x9E3779B9)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    jitter = (z ^ (z >> np.uint64(31))).astype(np.float64)
+    order = np.lexsort((jitter, -w, r))
+    rs, cs = r[order], c[order]
+
     partner = np.full(n, -1, dtype=np.int64)
-    for k in order:
-        i, j = r[k], c[k]
-        if partner[i] == -1 and partner[j] == -1:
-            partner[i] = j
-            partner[j] = i
-    agg = np.full(n, -1, dtype=np.int64)
-    next_agg = 0
-    for i in range(n):
-        if agg[i] != -1:
-            continue
-        if partner[i] != -1:
-            agg[i] = agg[partner[i]] = next_agg
-            next_agg += 1
-        else:
-            agg[i] = next_agg
-            next_agg += 1
+    for _ in range(max_rounds):
+        un = partner == -1
+        valid = un[rs] & un[cs]
+        first = _first_per_row(rs[valid], n)
+        # strongest available neighbour per unmatched vertex
+        cand = np.full(n, -1, dtype=np.int64)
+        has = first >= 0
+        cand[has] = cs[valid][first[has]]
+        # mutual handshake
+        ok = (cand >= 0) & un
+        idx = np.nonzero(ok)[0]
+        mutual = idx[cand[cand[idx]] == idx]
+        a = mutual[mutual < cand[mutual]]
+        partner[a] = cand[a]
+        partner[cand[a]] = a
+        if a.size == 0:
+            break
+
+    # aggregate ids: pair root = min(i, partner); singletons own id
+    root = np.where(partner >= 0, np.minimum(np.arange(n), partner),
+                    np.arange(n))
+    uniq, agg = np.unique(root, return_inverse=True)
+
     if merge_singletons:
-        # singletons (their own aggregate alone) join strongest neighbour
-        sizes = np.bincount(agg, minlength=next_agg)
-        indptr, indices, data = W.indptr, W.indices, W.data
-        for i in range(n):
-            if sizes[agg[i]] != 1:
-                continue
-            s, e = indptr[i], indptr[i + 1]
-            if s == e:
-                continue
-            nb = indices[s:e]
-            best = nb[np.argmax(data[s:e])]
-            sizes[agg[i]] -= 1
-            agg[i] = agg[best]
-            sizes[agg[best]] += 1
-        # compact ids
-        uniq, agg = np.unique(agg, return_inverse=True)
+        sizes = np.bincount(agg)
+        is_single = sizes[agg] == 1
+        if is_single.any():
+            # strongest neighbour regardless of matching state
+            first_all = _first_per_row(rs, n)
+            best = np.full(n, -1, dtype=np.int64)
+            hasn = first_all >= 0
+            best[hasn] = cs[first_all[hasn]]
+            move = is_single & (best >= 0)
+            agg = agg.copy()
+            agg[move] = agg[best[move]]
+            uniq2, agg = np.unique(agg, return_inverse=True)
     return agg.astype(np.int32)
 
 

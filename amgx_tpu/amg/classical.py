@@ -37,7 +37,9 @@ def strength_ahat(Asp: sps.csr_matrix, theta: float, max_row_sum: float):
     use_abs = mneg <= 0
     thresh = np.where(use_abs, mabs, mneg) * theta
     val = np.where(use_abs[row_ids], np.abs(data), -data)
-    strong = offdiag & (val >= thresh[row_ids]) & (thresh[row_ids] > 0)
+    # val > 0 (not thresh > 0) so theta = 0 means "all opposite-sign
+    # connections strong" (reference strength_base.cu strict comparison)
+    strong = offdiag & (val >= thresh[row_ids]) & (val > 0)
 
     if max_row_sum < 1.0 + 1e-12:
         diag = Asp.diagonal()

@@ -98,12 +98,9 @@ class AMGSolver(Solver):
         if name == "NOSOLVER":
             return None
         if name in ("DENSE_LU_SOLVER", "DENSE_LU"):
-            # size guards (reference amg.cu:76-85): fall back to smoothing
-            # if the coarsest level ended up too large to densify
-            cap = self.dense_lu_max_rows or max(
-                self.dense_lu_num_rows, 4096
-            )
-            if A.n_rows > cap:
+            # reference amg.cu:211: the max-rows cap applies only when
+            # dense_lu_max_rows != 0
+            if 0 < self.dense_lu_max_rows < A.n_rows:
                 return None
         cs = SolverRegistry.get(name)(self.cfg, cscope)
         cs.setup(A)
@@ -116,12 +113,18 @@ class AMGSolver(Solver):
             )
         self.levels = [AMGLevel(A, 0)]
         Asp = A.to_scipy()
+        # reference amg.cu:207-230: when the coarse solver is dense LU,
+        # coarsening stops once the level fits the dense trigger size
+        coarse_name, _ = self.cfg.get_scoped("coarse_solver", self.scope)
+        stop_rows = self.min_coarse_rows
+        if coarse_name in ("DENSE_LU_SOLVER", "DENSE_LU"):
+            stop_rows = max(stop_rows, self.dense_lu_num_rows)
         while True:
             lvl = self.levels[-1]
             n = lvl.n_rows
             if (
                 len(self.levels) >= self.max_levels
-                or n <= self.min_coarse_rows
+                or n <= stop_rows
                 or n <= self.min_fine_rows
             ):
                 break
@@ -129,7 +132,7 @@ class AMGSolver(Solver):
             nc = Ac.shape[0]
             if nc >= n or nc == 0:  # coarsening stalled
                 break
-            dtype = np.asarray(lvl.A.values).dtype
+            dtype = lvl.A.values.dtype
             lvl.P = SparseMatrix.from_scipy(P.astype(dtype))
             lvl.R = SparseMatrix.from_scipy(R.astype(dtype))
             Ac = Ac.astype(dtype)
@@ -286,9 +289,7 @@ class AMGSolver(Solver):
             n, nnz = lvl.n_rows, lvl.nnz
             total_rows += n
             total_nnz += nnz
-            itemsize = np.dtype(
-                np.asarray(lvl.A.values).dtype
-            ).itemsize
+            itemsize = np.dtype(lvl.A.values.dtype).itemsize
             bytes_total += nnz * (itemsize + 4) + 4 * (n + 1)
             sp = nnz / (n * n) if n else 0.0
             rows.append(

@@ -38,6 +38,12 @@ from amgx_tpu.core.types import ViewType
 _ELL_MAX_OVERHEAD = 4.0
 # Hard cap on ELL row width regardless of overhead.
 _ELL_MAX_WIDTH = 128
+# DIA (diagonal) acceleration structure: built when the matrix has few
+# distinct diagonals and acceptable padding.  DIA SpMV is shift+FMA — no
+# gather — which is the fast path on TPU (XLA gathers are slow; stencil
+# matrices like Poisson 5/7/27-pt are pure DIA).
+_DIA_MAX_DIAGS = 48
+_DIA_MAX_OVERHEAD = 2.0
 
 
 def _static_field(**kw):
@@ -74,10 +80,13 @@ class SparseMatrix:
     diag: jnp.ndarray
     ell_cols: Optional[jnp.ndarray]
     ell_vals: Optional[jnp.ndarray]
+    # DIA structure: dia_vals[k, i] = A[i, i + dia_offsets[k]] (0 outside)
+    dia_vals: Optional[jnp.ndarray] = None
 
     n_rows: int = _static_field(default=0)
     n_cols: int = _static_field(default=0)
     block_size: int = _static_field(default=1)
+    dia_offsets: Any = _static_field(default=None)  # tuple[int] | None
     # Static view windows: {ViewType: (row_offset, num_rows)}; populated by the
     # distributed manager.  Single-device matrices map every view to (0, n).
     views: Any = _static_field(default=None)
@@ -105,6 +114,10 @@ class SparseMatrix:
         return self.ell_cols is not None
 
     @property
+    def has_dia(self) -> bool:
+        return self.dia_offsets is not None
+
+    @property
     def is_square(self) -> bool:
         return self.n_rows == self.n_cols
 
@@ -130,6 +143,10 @@ class SparseMatrix:
         if self.has_ell:
             ell_vals = _scatter_ell_vals(self, values)
             new = dataclasses.replace(new, ell_vals=ell_vals)
+        if self.has_dia:
+            new = dataclasses.replace(
+                new, dia_vals=_scatter_dia_vals(self, values)
+            )
         return new
 
     def astype(self, dtype) -> "SparseMatrix":
@@ -138,6 +155,8 @@ class SparseMatrix:
         )
         if self.has_ell:
             rep["ell_vals"] = self.ell_vals.astype(dtype)
+        if self.has_dia:
+            rep["dia_vals"] = self.dia_vals.astype(dtype)
         return dataclasses.replace(self, **rep)
 
     # ---- host conversions ----------------------------------------------
@@ -176,8 +195,14 @@ class SparseMatrix:
         row_ids = np.repeat(np.arange(n_rows, dtype=np.int32), row_lens)
         diag = _extract_diag_np(row_offsets, col_indices, values, n_rows, b)
 
+        dia_offsets = dia_vals = None
+        if b == 1 and n_rows == n_cols and nnz:
+            dia_offsets, dia_vals = _try_build_dia_np(
+                row_offsets, col_indices, values, row_ids, n_rows
+            )
+
         ell_cols = ell_vals = None
-        if build_ell and n_rows > 0:
+        if build_ell and n_rows > 0 and dia_offsets is None:
             w = int(row_lens.max()) if nnz else 0
             if w <= _ELL_MAX_WIDTH and w * n_rows <= _ELL_MAX_OVERHEAD * max(
                 nnz, 1
@@ -195,9 +220,11 @@ class SparseMatrix:
             diag=dev(diag),
             ell_cols=None if ell_cols is None else dev(ell_cols),
             ell_vals=None if ell_vals is None else dev(ell_vals),
+            dia_vals=None if dia_vals is None else dev(dia_vals),
             n_rows=int(n_rows),
             n_cols=int(n_cols),
             block_size=int(b),
+            dia_offsets=dia_offsets,
             views=views,
             partition=partition,
         )
@@ -310,6 +337,23 @@ def _build_ell_np(row_offsets, col_indices, values, n_rows, w, b):
     return ell_cols, ell_vals
 
 
+def _try_build_dia_np(row_offsets, col_indices, values, row_ids, n):
+    """DIA structure if few distinct diagonals with acceptable padding."""
+    offs = col_indices.astype(np.int64) - row_ids.astype(np.int64)
+    uniq = np.unique(offs)
+    if uniq.shape[0] > _DIA_MAX_DIAGS:
+        return None, None
+    nnz = col_indices.shape[0]
+    if uniq.shape[0] * n > _DIA_MAX_OVERHEAD * nnz:
+        return None, None
+    dia_vals = np.zeros((uniq.shape[0], n), dtype=values.dtype)
+    k = np.searchsorted(uniq, offs)
+    # add (not assign): duplicate (row,col) entries must sum, matching the
+    # ELL/segment-sum SpMV paths
+    np.add.at(dia_vals, (k, row_ids), values)
+    return tuple(int(o) for o in uniq), dia_vals
+
+
 def _extract_diag_jnp(A: SparseMatrix, values):
     """Traced diagonal extraction for replace_values."""
     is_diag = A.col_indices == A.row_ids
@@ -319,6 +363,16 @@ def _extract_diag_jnp(A: SparseMatrix, values):
     return jax.ops.segment_sum(
         contrib, A.row_ids, num_segments=A.n_rows, indices_are_sorted=True
     )
+
+
+def _scatter_dia_vals(A: SparseMatrix, values):
+    """Rebuild dia_vals from updated CSR values (traced)."""
+    offs = A.col_indices.astype(jnp.int64) - A.row_ids.astype(jnp.int64)
+    uniq = jnp.asarray(A.dia_offsets, dtype=jnp.int64)
+    k = jnp.searchsorted(uniq, offs)
+    flat_idx = k * A.n_rows + A.row_ids
+    out = jnp.zeros((len(A.dia_offsets) * A.n_rows,), values.dtype)
+    return out.at[flat_idx].add(values).reshape(A.dia_vals.shape)
 
 
 def _scatter_ell_vals(A: SparseMatrix, values):

@@ -1,0 +1,23 @@
+"""Distributed layer: SPMD domain decomposition over a jax.sharding.Mesh.
+
+Replaces the reference's MPI machinery (src/distributed/: DistributedManager
+B2L maps, CommsMPIHostBufferStream halo exchange, global_reduce) with ICI
+collectives: the boundary->local (B2L) gather + ``all_gather`` pool +
+halo gather replaces point-to-point halo exchange; ``psum`` replaces
+MPI_Allreduce for dots/norms.  One SPMD code path for 1..N chips.
+"""
+
+from amgx_tpu.distributed.partition import DistributedMatrix, partition_matrix
+from amgx_tpu.distributed.solve import (
+    dist_cg,
+    dist_pcg_jacobi,
+    dist_spmv_replicated_check,
+)
+
+__all__ = [
+    "DistributedMatrix",
+    "partition_matrix",
+    "dist_cg",
+    "dist_pcg_jacobi",
+    "dist_spmv_replicated_check",
+]

@@ -1,0 +1,140 @@
+"""Distributed SpMV and Krylov solves under shard_map.
+
+The solve loop runs entirely inside ``shard_map`` over a 1-axis device
+mesh: halo exchange is B2L-gather -> ``all_gather`` -> halo-gather
+(reference exchange_halo, comms_mpi_hostbuffer_stream.cu), reductions are
+``psum`` (reference global_reduce).  The while_loop condition uses the
+psum'd scalar, identical on every shard — standard SPMD.
+
+This is the distributed minimum slice (Krylov + Jacobi); the distributed
+AMG hierarchy (coarse-level RAP exchange, consolidation onto sub-meshes)
+builds on the same primitives in a later milestone.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from amgx_tpu.distributed.partition import DistributedMatrix
+
+
+def _shard_params(A: DistributedMatrix):
+    """The traced per-shard arrays, stacked on the shard axis."""
+    return (
+        jnp.asarray(A.ell_cols),
+        jnp.asarray(A.ell_vals),
+        jnp.asarray(A.diag),
+        jnp.asarray(A.send_idx),
+        jnp.asarray(A.halo_src_part),
+        jnp.asarray(A.halo_src_pos),
+    )
+
+
+def _local_spmv(shard, x_loc, axis):
+    """y_loc = (A x)_loc with halo exchange over `axis`."""
+    ell_cols, ell_vals, diag, send_idx, hsp, hpos = shard
+    send = x_loc[send_idx]  # B2L gather
+    pool = jax.lax.all_gather(send, axis)  # [N, max_send] over ICI
+    halo = pool[hsp, hpos]  # [max_halo]
+    xf = jnp.concatenate([x_loc, halo])
+    return jnp.sum(ell_vals * xf[ell_cols], axis=1)
+
+
+def _pdot(a, b, axis):
+    return jax.lax.psum(jnp.dot(a, b), axis)
+
+
+def _make_dist_solver(preconditioned: bool):
+    """Builds the shard-local PCG body (Jacobi-preconditioned or plain)."""
+
+    def local_solve(shard, b_loc, max_iters, tol, axis):
+        ell_cols, ell_vals, diag, *_ = shard
+        dinv = jnp.where(diag != 0, 1.0 / diag, 1.0)
+        x = jnp.zeros_like(b_loc)
+        r = b_loc  # x0 = 0
+        z = dinv * r if preconditioned else r
+        p = z
+        rho = _pdot(r, z, axis)
+        nrm0 = jnp.sqrt(_pdot(b_loc, b_loc, axis))
+
+        def cond(c):
+            it, x, r, p, rho, nrm = c
+            return (it < max_iters) & (nrm >= tol * nrm0) & (nrm0 > 0)
+
+        def body(c):
+            it, x, r, p, rho, nrm = c
+            q = _local_spmv(shard, p, axis)
+            alpha = rho / _pdot(p, q, axis)
+            x = x + alpha * p
+            r = r - alpha * q
+            z = dinv * r if preconditioned else r
+            rho_new = _pdot(r, z, axis)
+            p = z + (rho_new / rho) * p
+            nrm = jnp.sqrt(_pdot(r, r, axis))
+            return (it + 1, x, r, p, rho_new, nrm)
+
+        it, x, r, p, rho, nrm = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), x, r, p, rho, nrm0)
+        )
+        return x, it, nrm
+
+    return local_solve
+
+
+def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
+    axis = mesh.axis_names[0]
+    shard = _shard_params(A)
+    bp = jnp.asarray(A.pad_vector(b_global))
+    local = _make_dist_solver(preconditioned)
+
+    in_shard = tuple(P(axis) for _ in shard)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(in_shard, P(axis)),
+        out_specs=(P(axis), P(), P()),
+    )
+    def solve_sm(shard_stk, b_stk):
+        shard_loc = tuple(s[0] for s in shard_stk)  # drop unit shard axis
+        x, it, nrm = local(shard_loc, b_stk[0], max_iters, tol, axis)
+        return x[None], it, nrm
+
+    x, it, nrm = jax.jit(solve_sm)(shard, bp)
+    return A.unpad_vector(jax.device_get(x)), int(it), float(nrm)
+
+
+def dist_pcg_jacobi(A: DistributedMatrix, b, mesh: Mesh, max_iters=200,
+                    tol=1e-8):
+    """Distributed Jacobi-PCG: returns (x, iters, final_norm)."""
+    return _run_dist_solve(A, b, mesh, max_iters, tol, True)
+
+
+def dist_cg(A: DistributedMatrix, b, mesh: Mesh, max_iters=200, tol=1e-8):
+    return _run_dist_solve(A, b, mesh, max_iters, tol, False)
+
+
+def dist_spmv_replicated_check(A: DistributedMatrix, x, mesh: Mesh):
+    """y = A x through the distributed path (for validation against the
+    single-device SpMV — the distributed_io test pattern, SURVEY §4)."""
+    axis = mesh.axis_names[0]
+    shard = _shard_params(A)
+    xp = jnp.asarray(A.pad_vector(x))
+    in_shard = tuple(P(axis) for _ in shard)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(in_shard, P(axis)),
+        out_specs=P(axis),
+    )
+    def spmv_sm(shard_stk, x_stk):
+        shard_loc = tuple(s[0] for s in shard_stk)
+        return _local_spmv(shard_loc, x_stk[0], axis)[None]
+
+    y = jax.jit(spmv_sm)(shard, xp)
+    return A.unpad_vector(jax.device_get(y))

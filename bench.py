@@ -1,0 +1,96 @@
+"""Benchmark: SpMV GFLOPS/chip on the 3D Poisson-7pt operator
+(BASELINE.json "metric": SpMV GFLOPS/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Diagnostics go to stderr.
+
+Methodology: dependent SpMV chains x_{k+1} = 0.125*A x_k + x_0 (bounded,
+no reductions) of two lengths; GFLOPS from the MARGINAL per-iteration
+cost so fixed dispatch/tunnel overhead (~170 ms on the axon remote
+backend) does not contaminate the kernel number.
+
+vs_baseline: ratio against a nominal A100 CSR-SpMV throughput of 200
+GFLOPS fp32 (memory-bound estimate at ~2 TB/s HBM, ~8 bytes/nnz,
+cuSPARSE-class; the reference publishes no in-repo numbers, BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+A100_SPMV_GFLOPS_F32 = 200.0
+
+
+def _chain(iters):
+    import jax
+    import jax.numpy as jnp
+
+    from amgx_tpu.ops.spmv import spmv
+
+    @jax.jit
+    def chain(A, x0):
+        def body(i, x):
+            return spmv(A, x) * np.float32(0.125) + x0
+
+        return jax.lax.fori_loop(0, iters, body, x0)
+
+    return chain
+
+
+def _time_chain(fn, A, n, rng, reps=3):
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    jax.device_get(fn(A, x))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        x = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+        t0 = time.perf_counter()
+        jax.device_get(fn(A, x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+
+    from amgx_tpu.io.poisson import poisson_3d_7pt
+
+    dev = jax.devices()[0]
+    n_side = 96 if dev.platform != "cpu" else 48
+    A = poisson_3d_7pt(n_side, dtype=np.float32)
+    n, nnz = A.n_rows, A.nnz
+    print(
+        f"bench: device={dev}, poisson {n_side}^3 f32, "
+        f"format={'DIA' if A.has_dia else ('ELL' if A.has_ell else 'CSR')}",
+        file=sys.stderr,
+    )
+
+    rng = np.random.default_rng(0)
+    n1, n2 = 20, 120
+    t1 = _time_chain(_chain(n1), A, n, rng)
+    t2 = _time_chain(_chain(n2), A, n, rng)
+    per_iter = max((t2 - t1) / (n2 - n1), 1e-9)
+    gflops = 2.0 * nnz / per_iter / 1e9
+    print(
+        f"bench: chains {n1}:{t1*1e3:.1f}ms {n2}:{t2*1e3:.1f}ms -> "
+        f"{per_iter*1e3:.3f} ms/SpMV",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "spmv_gflops_per_chip",
+                "value": round(gflops, 2),
+                "unit": "GFLOPS",
+                "vs_baseline": round(gflops / A100_SPMV_GFLOPS_F32, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
