@@ -4,9 +4,9 @@ strength of connection (strength/ahat), PMIS/HMIS C/F selection
 distance1.cu) with truncation, Galerkin RAP.
 
 Host-side setup (numpy/scipy) with deterministic hashes — the reference's
-determinism_flag path; D2/multipass interpolation and aggressive
-coarsening arrive with later milestones (D2 currently falls back to D1
-with a warning).
+determinism_flag path.  Interpolators: D1 (direct) and D2 (standard,
+distance-2); unknown interpolator names fall back to D2 with a warning.
+Aggressive coarsening and true multipass interpolation are still pending.
 """
 
 from __future__ import annotations
@@ -184,6 +184,105 @@ def direct_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
     return P
 
 
+def standard_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
+                           cf: np.ndarray) -> sps.csr_matrix:
+    """Distance-2 'standard' interpolation (reference interpolators/
+    distance2.cu; hypre BoomerAMG standard-interpolation formulation,
+    M-matrix form):
+
+      F point i, interpolatory set C_i^ext = C_i ∪ (∪_{k in F_i^s} C_k):
+        w_ij = -( a_ij 1[j in C_i] +
+                  sum_{k in F_i^s} a_ik * a_kj / d_ik ) / ã_ii
+        d_ik = sum_{l in C_i^ext} a_kl      (redistribution denominator)
+        ã_ii = a_ii + sum over weak neighbours a_ik
+               + a_ik for F-strong k with d_ik = 0 (undistributable)
+
+    Vectorized in sparse matrix algebra: the pair-dependent denominators
+    d_ik are entries of (A_FC_ext @ T^T) sampled on the S_FF pattern.
+    """
+    n = Asp.shape[0]
+    fmask = cf == 0
+    cmask = cf == 1
+    nc = int(cmask.sum())
+    cmap = np.cumsum(cf) - 1
+
+    fidx = np.nonzero(fmask)[0]
+    nf = fidx.shape[0]
+    if nf == 0:
+        return sps.eye_array(n, format="csr")[:, cmask].tocsr()
+
+    # strong pattern restricted to A's values
+    Sb = S.astype(bool)
+    A_strong = Asp.multiply(Sb).tocsr()
+
+    # selector matrices
+    If = sps.csr_matrix(
+        (np.ones(nf), (np.arange(nf), fidx)), shape=(nf, n)
+    )
+    cidx = np.nonzero(cmask)[0]
+    Ic = sps.csr_matrix(
+        (np.ones(nc), (cidx, np.arange(nc))), shape=(n, nc)
+    )
+
+    AsFC = (If @ A_strong @ Ic).tocsr()          # strong F->C values
+    AsFF = (If @ A_strong @ If.T).tocsr()        # strong F->F values
+    AsFF.setdiag(0.0)
+    AsFF.eliminate_zeros()
+    A_FC = (If @ Asp @ Ic).tocsr()               # all F->C values
+
+    # extended pattern T (binary): C_i ∪ C(F_i^s)
+    SFCb = (AsFC != 0).astype(np.float64)
+    SFFb = (AsFF != 0).astype(np.float64)
+    T = ((SFCb + SFFb @ SFCb) != 0).astype(np.float64).tocsr()
+
+    # denominators d_ik on the S_FF pattern: row k of A_FC dotted with
+    # T row i  ->  sample E = (A_FC @ T^T)^T at S_FF entries
+    E = (T @ A_FC.T).tocsr()                     # E[i,k] = d_ik
+    D = SFFb.multiply(E).tocsr()                 # masked to F_i^s edges
+
+    sff = AsFF.tocoo()
+    # align D entries with AsFF entries via dense-keyed lookup on rows
+    Dcsr = D.tocsr()
+    d_vals = np.asarray(Dcsr[sff.row, sff.col]).ravel()
+    with np.errstate(divide="ignore", invalid="ignore"):
+        b_vals = np.where(d_vals != 0, sff.data / d_vals, 0.0)
+    B = sps.csr_matrix((b_vals, (sff.row, sff.col)), shape=(nf, nf))
+
+    # numerator: (A^s_FC + B @ A_FC) masked to the extended pattern
+    Wnum = (AsFC + B @ A_FC).multiply(T).tocsr()
+
+    # modified diagonal: a_ii + weak row sum + undistributable strong F
+    diag = Asp.diagonal()[fidx]
+    row_total = np.asarray(Asp.sum(axis=1)).ravel()[fidx] - Asp.diagonal()[
+        fidx
+    ]
+    strong_sum = np.asarray(AsFC.sum(axis=1)).ravel() + np.asarray(
+        AsFF.sum(axis=1)
+    ).ravel()
+    weak_sum = row_total - strong_sum
+    undistributable = np.asarray(
+        sps.csr_matrix(
+            (np.where(d_vals == 0, sff.data, 0.0), (sff.row, sff.col)),
+            shape=(nf, nf),
+        ).sum(axis=1)
+    ).ravel()
+    atil = diag + weak_sum + undistributable
+    atil = np.where(atil != 0, atil, 1.0)
+
+    # scale rows of Wnum by -1/atil
+    Wnum = sps.diags_array(-1.0 / atil) @ Wnum
+
+    # assemble P: C rows identity, F rows = Wnum
+    Wcoo = Wnum.tocoo()
+    rows = np.concatenate([fidx[Wcoo.row], cidx])
+    cols = np.concatenate([Wcoo.col, cmap[cidx]])
+    vals = np.concatenate([Wcoo.data, np.ones(nc)])
+    P = sps.csr_matrix((vals, (rows, cols)), shape=(n, nc))
+    P.sum_duplicates()
+    P.sort_indices()
+    return P
+
+
 def truncate_interp(P: sps.csr_matrix, trunc_factor: float,
                     max_elements: int) -> sps.csr_matrix:
     """Interpolation truncation (reference truncate.cu + interp_max_elements):
@@ -248,11 +347,15 @@ def build_classical_level(Asp, cfg, scope):
         warnings.warn(f"selector {selector}: using PMIS")
     cf = pmis_select(S, deterministic)
 
-    if interp not in ("D1",):
+    if interp == "D1":
+        P = direct_interpolation(Asp, S, cf)
+    elif interp in ("D2", "STD", "STANDARD"):
+        P = standard_interpolation(Asp, S, cf)
+    else:
         warnings.warn(
-            f"interpolator {interp} not yet implemented; using D1"
+            f"interpolator {interp} not yet implemented; using D2 standard"
         )
-    P = direct_interpolation(Asp, S, cf)
+        P = standard_interpolation(Asp, S, cf)
     P = truncate_interp(P, trunc, max_el)
     R = P.T.tocsr()
     Ac = (R @ Asp @ P).tocsr()

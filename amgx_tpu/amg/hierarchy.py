@@ -10,8 +10,9 @@ and the dense coarse solve is a single XLA program.  Hierarchy rebuild =
 retrace; value-only updates reuse structure (reference
 structure_reuse_levels / replace_coefficients).
 
-Cycles: V, W, F (reference cycles/{v,w,f}_cycle.h); CG/CGF K-cycles TBD.
-W/F recursion is unrolled over levels (depth is small: ~log n).
+Cycles: V, W, F and CG/CGF K-cycles (reference cycles/).  Branching
+cycles (W/F/K) are truncated below _W_MAX_BRANCH_LEVELS to bound the
+unrolled program size.
 """
 
 from __future__ import annotations
@@ -21,6 +22,7 @@ import jax.numpy as jnp
 import scipy.sparse as sps
 
 from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.ops.blas import dot
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import SolverRegistry, register_solver
@@ -62,6 +64,7 @@ class AMGSolver(Solver):
         self.postsweeps = int(g("postsweeps"))
         self.finest_sweeps = int(g("finest_sweeps"))
         self.coarsest_sweeps = int(g("coarsest_sweeps"))
+        self.cycle_iters = int(g("cycle_iters"))
         self.dense_lu_num_rows = int(g("dense_lu_num_rows"))
         self.dense_lu_max_rows = int(g("dense_lu_max_rows"))
         self.print_grid_stats = bool(g("print_grid_stats"))
@@ -90,6 +93,7 @@ class AMGSolver(Solver):
     def _make_smoother(self, A: SparseMatrix) -> Solver:
         name, sscope = self.cfg.get_scoped("smoother", self.scope)
         sm = SolverRegistry.get(name)(self.cfg, sscope)
+        sm.scaling = "NONE"  # nested: the hierarchy is already scaled
         sm.setup(A)
         return sm
 
@@ -103,6 +107,7 @@ class AMGSolver(Solver):
             if 0 < self.dense_lu_max_rows < A.n_rows:
                 return None
         cs = SolverRegistry.get(name)(self.cfg, cscope)
+        cs.scaling = "NONE"  # nested: the hierarchy is already scaled
         cs.setup(A)
         return cs
 
@@ -110,6 +115,13 @@ class AMGSolver(Solver):
         if A.block_size != 1:
             raise NotImplementedError(
                 "AMG on block matrices: scalarize for now"
+            )
+        if int(self.cfg.get("aggressive_levels", self.scope)) > 0:
+            import warnings
+
+            warnings.warn(
+                "aggressive_levels not yet implemented; using standard "
+                "coarsening on all levels"
             )
         self.levels = [AMGLevel(A, 0)]
         Asp = A.to_scipy()
@@ -229,11 +241,45 @@ class AMGSolver(Solver):
             elif cycle_type == "F" and branch:
                 xc = cycle(params, bc, xc, lvl_id + 1)
                 xc = _v_cycle(params, bc, xc, lvl_id + 1)
+            elif cycle_type in ("CG", "CGF") and branch:
+                xc = _kcycle_solve(params, bc, lvl_id + 1)
             else:
                 xc = cycle(params, bc, xc, lvl_id + 1)
             x = x + spmv(P, xc)
             if post > 0:
                 x = smooth_fns[lvl_id](smp, b, x, post)
+            return x
+
+        def _kcycle_solve(params, b, lvl_id):
+            """K-cycle (reference cycles/cg_[flex_]cycle.cu, Notay): the
+            coarse problem is solved by cycle_iters (F)CG iterations
+            preconditioned with the recursive cycle at this level."""
+            level_params, _ = params
+            A = level_params[lvl_id][0]
+            flexible = cycle_type == "CGF"
+            x = jnp.zeros((A.n_rows * A.block_size,), b.dtype)
+            r = b
+            z = cycle(params, r, jnp.zeros_like(r), lvl_id)
+            p = z
+            rho = dot(r, z)
+            for j in range(self.cycle_iters):
+                q = spmv(A, p)
+                pq = dot(p, q)
+                alpha = jnp.where(pq != 0, rho / pq, 0.0)
+                x = x + alpha * p
+                r_new = r - alpha * q
+                if j + 1 == self.cycle_iters:
+                    break
+                z = cycle(params, r_new, jnp.zeros_like(r_new), lvl_id)
+                rho_new = dot(r_new, z)
+                if flexible:
+                    beta = dot(z, r_new - r) / jnp.where(
+                        rho != 0, rho, 1.0
+                    )
+                else:
+                    beta = rho_new / jnp.where(rho != 0, rho, 1.0)
+                p = z + beta * p
+                r, rho = r_new, rho_new
             return x
 
         def _v_cycle(params, b, x, lvl_id):

@@ -4,11 +4,11 @@ Importing this package registers all built-in solvers (the analogue of
 registerClasses at amgx::initialize, reference core.cu:552-688).
 
 Registered here: PCG, CG, PCGF, PBICGSTAB, BICGSTAB, FGMRES, GMRES,
-BLOCK_JACOBI, JACOBI_L1, GS, MULTICOLOR_GS, FIXCOLOR_GS, MULTICOLOR_DILU,
-MULTICOLOR_ILU, CHEBYSHEV, CHEBYSHEV_POLY, DENSE_LU_SOLVER, NOSOLVER.
+IDR, IDRMSYNC, BLOCK_JACOBI, JACOBI_L1, GS, MULTICOLOR_GS, FIXCOLOR_GS,
+MULTICOLOR_DILU, MULTICOLOR_ILU, CHEBYSHEV, CHEBYSHEV_POLY, POLYNOMIAL,
+KPZ_POLYNOMIAL, KACZMARZ, DENSE_LU_SOLVER, NOSOLVER.
 The AMG solver registers when amgx_tpu.amg is imported (amgx_tpu.initialize
-does both).  Pending reference parity: IDR/IDRMSYNC, KACZMARZ,
-POLYNOMIAL/KPZ_POLYNOMIAL, CF_JACOBI.
+does both).  Pending reference parity: CF_JACOBI (needs C/F plumbing).
 """
 
 from amgx_tpu.solvers.registry import (
@@ -26,8 +26,11 @@ from amgx_tpu.solvers import (  # noqa: F401
     dummy,
     gmres,
     gs,
+    idr,
     jacobi,
+    kaczmarz,
     krylov,
+    polynomial,
 )
 
 __all__ = [

@@ -29,6 +29,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from amgx_tpu.core.matrix import SparseMatrix
 from amgx_tpu.core.types import NormType
@@ -80,6 +81,7 @@ class Solver:
         self.obtain_timings = bool(g("obtain_timings"))
         self.rel_div_tolerance = float(g("rel_div_tolerance"))
         self.alt_rel_tolerance = float(g("alt_rel_tolerance"))
+        self.scaling = str(g("scaling"))
         self._conv_check = make_convergence_check(
             self.conv_type, self.tolerance, self.alt_rel_tolerance
         )
@@ -302,6 +304,23 @@ class Solver:
 
     def setup(self, A: SparseMatrix):
         t0 = time.perf_counter()
+        self._scale_vecs = None
+        if self.scaling.upper() not in ("", "NONE"):
+            # scale the system at setup (reference Scaler::setup hook,
+            # solver.cu:667-676): work on As = Dr A Dc
+            from amgx_tpu.solvers.scalers import create_scaler
+            import scipy.sparse as sps
+
+            scaler = create_scaler(self.scaling)
+            sp = A.to_scipy()
+            r, c = scaler.compute(sp)
+            sp = sps.diags_array(r) @ sp @ sps.diags_array(c)
+            A = SparseMatrix.from_scipy(
+                sp.tocsr().astype(np.dtype(A.values.dtype)),
+                block_size=A.block_size,
+            )
+            self._scale_vecs = (jnp.asarray(r.astype(sp.dtype)),
+                                jnp.asarray(c.astype(sp.dtype)))
         self.A = A
         self._setup_impl(A)
         self._jit_cache.clear()
@@ -319,6 +338,10 @@ class Solver:
             x0 = jnp.zeros_like(b)
         else:
             x0 = jnp.asarray(x0)
+        if self._scale_vecs is not None:
+            r_s, c_s = self._scale_vecs
+            b = r_s * b
+            x0 = x0 / jnp.where(c_s != 0, c_s, 1.0)
         key = (b.shape, b.dtype.name)
         fn = self._jit_cache.get(key)
         if fn is None:
@@ -326,6 +349,8 @@ class Solver:
             self._jit_cache[key] = fn
         t0 = time.perf_counter()
         res = fn(self.apply_params(), b, x0)
+        if self._scale_vecs is not None:
+            res = dataclasses.replace(res, x=self._scale_vecs[1] * res.x)
         res.x.block_until_ready()
         self.solve_time = time.perf_counter() - t0
         if self.print_solve_stats:

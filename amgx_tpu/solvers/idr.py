@@ -1,0 +1,166 @@
+"""IDR(s) — induced dimension reduction (reference idr_solver.cu,
+idrmsync_solver.cu; van Gijzen & Sonneveld biortho variant).
+
+The shadow space dimension s (subspace_dim_s, default 8) is static, so
+the inner k-loop unrolls with static shapes; the whole solve is one
+jitted while_loop over outer cycles.  IDRMSYNC differs from IDR only in
+GPU synchronization strategy — meaningless under XLA — so it aliases.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from amgx_tpu.ops.blas import dot
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.solvers.base import (
+    FAILED,
+    NOT_CONVERGED,
+    SUCCESS,
+    SolveResult,
+)
+from amgx_tpu.solvers.krylov import KrylovSolver
+from amgx_tpu.solvers.registry import register_solver
+
+
+@register_solver("IDR")
+class IDRSolver(KrylovSolver):
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.s = int(cfg.get("subspace_dim_s", scope))
+
+    def make_solve(self):
+        return self._build_solve(self.max_iters, self.monitor_residual)
+
+    def _build_solve(self, max_iters, monitored):
+        M = self._make_M()
+        s = self.s
+        norm_of = self.make_norm()
+        rel_div = self.rel_div_tolerance
+        conv_check = (
+            self._conv_check
+            if monitored
+            else (lambda *a: jnp.asarray(False))
+        )
+
+        def solve(params, b, x0):
+            A, Mp = params
+            n = b.shape[0]
+            dt = b.dtype
+            # deterministic orthonormal shadow space
+            rng = np.random.default_rng(42)
+            Phost = rng.standard_normal((n, s))
+            Phost, _ = np.linalg.qr(Phost)
+            P = jnp.asarray(Phost.T.astype(dt))  # (s, n)
+
+            r0 = b - spmv(A, x0)
+            nrm0 = norm_of(r0)
+
+            def outer(c):
+                (it, x, r, G, U, Mm, om, hist, status) = c
+                f = jnp.conj(P) @ r if jnp.iscomplexobj(r) else P @ r
+                # inner: s dimension-reduction steps (static unroll)
+                for k in range(s):
+                    Mkk = Mm[k:, k:]
+                    ck = jax.scipy.linalg.solve_triangular(
+                        Mkk, f[k:], lower=True
+                    )
+                    v = r - ck @ G[k:]
+                    v = M(Mp, v)
+                    u = om * v + ck @ U[k:]
+                    g = spmv(A, u)
+                    for i in range(k):
+                        alpha = dot(P[i], g) / Mm[i, i]
+                        g = g - alpha * G[i]
+                        u = u - alpha * U[i]
+                    col = jnp.conj(P[k:]) @ g if jnp.iscomplexobj(g) else P[k:] @ g
+                    Mm = Mm.at[k:, k].set(col)
+                    beta = f[k] / jnp.where(Mm[k, k] != 0, Mm[k, k], 1.0)
+                    r = r - beta * g
+                    x = x + beta * u
+                    f = f.at[k:].add(-beta * Mm[k:, k])
+                    G = G.at[k].set(g)
+                    U = U.at[k].set(u)
+                # dimension reduction step
+                v = M(Mp, r)
+                t = spmv(A, v)
+                tt = dot(t, t)
+                om = jnp.where(jnp.real(tt) > 0, dot(t, r) / tt, om)
+                x = x + om * v
+                r = r - om * t
+                it = it + 1
+                nrm = norm_of(r)
+                hist = hist.at[it].set(nrm)
+                done = conv_check(nrm, nrm0, nrm)
+                bad = ~jnp.all(jnp.isfinite(nrm))
+                if rel_div > 0:
+                    bad = bad | jnp.any(nrm > rel_div * nrm0)
+                status = jnp.where(
+                    bad,
+                    jnp.int32(FAILED),
+                    jnp.where(
+                        done, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
+                    ),
+                )
+                return (it, x, r, G, U, Mm, om, hist, status)
+
+            def cond(c):
+                return (c[8] == NOT_CONVERGED) & (c[0] < max_iters)
+
+            rdt = jnp.zeros((), dt).real.dtype
+            ncomp = self.norm_components
+            hist = jnp.full((max_iters + 1, ncomp), jnp.nan, rdt)
+            hist = hist.at[0].set(nrm0)
+            G = jnp.zeros((s, n), dt)
+            U = jnp.zeros((s, n), dt)
+            Mm = jnp.eye(s, dtype=dt)
+            status0 = jnp.where(
+                conv_check(nrm0, nrm0, nrm0) & monitored,
+                jnp.int32(SUCCESS),
+                jnp.int32(NOT_CONVERGED),
+            )
+            c0 = (
+                jnp.int32(0), x0, r0, G, U, Mm, jnp.ones((), dt), hist,
+                status0,
+            )
+            c = jax.lax.while_loop(cond, outer, c0)
+            it, x = c[0], c[1]
+            hist = c[7]
+            status = c[8] if monitored else jnp.int32(SUCCESS)
+            final = hist[jnp.minimum(it, max_iters)]
+            return SolveResult(
+                x=x,
+                iters=it,
+                status=status,
+                final_norm=final,
+                initial_norm=nrm0,
+                history=hist,
+            )
+
+        return solve
+
+    def make_apply(self):
+        solve = self._build_solve(max(self.max_iters, 1), monitored=False)
+
+        def apply(params, r):
+            return solve(params, r, jnp.zeros_like(r)).x
+
+        return apply
+
+    def make_smooth(self):
+        cache = {}
+
+        def smooth(params, b, x, sweeps):
+            if sweeps not in cache:
+                cache[sweeps] = self._build_solve(sweeps, monitored=False)
+            return cache[sweeps](params, b, x).x
+
+        return smooth
+
+
+@register_solver("IDRMSYNC")
+class IDRMSyncSolver(IDRSolver):
+    """Reduced-synchronization IDR(s) (reference idrmsync_solver.cu) —
+    identical math under XLA."""

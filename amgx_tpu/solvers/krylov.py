@@ -27,7 +27,9 @@ def resolve_preconditioner(cfg, scope):
     name, pscope = cfg.get_scoped("preconditioner", scope)
     if name == "NOSOLVER":
         return None
-    return SolverRegistry.get(name)(cfg, pscope)
+    prec = SolverRegistry.get(name)(cfg, pscope)
+    prec.scaling = "NONE"  # nested solvers never re-scale (base.setup)
+    return prec
 
 
 class KrylovSolver(Solver):

@@ -1,0 +1,48 @@
+"""Polynomial smoothers (reference polynomial_solver.cu,
+kpz_polynomial_solver.cu).
+
+POLYNOMIAL: truncated Neumann-series smoother in the Jacobi-preconditioned
+operator:  z = sum_{k<order} (I - D^{-1}A)^k D^{-1} r.
+KPZ_POLYNOMIAL: same family with the KPZ order/mu parameters.
+Both are gather-free chains of SpMV + AXPY — TPU-friendly.
+"""
+
+from __future__ import annotations
+
+from amgx_tpu.ops.diagonal import invert_diag
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.solvers.base import Solver
+from amgx_tpu.solvers.registry import register_solver
+
+
+@register_solver("POLYNOMIAL")
+class PolynomialSolver(Solver):
+    order_param = "kpz_order"
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.order = max(int(cfg.get(self.order_param, scope)), 1)
+
+    def _setup_impl(self, A):
+        if A.block_size != 1:
+            raise NotImplementedError("polynomial smoother: scalar only")
+        self._params = (A, invert_diag(A))
+
+    def make_residual_step(self):
+        order = self.order
+        omega = self.relaxation_factor
+
+        def rstep(params, b, x, r):
+            A, dinv = params
+            # z_m = sum_{k<=m} (I - Dinv A)^k Dinv r, built incrementally
+            z = dinv * r
+            for _ in range(order - 1):
+                z = z - dinv * spmv(A, z) + dinv * r
+            return x + omega * z
+
+        return rstep
+
+
+@register_solver("KPZ_POLYNOMIAL")
+class KPZPolynomialSolver(PolynomialSolver):
+    order_param = "kpz_order"

@@ -1,0 +1,74 @@
+"""Equation scalers (reference src/scalers/: BINORMALIZATION,
+NBINORMALIZATION, DIAGONAL_SYMMETRIC; hooked in Solver::setup/solve,
+solver.cu:667-676).
+
+A scaler computes row/col scaling vectors at setup, the solver then works
+on As = Dr A Dc; rhs is scaled before the solve (b -> Dr b) and the
+solution unscaled after (x -> Dc x).  For symmetric scalings Dr == Dc.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sps
+
+
+class Scaler:
+    """Computes (left, right) positive scaling vectors."""
+
+    def compute(self, Asp: sps.csr_matrix):
+        raise NotImplementedError
+
+
+class DiagonalSymmetricScaler(Scaler):
+    """As = D^{-1/2} A D^{-1/2} (reference diagonal_symmetric_scaler.cu)."""
+
+    def compute(self, Asp):
+        d = np.abs(Asp.diagonal())
+        s = 1.0 / np.sqrt(np.where(d > 0, d, 1.0))
+        return s, s
+
+
+class BinormalizationScaler(Scaler):
+    """Iterative binormalization (reference binormalization scalers,
+    Livne-Golub): find u > 0 with u_i (B u)_i = 1 for B = |A|.^2, then
+    D = diag(sqrt(u)) gives unit row/col 2-norms of D A D.  The damped
+    (Knight-Ruiz style) symmetric iteration u <- sqrt(u / (B u)) is used —
+    a SYMMETRIC scaling, so SPD systems stay SPD (alternating row/col
+    Sinkhorn would produce r != c and break CG)."""
+
+    def __init__(self, iters: int = 50):
+        self.iters = iters
+
+    def compute(self, Asp):
+        B = Asp.copy().tocsr()
+        B.data = np.abs(B.data) ** 2
+        # symmetrize the weight graph so the iteration is well-defined for
+        # mildly nonsymmetric A as well
+        B = ((B + B.T) * 0.5).tocsr()
+        n = B.shape[0]
+        u = 1.0 / np.maximum(np.asarray(B.sum(axis=1)).ravel(), 1e-300)
+        for _ in range(self.iters):
+            Bu = B @ u
+            u = np.sqrt(u / np.where(Bu > 0, Bu, 1.0))
+        s = np.sqrt(u)
+        return s, s
+
+
+_SCALERS = {
+    "DIAGONAL_SYMMETRIC": DiagonalSymmetricScaler,
+    "BINORMALIZATION": BinormalizationScaler,
+    "NBINORMALIZATION": BinormalizationScaler,
+}
+
+
+def create_scaler(name: str):
+    name = name.upper()
+    if name in ("", "NONE"):
+        return None
+    try:
+        return _SCALERS[name]()
+    except KeyError:
+        raise KeyError(
+            f"unknown scaler {name!r}; known: {sorted(_SCALERS)}"
+        ) from None
