@@ -25,7 +25,11 @@ from amgx_tpu.core.matrix import SparseMatrix
 from amgx_tpu.ops.blas import dot
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
-from amgx_tpu.solvers.registry import SolverRegistry, register_solver
+from amgx_tpu.solvers.registry import (
+    SolverRegistry,
+    make_nested,
+    register_solver,
+)
 
 
 class AMGLevel:
@@ -92,8 +96,7 @@ class AMGSolver(Solver):
 
     def _make_smoother(self, A: SparseMatrix) -> Solver:
         name, sscope = self.cfg.get_scoped("smoother", self.scope)
-        sm = SolverRegistry.get(name)(self.cfg, sscope)
-        sm.scaling = "NONE"  # nested: the hierarchy is already scaled
+        sm = make_nested(SolverRegistry.get(name)(self.cfg, sscope))
         sm.setup(A)
         return sm
 
@@ -106,8 +109,7 @@ class AMGSolver(Solver):
             # dense_lu_max_rows != 0
             if 0 < self.dense_lu_max_rows < A.n_rows:
                 return None
-        cs = SolverRegistry.get(name)(self.cfg, cscope)
-        cs.scaling = "NONE"  # nested: the hierarchy is already scaled
+        cs = make_nested(SolverRegistry.get(name)(self.cfg, cscope))
         cs.setup(A)
         return cs
 

@@ -113,6 +113,10 @@ class AMGConfig:
             new_scope = None
             if ":" in lhs:
                 scope, lhs = (s.strip() for s in lhs.split(":", 1))
+            if lhs == "config_version":
+                if rhs not in ("1", "2"):
+                    raise ConfigError(f"unsupported config_version {rhs}")
+                continue
             m = re.match(r"^(\w+)\((\w+)\)$", lhs)
             if m:
                 lhs, new_scope = m.group(1), m.group(2)

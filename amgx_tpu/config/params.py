@@ -209,6 +209,7 @@ register("eig_which", S, "largest", "which eigenpair",
          ("smallest", "largest", "pagerank", "shift"))
 register("eig_wanted_count", I, 1, "number of eigenpairs")
 register("eig_subspace_size", I, 8, "subspace/Lanczos dimension")
+register("eig_convergence_check_freq", I, 1, "convergence check frequency")
 register("eig_eigenvector", I, 0, "compute eigenvectors flag")
 register("eig_eigenvector_solver", S, "", "inverse-iteration solver cfg")
 

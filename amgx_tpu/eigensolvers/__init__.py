@@ -1,0 +1,23 @@
+"""Eigensolvers (reference src/eigensolvers/: EigenSolver base
+eigensolver.h:25-150; factories eigensolvers.cu:38-48; shipped configs
+src/configs/eigen_configs/).
+
+Registered: POWER_ITERATION, SINGLE_ITERATION, INVERSE_ITERATION,
+PAGERANK, SUBSPACE_ITERATION, LANCZOS, ARNOLDI, LOBPCG.
+JACOBI_DAVIDSON is pending.
+"""
+
+from amgx_tpu.eigensolvers.base import (
+    EigenResult,
+    EigenSolver,
+    EigenSolverRegistry,
+    create_eigensolver,
+)
+from amgx_tpu.eigensolvers import algorithms  # noqa: F401  (registration)
+
+__all__ = [
+    "EigenResult",
+    "EigenSolver",
+    "EigenSolverRegistry",
+    "create_eigensolver",
+]

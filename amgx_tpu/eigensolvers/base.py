@@ -1,0 +1,98 @@
+"""EigenSolver contract (reference eigensolver.h:25-150): configured by
+eig_* parameters, setup(A) then solve() returning eigenpairs."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from amgx_tpu.core.matrix import SparseMatrix
+
+
+@dataclasses.dataclass
+class EigenResult:
+    eigenvalues: np.ndarray  # (k,)
+    eigenvectors: Optional[np.ndarray]  # (n, k) or None
+    iterations: int
+    converged: bool
+    residual: float
+
+
+_EIGENSOLVERS: Dict[str, type] = {}
+
+
+class EigenSolverRegistry:
+    @staticmethod
+    def register(name, cls):
+        _EIGENSOLVERS[name] = cls
+
+    @staticmethod
+    def get(name):
+        try:
+            return _EIGENSOLVERS[name]
+        except KeyError:
+            raise KeyError(
+                f"unregistered eigensolver {name!r}; known: "
+                f"{sorted(_EIGENSOLVERS)}"
+            ) from None
+
+
+def register_eigensolver(*names):
+    def deco(cls):
+        for n in names:
+            EigenSolverRegistry.register(n, cls)
+        cls.registry_name = names[0]
+        return cls
+
+    return deco
+
+
+class EigenSolver:
+    """Base: reads the eig_* parameter family (core registrations)."""
+
+    registry_name = "?"
+
+    def __init__(self, cfg, scope: str = "default"):
+        self.cfg = cfg
+        self.scope = scope
+        g = lambda k: cfg.get(k, scope)
+        self.max_iters = int(g("eig_max_iters"))
+        self.tolerance = float(g("eig_tolerance"))
+        self.shift = float(g("eig_shift"))
+        self.which = str(g("eig_which")).lower()
+        self.wanted_count = int(g("eig_wanted_count"))
+        self.subspace_size = int(g("eig_subspace_size"))
+        self.damping = float(g("eig_damping_factor"))
+        self.want_vectors = bool(g("eig_eigenvector"))
+        self.A: Optional[SparseMatrix] = None
+        self.requested_name = type(self).registry_name
+
+    def setup(self, A: SparseMatrix):
+        self.A = A
+        self._setup_impl(A)
+        return self
+
+    def _setup_impl(self, A):
+        pass
+
+    def _krylov_dim(self) -> int:
+        """Krylov dimension for single-shot Lanczos/Arnoldi: the explicit
+        eig_subspace_size when configured, else the iteration budget
+        (the reference restarts; a long single sweep is equivalent here)."""
+        if self.cfg.has("eig_subspace_size", self.scope):
+            return max(self.subspace_size, 2 * self.wanted_count + 2)
+        return max(self.max_iters, 2 * self.wanted_count + 2)
+
+    def solve(self, x0=None) -> EigenResult:
+        raise NotImplementedError
+
+
+def create_eigensolver(cfg, scope: str = "default") -> EigenSolver:
+    name = str(cfg.get("eig_solver", scope)).upper()
+    inst = EigenSolverRegistry.get(name)(cfg, scope)
+    # several registry names share a class (reference SINGLE_ITERATION
+    # family); record which one was asked for so setup can specialize
+    inst.requested_name = name
+    return inst

@@ -36,7 +36,8 @@ class IDRSolver(KrylovSolver):
 
     def _build_solve(self, max_iters, monitored):
         M = self._make_M()
-        s = self.s
+        # shadow space cannot exceed the system size
+        s = min(self.s, self.A.n_rows * self.A.block_size)
         norm_of = self.make_norm()
         rel_div = self.rel_div_tolerance
         conv_check = (
@@ -59,20 +60,24 @@ class IDRSolver(KrylovSolver):
             nrm0 = norm_of(r0)
 
             def outer(c):
-                (it, x, r, G, U, Mm, om, hist, status) = c
+                (it, x, r, G, U, Mm, om, nrm_max, hist, status) = c
                 f = jnp.conj(P) @ r if jnp.iscomplexobj(r) else P @ r
                 # inner: s dimension-reduction steps (static unroll)
                 for k in range(s):
                     Mkk = Mm[k:, k:]
+                    # guard exact-zero pivots (residual hit zero mid-loop:
+                    # f is zero there, so the unit pivot is inert)
+                    dsafe = jnp.where(jnp.diag(Mkk) == 0, 1.0, 0.0)
                     ck = jax.scipy.linalg.solve_triangular(
-                        Mkk, f[k:], lower=True
+                        Mkk + jnp.diag(dsafe), f[k:], lower=True
                     )
                     v = r - ck @ G[k:]
                     v = M(Mp, v)
                     u = om * v + ck @ U[k:]
                     g = spmv(A, u)
                     for i in range(k):
-                        alpha = dot(P[i], g) / Mm[i, i]
+                        mii = jnp.where(Mm[i, i] != 0, Mm[i, i], 1.0)
+                        alpha = dot(P[i], g) / mii
                         g = g - alpha * G[i]
                         u = u - alpha * U[i]
                     col = jnp.conj(P[k:]) @ g if jnp.iscomplexobj(g) else P[k:] @ g
@@ -92,8 +97,9 @@ class IDRSolver(KrylovSolver):
                 r = r - om * t
                 it = it + 1
                 nrm = norm_of(r)
+                nrm_max = jnp.maximum(nrm_max, nrm)
                 hist = hist.at[it].set(nrm)
-                done = conv_check(nrm, nrm0, nrm)
+                done = conv_check(nrm, nrm0, nrm_max)
                 bad = ~jnp.all(jnp.isfinite(nrm))
                 if rel_div > 0:
                     bad = bad | jnp.any(nrm > rel_div * nrm0)
@@ -104,10 +110,10 @@ class IDRSolver(KrylovSolver):
                         done, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
                     ),
                 )
-                return (it, x, r, G, U, Mm, om, hist, status)
+                return (it, x, r, G, U, Mm, om, nrm_max, hist, status)
 
             def cond(c):
-                return (c[8] == NOT_CONVERGED) & (c[0] < max_iters)
+                return (c[9] == NOT_CONVERGED) & (c[0] < max_iters)
 
             rdt = jnp.zeros((), dt).real.dtype
             ncomp = self.norm_components
@@ -122,13 +128,13 @@ class IDRSolver(KrylovSolver):
                 jnp.int32(NOT_CONVERGED),
             )
             c0 = (
-                jnp.int32(0), x0, r0, G, U, Mm, jnp.ones((), dt), hist,
-                status0,
+                jnp.int32(0), x0, r0, G, U, Mm, jnp.ones((), dt), nrm0,
+                hist, status0,
             )
             c = jax.lax.while_loop(cond, outer, c0)
             it, x = c[0], c[1]
-            hist = c[7]
-            status = c[8] if monitored else jnp.int32(SUCCESS)
+            hist = c[8]
+            status = c[9] if monitored else jnp.int32(SUCCESS)
             final = hist[jnp.minimum(it, max_iters)]
             return SolveResult(
                 x=x,

@@ -19,7 +19,11 @@ import jax.numpy as jnp
 from amgx_tpu.ops.blas import dot
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
-from amgx_tpu.solvers.registry import SolverRegistry, register_solver
+from amgx_tpu.solvers.registry import (
+    SolverRegistry,
+    make_nested,
+    register_solver,
+)
 
 
 def resolve_preconditioner(cfg, scope):
@@ -27,9 +31,7 @@ def resolve_preconditioner(cfg, scope):
     name, pscope = cfg.get_scoped("preconditioner", scope)
     if name == "NOSOLVER":
         return None
-    prec = SolverRegistry.get(name)(cfg, pscope)
-    prec.scaling = "NONE"  # nested solvers never re-scale (base.setup)
-    return prec
+    return make_nested(SolverRegistry.get(name)(cfg, pscope))
 
 
 class KrylovSolver(Solver):

@@ -49,3 +49,12 @@ def create_solver(cfg, scope: str = "default", param: str = "solver"):
     name, new_scope = cfg.get_scoped(param, scope)
     cls = SolverRegistry.get(name)
     return cls(cfg, new_scope)
+
+
+def make_nested(solver):
+    """Mark a solver as nested (preconditioner / smoother / coarse / inner
+    eigensolver solver).  Nested solvers never re-scale: the outer solver
+    already works on the scaled operator (reference 'scaled' guard,
+    solver.cu:452-467).  Single enforcement point for the invariant."""
+    solver.scaling = "NONE"
+    return solver
