@@ -166,7 +166,9 @@ class AMGSolver(Solver):
 
         self._params = self._collect_params()
         if self.print_grid_stats:
-            print(self.grid_stats())
+            from amgx_tpu.core.printing import emit
+
+            emit(self.grid_stats())
 
     def _collect_params(self):
         per_level = []

@@ -1,0 +1,3 @@
+from amgx_tpu.api import capi
+
+__all__ = ["capi"]

@@ -1,0 +1,601 @@
+"""C-API-compatible handle layer (reference include/amgx_c.h, 611 lines;
+src/amgx_c.cu).
+
+Functions mirror the AMGX_* surface with opaque integer handles; errors
+raise :class:`AMGXError` carrying an AMGX_RC code (the native C shim in
+native/ converts exceptions back to return codes, reference
+AMGX_TRIES/AMGX_CATCHES).  Array arguments accept numpy arrays, any
+buffer, or bytes (the C shim passes raw buffers + the mode's dtypes).
+
+Modes (dDDI, dDFI, ...) choose vector/matrix dtypes
+(amgx_tpu.core.types); the memory-space letter is ignored on TPU.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from amgx_tpu.config.amg_config import AMGConfig, ConfigError
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.core.types import mode_from_name
+
+# AMGX_RC codes (reference amgx_c.h:52-69)
+RC_OK = 0
+RC_BAD_PARAMETERS = 1
+RC_UNKNOWN = 2
+RC_NOT_SUPPORTED_TARGET = 3
+RC_NOT_SUPPORTED_BLOCKSIZE = 4
+RC_CUDA_FAILURE = 5
+RC_IO_ERROR = 6
+RC_BAD_MODE = 7
+RC_CORE = 8
+RC_PLUGIN = 9
+RC_BAD_CONFIGURATION = 10
+RC_NOT_IMPLEMENTED = 11
+RC_LICENSE_NOT_FOUND = 12
+RC_INTERNAL = 13
+
+# solve status (reference AMGX_SOLVE_*)
+SOLVE_SUCCESS = 0
+SOLVE_FAILED = 1
+SOLVE_DIVERGED = 2
+SOLVE_NOT_CONVERGED = 2
+
+
+class AMGXError(Exception):
+    def __init__(self, rc, msg=""):
+        super().__init__(msg or f"AMGX_RC {rc}")
+        self.rc = rc
+
+
+_lock = threading.Lock()
+_next_handle = [1]
+_objects: Dict[int, object] = {}
+_initialized = [False]
+_print_callback = [print]
+
+
+def _ensure_dtype_support(mode):
+    """Enable jax x64 when a 64-bit mode is requested on a backend that
+    supports it (CPU); TPU stays in 32-bit (the dDFI-analogue story,
+    SURVEY §7) — values are downcast there."""
+    import jax
+
+    wide = np.dtype(mode.vec_dtype).itemsize >= 8 or np.dtype(
+        mode.mat_dtype
+    ).itemsize >= 8
+    if wide and jax.default_backend() == "cpu":
+        jax.config.update("jax_enable_x64", True)
+
+
+def _new(obj) -> int:
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _objects[h] = obj
+    return h
+
+
+def _get(h, cls=None):
+    try:
+        obj = _objects[h]
+    except KeyError:
+        raise AMGXError(RC_BAD_PARAMETERS, f"invalid handle {h}") from None
+    if cls is not None and not isinstance(obj, cls):
+        raise AMGXError(
+            RC_BAD_PARAMETERS, f"handle {h} is not a {cls.__name__}"
+        )
+    return obj
+
+
+class _Config:
+    def __init__(self, cfg: AMGConfig):
+        self.cfg = cfg
+
+
+class _Resources:
+    def __init__(self, cfg: _Config):
+        self.cfg = cfg
+
+
+class _Matrix:
+    def __init__(self, res: _Resources, mode):
+        self.res = res
+        self.mode = mode
+        self.A: Optional[SparseMatrix] = None
+
+
+class _Vector:
+    def __init__(self, res: _Resources, mode):
+        self.res = res
+        self.mode = mode
+        self.data: Optional[np.ndarray] = None
+        self.block_dim = 1
+        self.bound_matrix: Optional[_Matrix] = None
+
+
+class _SolverHandle:
+    def __init__(self, res: _Resources, mode, cfg: _Config):
+        self.res = res
+        self.mode = mode
+        self.cfg = cfg
+        self.solver = None
+        self.result = None
+
+
+# ---------------------------------------------------------------------------
+# lifecycle (amgx_c.h:165-191)
+
+
+def initialize():
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    _initialized[0] = True
+    return RC_OK
+
+
+def finalize():
+    _objects.clear()
+    _initialized[0] = False
+    return RC_OK
+
+
+def get_api_version():
+    from amgx_tpu.version import get_api_version as _v
+
+    return _v()
+
+
+def register_print_callback(fn):
+    from amgx_tpu.core.printing import set_print_callback
+
+    set_print_callback(fn)
+    return RC_OK
+
+
+def install_signal_handler():
+    import faulthandler
+
+    faulthandler.enable()
+    return RC_OK
+
+
+def reset_signal_handler():
+    import faulthandler
+
+    faulthandler.disable()
+    return RC_OK
+
+
+def mode_itemsizes(mode: str):
+    """(matrix itemsize, vector itemsize) for a mode name — the native C
+    shim sizes its buffers from this (single source of truth)."""
+    try:
+        m = mode_from_name(mode)
+    except ValueError as e:
+        raise AMGXError(RC_BAD_MODE, str(e)) from None
+    return (
+        int(np.dtype(m.mat_dtype).itemsize),
+        int(np.dtype(m.vec_dtype).itemsize),
+    )
+
+
+def get_error_string(rc):
+    names = {
+        RC_OK: "success",
+        RC_BAD_PARAMETERS: "bad parameters",
+        RC_UNKNOWN: "unknown error",
+        RC_IO_ERROR: "I/O error",
+        RC_BAD_MODE: "bad mode",
+        RC_BAD_CONFIGURATION: "bad configuration",
+        RC_NOT_IMPLEMENTED: "not implemented",
+        RC_INTERNAL: "internal error",
+    }
+    return names.get(rc, f"error code {rc}")
+
+
+# ---------------------------------------------------------------------------
+# config (amgx_c.h:193-215)
+
+
+def config_create(options: str) -> int:
+    try:
+        cfg = AMGConfig.from_string(options) if options.strip() else (
+            AMGConfig()
+        )
+    except ConfigError as e:
+        raise AMGXError(RC_BAD_CONFIGURATION, str(e)) from None
+    return _new(_Config(cfg))
+
+
+def config_create_from_file(path: str) -> int:
+    try:
+        cfg = AMGConfig.from_file(path)
+    except FileNotFoundError as e:
+        raise AMGXError(RC_IO_ERROR, str(e)) from None
+    except ConfigError as e:
+        raise AMGXError(RC_BAD_CONFIGURATION, str(e)) from None
+    return _new(_Config(cfg))
+
+
+def config_create_from_file_and_string(path: str, options: str) -> int:
+    h = config_create_from_file(path)
+    config_add_parameters(h, options)
+    return h
+
+
+def config_add_parameters(cfg_h: int, options: str):
+    cfg = _get(cfg_h, _Config).cfg
+    try:
+        cfg.parse(options)
+    except ConfigError as e:
+        raise AMGXError(RC_BAD_CONFIGURATION, str(e)) from None
+    return RC_OK
+
+
+def config_get_default_number_of_rings(cfg_h: int) -> int:
+    """Classical AMG needs 2 halo rings, aggregation 1 (reference
+    AMGX_config_get_default_number_of_rings).  Any scope configured
+    CLASSICAL (or the registry default, when nothing overrides it)
+    means 2."""
+    cfg = _get(cfg_h, _Config).cfg
+    values = cfg.items()
+    algos = [
+        str(v).upper()
+        for (scope, name), v in values.items()
+        if name == "algorithm"
+    ]
+    if not algos:
+        algos = [str(cfg.get("algorithm", "default")).upper()]
+    return 2 if "CLASSICAL" in algos else 1
+
+
+def config_destroy(cfg_h: int):
+    _objects.pop(cfg_h, None)
+    return RC_OK
+
+
+# ---------------------------------------------------------------------------
+# resources (amgx_c.h:218-230)
+
+
+def resources_create_simple(cfg_h: int) -> int:
+    return _new(_Resources(_get(cfg_h, _Config)))
+
+
+def resources_destroy(res_h: int):
+    _objects.pop(res_h, None)
+    return RC_OK
+
+
+# ---------------------------------------------------------------------------
+# matrix (amgx_c.h:262-333)
+
+
+def matrix_create(res_h: int, mode: str = "dDDI") -> int:
+    try:
+        m = mode_from_name(mode)
+    except ValueError as e:
+        raise AMGXError(RC_BAD_MODE, str(e)) from None
+    _ensure_dtype_support(m)
+    return _new(_Matrix(_get(res_h, _Resources), m))
+
+
+def _as_array(buf, dtype, count):
+    if buf is None:
+        return None
+    a = np.frombuffer(buf, dtype=dtype, count=count) if isinstance(
+        buf, (bytes, bytearray, memoryview)
+    ) else np.asarray(buf, dtype=dtype)
+    return a.reshape(-1)[:count] if count >= 0 else a.reshape(-1)
+
+
+def matrix_upload_all(
+    mtx_h: int,
+    n: int,
+    nnz: int,
+    block_dimx: int,
+    block_dimy: int,
+    row_ptrs,
+    col_indices,
+    data,
+    diag_data=None,
+):
+    m = _get(mtx_h, _Matrix)
+    if block_dimx != block_dimy:
+        raise AMGXError(
+            RC_NOT_SUPPORTED_BLOCKSIZE, "rectangular blocks unsupported"
+        )
+    b = block_dimx
+    mat_dt = m.mode.mat_dtype
+    rp = _as_array(row_ptrs, np.int32, n + 1)
+    ci = _as_array(col_indices, np.int32, nnz)
+    vals = _as_array(data, mat_dt, nnz * b * b)
+    if diag_data is not None:
+        # external diagonal: append explicit diagonal entries
+        dg = _as_array(diag_data, mat_dt, n * b * b)
+        rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(rp))
+        rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+        cols = np.concatenate([ci.astype(np.int64),
+                               np.arange(n, dtype=np.int64)])
+        allv = np.concatenate(
+            [vals.reshape(nnz, -1), dg.reshape(n, -1)]
+        )
+        m.A = SparseMatrix.from_coo(
+            rows, cols, allv, n_rows=n, n_cols=n, block_size=b
+        )
+    else:
+        m.A = SparseMatrix.from_csr(rp, ci, vals, block_size=b)
+    return RC_OK
+
+
+def matrix_replace_coefficients(mtx_h, n, nnz, data, diag_data=None):
+    m = _get(mtx_h, _Matrix)
+    if m.A is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+    if diag_data is not None:
+        raise AMGXError(
+            RC_NOT_IMPLEMENTED, "external diag replace TBD"
+        )
+    b = m.A.block_size
+    vals = _as_array(data, m.mode.mat_dtype, nnz * b * b)
+    m.A = m.A.replace_values(vals)
+    return RC_OK
+
+
+def matrix_get_size(mtx_h):
+    m = _get(mtx_h, _Matrix)
+    if m.A is None:
+        return 0, 0, 0
+    return m.A.n_rows, m.A.block_size, m.A.block_size
+
+
+def matrix_check_symmetry(mtx_h):
+    from amgx_tpu.ops.analysis import check_symmetry
+
+    m = _get(mtx_h, _Matrix)
+    if m.A is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+    s, n = check_symmetry(m.A)
+    return int(s), int(n)
+
+
+def matrix_destroy(mtx_h):
+    _objects.pop(mtx_h, None)
+    return RC_OK
+
+
+# ---------------------------------------------------------------------------
+# vector (amgx_c.h:336-372)
+
+
+def vector_create(res_h: int, mode: str = "dDDI") -> int:
+    try:
+        m = mode_from_name(mode)
+    except ValueError as e:
+        raise AMGXError(RC_BAD_MODE, str(e)) from None
+    _ensure_dtype_support(m)
+    return _new(_Vector(_get(res_h, _Resources), m))
+
+
+def vector_upload(vec_h: int, n: int, block_dim: int, data):
+    v = _get(vec_h, _Vector)
+    v.data = np.array(
+        _as_array(data, v.mode.vec_dtype, n * block_dim), copy=True
+    )
+    v.block_dim = block_dim
+    return RC_OK
+
+
+def vector_set_zero(vec_h: int, n: int, block_dim: int):
+    v = _get(vec_h, _Vector)
+    v.data = np.zeros(n * block_dim, dtype=v.mode.vec_dtype)
+    v.block_dim = block_dim
+    return RC_OK
+
+
+def vector_set_random(vec_h: int, n: int):
+    v = _get(vec_h, _Vector)
+    v.data = np.random.default_rng(0).standard_normal(n).astype(
+        v.mode.vec_dtype
+    )
+    return RC_OK
+
+
+def vector_download(vec_h: int) -> np.ndarray:
+    v = _get(vec_h, _Vector)
+    if v.data is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "vector empty")
+    # always the mode's dtype: the C caller sizes its buffer by the mode
+    return np.ascontiguousarray(
+        np.asarray(v.data), dtype=v.mode.vec_dtype
+    )
+
+
+def vector_bind(vec_h: int, mtx_h: int):
+    v = _get(vec_h, _Vector)
+    v.bound_matrix = _get(mtx_h, _Matrix)
+    return RC_OK
+
+
+def vector_get_size(vec_h: int):
+    v = _get(vec_h, _Vector)
+    if v.data is None:
+        return 0, 1
+    return v.data.shape[0] // v.block_dim, v.block_dim
+
+
+def vector_destroy(vec_h):
+    _objects.pop(vec_h, None)
+    return RC_OK
+
+
+# ---------------------------------------------------------------------------
+# solver (amgx_c.h:375-421)
+
+
+def solver_create(res_h: int, mode: str, cfg_h: int) -> int:
+    try:
+        m = mode_from_name(mode)
+    except ValueError as e:
+        raise AMGXError(RC_BAD_MODE, str(e)) from None
+    return _new(
+        _SolverHandle(_get(res_h, _Resources), m, _get(cfg_h, _Config))
+    )
+
+
+def solver_setup(slv_h: int, mtx_h: int):
+    from amgx_tpu.solvers.registry import create_solver
+
+    s = _get(slv_h, _SolverHandle)
+    m = _get(mtx_h, _Matrix)
+    if m.A is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+    try:
+        s.solver = create_solver(s.cfg.cfg, "default")
+    except KeyError as e:
+        raise AMGXError(RC_BAD_CONFIGURATION, str(e)) from None
+    A = m.A
+    if np.dtype(A.values.dtype) != np.dtype(s.mode.mat_dtype):
+        A = A.astype(s.mode.mat_dtype)
+    s.solver.setup(A)
+    s.matrix = m
+    return RC_OK
+
+
+def _solve_impl(s, rhs_h, sol_h, zero_guess):
+    rhs = _get(rhs_h, _Vector)
+    sol = _get(sol_h, _Vector)
+    if s.solver is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "solver not set up")
+    if rhs.data is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "rhs not uploaded")
+    x0 = None if (zero_guess or sol.data is None) else sol.data
+    res = s.solver.solve(
+        rhs.data.astype(s.mode.vec_dtype),
+        x0=x0,
+        zero_initial_guess=zero_guess,
+    )
+    s.result = res
+    sol.data = np.asarray(res.x)
+    return RC_OK
+
+
+def solver_solve(slv_h: int, rhs_h: int, sol_h: int):
+    return _solve_impl(_get(slv_h, _SolverHandle), rhs_h, sol_h, False)
+
+
+def solver_solve_with_0_initial_guess(slv_h: int, rhs_h: int, sol_h: int):
+    return _solve_impl(_get(slv_h, _SolverHandle), rhs_h, sol_h, True)
+
+
+def solver_get_status(slv_h: int) -> int:
+    s = _get(slv_h, _SolverHandle)
+    if s.result is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no solve yet")
+    return int(s.result.status)
+
+
+def solver_get_iterations_number(slv_h: int) -> int:
+    s = _get(slv_h, _SolverHandle)
+    if s.result is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no solve yet")
+    return int(s.result.iters)
+
+
+def solver_get_iteration_residual(slv_h: int, it: int, idx: int = 0):
+    s = _get(slv_h, _SolverHandle)
+    if s.result is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no solve yet")
+    hist = np.asarray(s.result.history)
+    if not (0 <= it < hist.shape[0]):
+        raise AMGXError(RC_BAD_PARAMETERS, f"iteration {it} out of range")
+    return float(hist[it, idx])
+
+
+def solver_destroy(slv_h):
+    _objects.pop(slv_h, None)
+    return RC_OK
+
+
+# ---------------------------------------------------------------------------
+# IO (amgx_c.h:424-529)
+
+
+def read_system(mtx_h: int, rhs_h: int, sol_h: int, filename: str):
+    from amgx_tpu.io.matrix_market import MatrixIOError
+    from amgx_tpu.io.matrix_market import read_system as _read
+
+    m = _get(mtx_h, _Matrix) if mtx_h else None
+    try:
+        Ad, rhs, sol = _read(filename)
+    except (FileNotFoundError, MatrixIOError) as e:
+        raise AMGXError(RC_IO_ERROR, str(e)) from None
+    if m is not None:
+        bx, by = Ad["block_dims"]
+        m.A = SparseMatrix.from_coo(
+            Ad["rows"],
+            Ad["cols"],
+            np.asarray(Ad["vals"], dtype=m.mode.mat_dtype),
+            n_rows=Ad["n_rows"],
+            n_cols=Ad["n_cols"],
+            block_size=bx if bx == by else 1,
+        )
+    n = Ad["n_rows"] * Ad["block_dims"][0]
+    if rhs_h:
+        v = _get(rhs_h, _Vector)
+        v.data = (
+            np.asarray(rhs, v.mode.vec_dtype)
+            if rhs is not None
+            else np.ones(n, v.mode.vec_dtype)
+        )
+    if sol_h:
+        v = _get(sol_h, _Vector)
+        if sol is not None:
+            v.data = np.asarray(sol, v.mode.vec_dtype)
+    return RC_OK
+
+
+def write_system(mtx_h: int, rhs_h: int, sol_h: int, filename: str):
+    from amgx_tpu.io.matrix_market import write_system as _write
+
+    m = _get(mtx_h, _Matrix)
+    if m.A is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+    rhs = _objects.get(rhs_h).data if rhs_h in _objects else None
+    sol = _objects.get(sol_h).data if sol_h in _objects else None
+    _write(filename, m.A, rhs=rhs, sol=sol)
+    return RC_OK
+
+
+def write_parameters_description(filename: str):
+    from amgx_tpu.config.params import write_parameters_description as _w
+
+    _w(filename)
+    return RC_OK
+
+
+def generate_distributed_poisson_7pt(
+    mtx_h: int, rhs_h: int, sol_h: int, nx, ny, nz, *args
+):
+    """Single-handle Poisson generator (reference
+    AMGX_generate_distributed_poisson_7pt; the px/py/pz partition args are
+    accepted for signature parity — distribution happens in the
+    distributed layer)."""
+    from amgx_tpu.io.poisson import poisson_scipy
+
+    m = _get(mtx_h, _Matrix)
+    sp = poisson_scipy((nx, ny, nz)).astype(m.mode.mat_dtype)
+    m.A = SparseMatrix.from_scipy(sp)
+    n = sp.shape[0]
+    if rhs_h:
+        v = _get(rhs_h, _Vector)
+        v.data = np.ones(n, v.mode.vec_dtype)
+    if sol_h:
+        v = _get(sol_h, _Vector)
+        v.data = np.zeros(n, v.mode.vec_dtype)
+    return RC_OK

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.core.printing import emit
 from amgx_tpu.core.types import NormType
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.ops.norms import norm as _norm, block_norm as _block_norm
@@ -356,7 +357,7 @@ class Solver:
         if self.print_solve_stats:
             self._print_stats(res)
         if self.obtain_timings:
-            print(
+            emit(
                 f"Total Time: {self.setup_time + self.solve_time:10.6f}\n"
                 f"    setup: {self.setup_time:10.6f} s\n"
                 f"    solve: {self.solve_time:10.6f} s\n"
@@ -371,23 +372,24 @@ class Solver:
 
         hist = np.asarray(res.history)
         iters = int(res.iters)
-        print("           iter      residual           rate")
-        print("         --------------------------------------")
+        lines = ["           iter      residual           rate",
+                 "         --------------------------------------"]
         for i in range(min(iters, self.max_iters) + 1):
             row = hist[i]
             if np.all(np.isnan(row)):
                 continue
             r = float(np.max(row))
             if i == 0:
-                print(f"            Ini {r:18.6e}")
+                lines.append(f"            Ini {r:18.6e}")
             else:
                 prev = float(np.max(hist[i - 1]))
                 rate = r / prev if prev > 0 else 0.0
-                print(f"            {i:3d} {r:18.6e} {rate:14.4f}")
+                lines.append(f"            {i:3d} {r:18.6e} {rate:14.4f}")
         st = int(res.status)
         label = {0: "success", 1: "failed (diverged/nan)", 2: "not converged"}[st]
-        print("         --------------------------------------")
-        print(
+        lines.append("         --------------------------------------")
+        emit("\n".join(lines))
+        emit(
             f"         Total Iterations: {iters}\n"
             f"         Avg Convergence Rate: "
             f"{self._avg_rate(hist, iters):18.4f}\n"

@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""Python port of the reference benchmark CLI (examples/amgx_capi.c):
+
+    python examples/amgx_capi.py -m matrix.mtx -c config.json [-mode dDDI]
+    python examples/amgx_capi.py -p NX NY NZ -c config.json
+
+Prints setup/solve timings and the per-iteration residual table (the
+output contract of README.md:96-131).
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from amgx_tpu.api import capi
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-m", "--matrix", help="MatrixMarket file")
+    ap.add_argument("-c", "--config", required=True)
+    ap.add_argument("-p", "--poisson", nargs=3, type=int, metavar="N",
+                    help="generate NX NY NZ 7-pt Poisson instead of -m")
+    ap.add_argument("-mode", default="dDDI")
+    args = ap.parse_args()
+    if not args.matrix and not args.poisson:
+        ap.error("need -m or -p")
+
+    capi.initialize()
+    cfg = capi.config_create_from_file(args.config)
+    capi.config_add_parameters(
+        cfg, "print_solve_stats=1, obtain_timings=1, monitor_residual=1"
+    )
+    res = capi.resources_create_simple(cfg)
+    A = capi.matrix_create(res, args.mode)
+    b = capi.vector_create(res, args.mode)
+    x = capi.vector_create(res, args.mode)
+    slv = capi.solver_create(res, args.mode, cfg)
+
+    if args.poisson:
+        nx, ny, nz = args.poisson
+        capi.generate_distributed_poisson_7pt(A, b, x, nx, ny, nz)
+    else:
+        capi.read_system(A, b, x, args.matrix)
+    n, bx, _ = capi.matrix_get_size(A)
+    capi.vector_set_zero(x, n, bx)
+
+    capi.solver_setup(slv, A)
+    capi.solver_solve(slv, b, x)
+    status = capi.solver_get_status(slv)
+    capi.finalize()
+    return 0 if status == capi.SOLVE_SUCCESS else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,84 @@
+/* amgx_capi_demo.c — C host-code demo for the amgx_tpu native API
+ * (the workflow of the reference examples/amgx_capi.c: create config,
+ * resources, upload a system, solve, inspect the residual history).
+ *
+ * Usage: amgx_capi_demo <matrix.mtx> <config.json>
+ * Env:   PYTHONPATH must include the amgx_tpu repo root.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "amgx_tpu_c.h"
+
+#define CHECK(call)                                                     \
+  do {                                                                  \
+    AMGX_RC rc_ = (call);                                               \
+    if (rc_ != AMGX_RC_OK) {                                            \
+      fprintf(stderr, "error %d (%s) at %s:%d\n", rc_,                  \
+              AMGX_get_error_string(rc_), __FILE__, __LINE__);          \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <matrix.mtx> <config.json>\n", argv[0]);
+    return 2;
+  }
+  const char *mtx_path = argv[1];
+  const char *cfg_path = argv[2];
+
+  CHECK(AMGX_initialize());
+  int major, minor;
+  CHECK(AMGX_get_api_version(&major, &minor));
+  printf("amgx_tpu C API version %d.%d\n", major, minor);
+
+  AMGX_config_handle cfg;
+  CHECK(AMGX_config_create_from_file(&cfg, cfg_path));
+  AMGX_resources_handle res;
+  CHECK(AMGX_resources_create_simple(&res, cfg));
+
+  AMGX_matrix_handle A;
+  AMGX_vector_handle b, x;
+  AMGX_solver_handle solver;
+  CHECK(AMGX_matrix_create(&A, res, "dDDI"));
+  CHECK(AMGX_vector_create(&b, res, "dDDI"));
+  CHECK(AMGX_vector_create(&x, res, "dDDI"));
+  CHECK(AMGX_solver_create(&solver, res, "dDDI", cfg));
+
+  CHECK(AMGX_read_system(A, b, x, mtx_path));
+  int n, bx, by;
+  CHECK(AMGX_matrix_get_size(A, &n, &bx, &by));
+  printf("system: %d rows, block %dx%d\n", n, bx, by);
+  CHECK(AMGX_vector_set_zero(x, n, bx));
+
+  CHECK(AMGX_solver_setup(solver, A));
+  CHECK(AMGX_solver_solve(solver, b, x));
+
+  AMGX_SOLVE_STATUS st;
+  int iters;
+  CHECK(AMGX_solver_get_status(solver, &st));
+  CHECK(AMGX_solver_get_iterations_number(solver, &iters));
+  double res0, resn;
+  CHECK(AMGX_solver_get_iteration_residual(solver, 0, 0, &res0));
+  CHECK(AMGX_solver_get_iteration_residual(solver, iters, 0, &resn));
+  printf("status=%d iterations=%d residual %.3e -> %.3e\n", (int)st,
+         iters, res0, resn);
+
+  double *sol = (double *)malloc(sizeof(double) * (size_t)n * bx);
+  CHECK(AMGX_vector_download(x, sol));
+  printf("x[0..3] = %.6f %.6f %.6f %.6f\n", sol[0], sol[1], sol[2],
+         sol[3]);
+  free(sol);
+
+  CHECK(AMGX_solver_destroy(solver));
+  CHECK(AMGX_vector_destroy(x));
+  CHECK(AMGX_vector_destroy(b));
+  CHECK(AMGX_matrix_destroy(A));
+  CHECK(AMGX_resources_destroy(res));
+  CHECK(AMGX_config_destroy(cfg));
+  CHECK(AMGX_finalize());
+  printf("done\n");
+  return (st == AMGX_SOLVE_SUCCESS) ? 0 : 1;
+}

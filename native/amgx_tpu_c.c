@@ -1,0 +1,518 @@
+/* amgx_tpu_c.c — native C implementation of the AMGX-compatible API.
+ *
+ * Strategy: embed the CPython runtime and dispatch into
+ * amgx_tpu.api.capi (the handle layer).  Arrays cross the boundary as
+ * PyBytes copies sized by the mode's dtypes (itemsizes queried from the
+ * Python mode table at create time — single source of truth); results
+ * come back through the buffer protocol.  Exceptions carry an .rc
+ * attribute converted to the AMGX_RC return code (the reference does the
+ * same with AMGX_TRIES/AMGX_CATCHES, amgx_c.cu).
+ *
+ * Threading: every entry point takes the GIL via PyGILState_Ensure, so
+ * host apps may call from any thread (AMGX permits this); after
+ * initialization the main thread releases its thread state.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+#include <stdio.h>
+
+#include "amgx_tpu_c.h"
+
+static PyObject *g_capi = NULL; /* amgx_tpu.api.capi module */
+static PyThreadState *g_saved_ts = NULL;
+
+/* per-handle dtype bookkeeping so upload/download can size buffers */
+#define MAX_TRACKED 65536
+static struct {
+  uintptr_t handle;
+  size_t mat_size;
+  size_t vec_size;
+  int block_size;
+} g_modes[MAX_TRACKED];
+static int g_mode_count = 0;
+
+static int track_handle(uintptr_t h, size_t mat_size, size_t vec_size) {
+  if (g_mode_count >= MAX_TRACKED) return 0;
+  g_modes[g_mode_count].handle = h;
+  g_modes[g_mode_count].mat_size = mat_size;
+  g_modes[g_mode_count].vec_size = vec_size;
+  g_modes[g_mode_count].block_size = 1;
+  g_mode_count++;
+  return 1;
+}
+
+static int handle_entry(uintptr_t h) {
+  for (int i = 0; i < g_mode_count; ++i)
+    if (g_modes[i].handle == h) return i;
+  return -1;
+}
+
+static void untrack_handle(uintptr_t h) {
+  int i = handle_entry(h);
+  if (i >= 0) {
+    g_modes[i] = g_modes[g_mode_count - 1];
+    g_mode_count--;
+  }
+}
+
+/* Convert a pending Python exception to an AMGX_RC (GIL held). */
+static AMGX_RC rc_from_exception(void) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  AMGX_RC rc = AMGX_RC_UNKNOWN;
+  if (value) {
+    PyObject *rc_attr = PyObject_GetAttrString(value, "rc");
+    if (rc_attr) {
+      long v = PyLong_AsLong(rc_attr);
+      if (v >= 0 && v <= AMGX_RC_INTERNAL) rc = (AMGX_RC)v;
+      Py_DECREF(rc_attr);
+    } else {
+      PyErr_Clear();
+      PyObject *s = PyObject_Str(value);
+      if (s) {
+        fprintf(stderr, "amgx_tpu_c: %s\n", PyUnicode_AsUTF8(s));
+        Py_DECREF(s);
+      }
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return rc;
+}
+
+/* Call capi.<fn>(args...) (GIL held).  Consumes args (which may be NULL
+ * from a failed Py_BuildValue — detected and propagated). */
+static PyObject *capi_call(const char *fn, PyObject *args, int had_args) {
+  if (had_args && !args) return NULL; /* Py_BuildValue failed */
+  if (!g_capi) {
+    Py_XDECREF(args);
+    PyErr_SetString(PyExc_RuntimeError, "AMGX_initialize not called");
+    return NULL;
+  }
+  PyObject *f = PyObject_GetAttrString(g_capi, fn);
+  if (!f) {
+    Py_XDECREF(args);
+    return NULL;
+  }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  return r;
+}
+
+/* GIL-wrapped call returning only an RC. */
+static AMGX_RC call_rc(const char *fn, PyObject *args, int had_args) {
+  PyObject *r = capi_call(fn, args, had_args);
+  AMGX_RC rc = AMGX_RC_OK;
+  if (!r)
+    rc = rc_from_exception();
+  else
+    Py_DECREF(r);
+  return rc;
+}
+
+#define ENTER() PyGILState_STATE gst_ = PyGILState_Ensure()
+/* evaluate the return expression BEFORE releasing the GIL — arguments
+ * routinely call PyErr_Occurred()/rc_from_exception() */
+#define LEAVE_RET(rc)           \
+  do {                          \
+    AMGX_RC rc_eval_ = (rc);    \
+    PyGILState_Release(gst_);   \
+    return rc_eval_;            \
+  } while (0)
+
+/* ------------------------------------------------------------------ */
+
+AMGX_RC AMGX_initialize(void) {
+  if (!Py_IsInitialized()) {
+    Py_Initialize();
+    PyObject *mod = PyImport_ImportModule("amgx_tpu.api.capi");
+    if (!mod) {
+      PyErr_Print();
+      return AMGX_RC_CORE;
+    }
+    g_capi = mod;
+    AMGX_RC rc = call_rc("initialize", NULL, 0);
+    /* release the main thread state so other host threads can enter via
+     * PyGILState_Ensure */
+    g_saved_ts = PyEval_SaveThread();
+    return rc;
+  }
+  ENTER();
+  if (!g_capi) {
+    PyObject *mod = PyImport_ImportModule("amgx_tpu.api.capi");
+    if (!mod) LEAVE_RET(AMGX_RC_CORE);
+    g_capi = mod;
+  }
+  AMGX_RC rc = call_rc("initialize", NULL, 0);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_finalize(void) {
+  ENTER();
+  AMGX_RC rc = AMGX_RC_OK;
+  if (g_capi) {
+    rc = call_rc("finalize", NULL, 0);
+    Py_CLEAR(g_capi);
+  }
+  g_mode_count = 0;
+  /* The embedded interpreter stays alive: jax runtimes do not survive
+   * re-initialization, and the process is about to exit anyway. */
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_get_api_version(int *major, int *minor) {
+  ENTER();
+  PyObject *r = capi_call("get_api_version", NULL, 0);
+  if (!r) LEAVE_RET(rc_from_exception());
+  int ok = PyArg_ParseTuple(r, "ii", major, minor);
+  Py_DECREF(r);
+  LEAVE_RET(ok ? AMGX_RC_OK : rc_from_exception());
+}
+
+const char *AMGX_get_error_string(AMGX_RC rc) {
+  switch (rc) {
+    case AMGX_RC_OK: return "success";
+    case AMGX_RC_BAD_PARAMETERS: return "bad parameters";
+    case AMGX_RC_IO_ERROR: return "I/O error";
+    case AMGX_RC_BAD_MODE: return "bad mode";
+    case AMGX_RC_BAD_CONFIGURATION: return "bad configuration";
+    case AMGX_RC_NOT_IMPLEMENTED: return "not implemented";
+    default: return "error";
+  }
+}
+
+AMGX_RC AMGX_config_create(AMGX_config_handle *cfg, const char *options) {
+  ENTER();
+  PyObject *r =
+      capi_call("config_create", Py_BuildValue("(s)", options), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  *cfg = (uintptr_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  LEAVE_RET(PyErr_Occurred() ? rc_from_exception() : AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_config_create_from_file(AMGX_config_handle *cfg,
+                                     const char *path) {
+  ENTER();
+  PyObject *r =
+      capi_call("config_create_from_file", Py_BuildValue("(s)", path), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  *cfg = (uintptr_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  LEAVE_RET(PyErr_Occurred() ? rc_from_exception() : AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_config_add_parameters(AMGX_config_handle cfg,
+                                   const char *options) {
+  ENTER();
+  AMGX_RC rc = call_rc(
+      "config_add_parameters",
+      Py_BuildValue("(Ks)", (unsigned long long)cfg, options), 1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_config_destroy(AMGX_config_handle cfg) {
+  ENTER();
+  AMGX_RC rc = call_rc("config_destroy",
+                       Py_BuildValue("(K)", (unsigned long long)cfg), 1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_resources_create_simple(AMGX_resources_handle *res,
+                                     AMGX_config_handle cfg) {
+  ENTER();
+  PyObject *r = capi_call("resources_create_simple",
+                          Py_BuildValue("(K)", (unsigned long long)cfg), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  *res = (uintptr_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  LEAVE_RET(PyErr_Occurred() ? rc_from_exception() : AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_resources_destroy(AMGX_resources_handle res) {
+  ENTER();
+  AMGX_RC rc = call_rc("resources_destroy",
+                       Py_BuildValue("(K)", (unsigned long long)res), 1);
+  LEAVE_RET(rc);
+}
+
+/* Create a mode-carrying object and record its dtype itemsizes (queried
+ * from Python — single source of truth). */
+static AMGX_RC create_with_mode(const char *pyfn, uintptr_t first_arg,
+                                const char *mode, uintptr_t extra_cfg,
+                                int has_cfg, uintptr_t *out) {
+  PyObject *args =
+      has_cfg ? Py_BuildValue("(KsK)", (unsigned long long)first_arg, mode,
+                              (unsigned long long)extra_cfg)
+              : Py_BuildValue("(Ks)", (unsigned long long)first_arg, mode);
+  PyObject *r = capi_call(pyfn, args, 1);
+  if (!r) return rc_from_exception();
+  *out = (uintptr_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  if (PyErr_Occurred()) return rc_from_exception();
+  PyObject *sz =
+      capi_call("mode_itemsizes", Py_BuildValue("(s)", mode), 1);
+  if (!sz) return rc_from_exception();
+  int mat_s, vec_s;
+  int ok = PyArg_ParseTuple(sz, "ii", &mat_s, &vec_s);
+  Py_DECREF(sz);
+  if (!ok) return rc_from_exception();
+  if (!track_handle(*out, (size_t)mat_s, (size_t)vec_s))
+    return AMGX_RC_INTERNAL;
+  return AMGX_RC_OK;
+}
+
+AMGX_RC AMGX_matrix_create(AMGX_matrix_handle *mtx,
+                           AMGX_resources_handle res, const char *mode) {
+  ENTER();
+  AMGX_RC rc = create_with_mode("matrix_create", res, mode, 0, 0, mtx);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_matrix_upload_all(AMGX_matrix_handle mtx, int n, int nnz,
+                               int block_dimx, int block_dimy,
+                               const int *row_ptrs, const int *col_indices,
+                               const void *data, const void *diag_data) {
+  ENTER();
+  int e = handle_entry(mtx);
+  if (e < 0) LEAVE_RET(AMGX_RC_BAD_PARAMETERS);
+  size_t msz = g_modes[e].mat_size;
+  size_t vsz = msz * (size_t)nnz * block_dimx * block_dimy;
+  size_t dsz = msz * (size_t)n * block_dimx * block_dimy;
+  PyObject *diag = diag_data
+                       ? PyBytes_FromStringAndSize((const char *)diag_data,
+                                                   (Py_ssize_t)dsz)
+                       : (Py_INCREF(Py_None), Py_None);
+  AMGX_RC rc = call_rc(
+      "matrix_upload_all",
+      Py_BuildValue(
+          "(Kiiiiy#y#y#N)", (unsigned long long)mtx, n, nnz, block_dimx,
+          block_dimy, (const char *)row_ptrs,
+          (Py_ssize_t)(sizeof(int) * (size_t)(n + 1)),
+          (const char *)col_indices,
+          (Py_ssize_t)(sizeof(int) * (size_t)nnz), (const char *)data,
+          (Py_ssize_t)vsz, diag),
+      1);
+  if (rc == AMGX_RC_OK) g_modes[handle_entry(mtx)].block_size = block_dimx;
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_matrix_replace_coefficients(AMGX_matrix_handle mtx, int n,
+                                         int nnz, const void *data,
+                                         const void *diag_data) {
+  ENTER();
+  int e = handle_entry(mtx);
+  if (e < 0) LEAVE_RET(AMGX_RC_BAD_PARAMETERS);
+  if (diag_data) LEAVE_RET(AMGX_RC_NOT_IMPLEMENTED);
+  int bs = g_modes[e].block_size;
+  size_t vsz = g_modes[e].mat_size * (size_t)nnz * bs * bs;
+  AMGX_RC rc = call_rc(
+      "matrix_replace_coefficients",
+      Py_BuildValue("(Kiiy#)", (unsigned long long)mtx, n, nnz,
+                    (const char *)data, (Py_ssize_t)vsz),
+      1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_matrix_get_size(AMGX_matrix_handle mtx, int *n,
+                             int *block_dimx, int *block_dimy) {
+  ENTER();
+  PyObject *r = capi_call("matrix_get_size",
+                          Py_BuildValue("(K)", (unsigned long long)mtx), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  int ok = PyArg_ParseTuple(r, "iii", n, block_dimx, block_dimy);
+  Py_DECREF(r);
+  LEAVE_RET(ok ? AMGX_RC_OK : rc_from_exception());
+}
+
+AMGX_RC AMGX_matrix_destroy(AMGX_matrix_handle mtx) {
+  ENTER();
+  AMGX_RC rc = call_rc("matrix_destroy",
+                       Py_BuildValue("(K)", (unsigned long long)mtx), 1);
+  untrack_handle(mtx);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_vector_create(AMGX_vector_handle *vec,
+                           AMGX_resources_handle res, const char *mode) {
+  ENTER();
+  AMGX_RC rc = create_with_mode("vector_create", res, mode, 0, 0, vec);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_vector_upload(AMGX_vector_handle vec, int n, int block_dim,
+                           const void *data) {
+  ENTER();
+  int e = handle_entry(vec);
+  if (e < 0) LEAVE_RET(AMGX_RC_BAD_PARAMETERS);
+  size_t sz = g_modes[e].vec_size * (size_t)n * block_dim;
+  AMGX_RC rc = call_rc(
+      "vector_upload",
+      Py_BuildValue("(Kiiy#)", (unsigned long long)vec, n, block_dim,
+                    (const char *)data, (Py_ssize_t)sz),
+      1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_vector_download(AMGX_vector_handle vec, void *data) {
+  ENTER();
+  PyObject *r = capi_call("vector_download",
+                          Py_BuildValue("(K)", (unsigned long long)vec), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  Py_buffer view;
+  if (PyObject_GetBuffer(r, &view, PyBUF_CONTIG_RO) != 0) {
+    Py_DECREF(r);
+    LEAVE_RET(rc_from_exception());
+  }
+  memcpy(data, view.buf, (size_t)view.len);
+  PyBuffer_Release(&view);
+  Py_DECREF(r);
+  LEAVE_RET(AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_vector_set_zero(AMGX_vector_handle vec, int n,
+                             int block_dim) {
+  ENTER();
+  AMGX_RC rc = call_rc("vector_set_zero",
+                       Py_BuildValue("(Kii)", (unsigned long long)vec, n,
+                                     block_dim),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_vector_bind(AMGX_vector_handle vec, AMGX_matrix_handle mtx) {
+  ENTER();
+  AMGX_RC rc = call_rc("vector_bind",
+                       Py_BuildValue("(KK)", (unsigned long long)vec,
+                                     (unsigned long long)mtx),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_vector_get_size(AMGX_vector_handle vec, int *n,
+                             int *block_dim) {
+  ENTER();
+  PyObject *r = capi_call("vector_get_size",
+                          Py_BuildValue("(K)", (unsigned long long)vec), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  int ok = PyArg_ParseTuple(r, "ii", n, block_dim);
+  Py_DECREF(r);
+  LEAVE_RET(ok ? AMGX_RC_OK : rc_from_exception());
+}
+
+AMGX_RC AMGX_vector_destroy(AMGX_vector_handle vec) {
+  ENTER();
+  AMGX_RC rc = call_rc("vector_destroy",
+                       Py_BuildValue("(K)", (unsigned long long)vec), 1);
+  untrack_handle(vec);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_solver_create(AMGX_solver_handle *slv,
+                           AMGX_resources_handle res, const char *mode,
+                           AMGX_config_handle cfg) {
+  ENTER();
+  AMGX_RC rc = create_with_mode("solver_create", res, mode, cfg, 1, slv);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_solver_setup(AMGX_solver_handle slv, AMGX_matrix_handle mtx) {
+  ENTER();
+  AMGX_RC rc = call_rc("solver_setup",
+                       Py_BuildValue("(KK)", (unsigned long long)slv,
+                                     (unsigned long long)mtx),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_solver_solve(AMGX_solver_handle slv, AMGX_vector_handle rhs,
+                          AMGX_vector_handle sol) {
+  ENTER();
+  AMGX_RC rc = call_rc("solver_solve",
+                       Py_BuildValue("(KKK)", (unsigned long long)slv,
+                                     (unsigned long long)rhs,
+                                     (unsigned long long)sol),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_solver_solve_with_0_initial_guess(AMGX_solver_handle slv,
+                                               AMGX_vector_handle rhs,
+                                               AMGX_vector_handle sol) {
+  ENTER();
+  AMGX_RC rc = call_rc("solver_solve_with_0_initial_guess",
+                       Py_BuildValue("(KKK)", (unsigned long long)slv,
+                                     (unsigned long long)rhs,
+                                     (unsigned long long)sol),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_solver_get_status(AMGX_solver_handle slv,
+                               AMGX_SOLVE_STATUS *status) {
+  ENTER();
+  PyObject *r = capi_call("solver_get_status",
+                          Py_BuildValue("(K)", (unsigned long long)slv), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  *status = (AMGX_SOLVE_STATUS)PyLong_AsLong(r);
+  Py_DECREF(r);
+  LEAVE_RET(AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_solver_get_iterations_number(AMGX_solver_handle slv,
+                                          int *n) {
+  ENTER();
+  PyObject *r =
+      capi_call("solver_get_iterations_number",
+                Py_BuildValue("(K)", (unsigned long long)slv), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  *n = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  LEAVE_RET(AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_solver_get_iteration_residual(AMGX_solver_handle slv, int it,
+                                           int idx, double *res) {
+  ENTER();
+  PyObject *r = capi_call(
+      "solver_get_iteration_residual",
+      Py_BuildValue("(Kii)", (unsigned long long)slv, it, idx), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  *res = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  LEAVE_RET(AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_solver_destroy(AMGX_solver_handle slv) {
+  ENTER();
+  AMGX_RC rc = call_rc("solver_destroy",
+                       Py_BuildValue("(K)", (unsigned long long)slv), 1);
+  untrack_handle(slv);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_read_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
+                         AMGX_vector_handle sol, const char *filename) {
+  ENTER();
+  AMGX_RC rc = call_rc("read_system",
+                       Py_BuildValue("(KKKs)", (unsigned long long)mtx,
+                                     (unsigned long long)rhs,
+                                     (unsigned long long)sol, filename),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_write_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
+                          AMGX_vector_handle sol, const char *filename) {
+  ENTER();
+  AMGX_RC rc = call_rc("write_system",
+                       Py_BuildValue("(KKKs)", (unsigned long long)mtx,
+                                     (unsigned long long)rhs,
+                                     (unsigned long long)sol, filename),
+                       1);
+  LEAVE_RET(rc);
+}

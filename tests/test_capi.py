@@ -1,0 +1,166 @@
+"""C-API handle-layer tests (reference src/tests/capi_upload_tests.cu,
+capi_graceful_failure.cu, object_destruction.cu, version_test.cu)."""
+
+import numpy as np
+import pytest
+
+from amgx_tpu.api import capi
+from amgx_tpu.io.poisson import poisson_scipy
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    capi.initialize()
+    yield
+    capi.finalize()
+
+
+CFG = (
+    '{"config_version": 2, "solver": {"scope": "main", "solver": "PCG",'
+    ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+    ' "tolerance": 1e-08, "max_iters": 300,'
+    ' "preconditioner": {"scope": "p", "solver": "BLOCK_JACOBI",'
+    ' "max_iters": 2, "monitor_residual": 0}}}'
+)
+
+
+def _upload_poisson(res, mode="dDDI", n_side=10):
+    sp = poisson_scipy((n_side, n_side)).tocsr()
+    sp.sort_indices()
+    A = capi.matrix_create(res, mode)
+    capi.matrix_upload_all(
+        A,
+        sp.shape[0],
+        sp.nnz,
+        1,
+        1,
+        sp.indptr.astype(np.int32),
+        sp.indices.astype(np.int32),
+        sp.data,
+    )
+    return A, sp
+
+
+def test_version():
+    assert capi.get_api_version() == (2, 5)
+
+
+def test_full_solve_flow():
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    A, sp = _upload_poisson(res)
+    n = sp.shape[0]
+    rng = np.random.default_rng(0)
+    bvec = rng.standard_normal(n)
+    b = capi.vector_create(res, "dDDI")
+    x = capi.vector_create(res, "dDDI")
+    capi.vector_upload(b, n, 1, bvec)
+    capi.vector_set_zero(x, n, 1)
+    slv = capi.solver_create(res, "dDDI", cfg)
+    capi.solver_setup(slv, A)
+    capi.solver_solve(slv, b, x)
+    assert capi.solver_get_status(slv) == capi.SOLVE_SUCCESS
+    iters = capi.solver_get_iterations_number(slv)
+    assert 0 < iters < 300
+    r0 = capi.solver_get_iteration_residual(slv, 0)
+    rn = capi.solver_get_iteration_residual(slv, iters)
+    assert rn < 1e-7 * r0
+    sol = capi.vector_download(x)
+    rel = np.linalg.norm(bvec - sp @ sol) / np.linalg.norm(bvec)
+    assert rel < 1e-7
+    for h in (slv, x, b, A, res, cfg):
+        pass  # destroyed by finalize
+
+
+def test_upload_bytes_buffers():
+    """The C shim passes raw bytes; verify the byte path end-to-end."""
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    sp = poisson_scipy((8, 8)).tocsr()
+    A = capi.matrix_create(res, "dDDI")
+    capi.matrix_upload_all(
+        A,
+        sp.shape[0],
+        sp.nnz,
+        1,
+        1,
+        sp.indptr.astype(np.int32).tobytes(),
+        sp.indices.astype(np.int32).tobytes(),
+        sp.data.astype(np.float64).tobytes(),
+    )
+    n, bx, by = capi.matrix_get_size(A)
+    assert (n, bx, by) == (64, 1, 1)
+
+
+def test_replace_coefficients():
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    A, sp = _upload_poisson(res)
+    capi.matrix_replace_coefficients(A, sp.shape[0], sp.nnz, sp.data * 2)
+    sym, num = capi.matrix_check_symmetry(A)
+    assert sym == 1 and num == 1
+
+
+def test_graceful_failures():
+    with pytest.raises(capi.AMGXError) as e:
+        capi.config_create("not json and not k=v")
+    assert e.value.rc == capi.RC_BAD_CONFIGURATION
+    with pytest.raises(capi.AMGXError) as e:
+        capi.matrix_create(999999)
+    assert e.value.rc == capi.RC_BAD_PARAMETERS
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    with pytest.raises(capi.AMGXError) as e:
+        capi.matrix_create(res, "xQQQ")
+    assert e.value.rc == capi.RC_BAD_MODE
+    slv = capi.solver_create(res, "dDDI", cfg)
+    b = capi.vector_create(res, "dDDI")
+    with pytest.raises(capi.AMGXError):
+        capi.solver_solve(slv, b, b)  # not set up
+    with pytest.raises(capi.AMGXError) as e:
+        capi.config_create_from_file("/does/not/exist.json")
+    assert e.value.rc == capi.RC_IO_ERROR
+
+
+def test_read_write_system(tmp_path):
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    A, sp = _upload_poisson(res)
+    b = capi.vector_create(res, "dDDI")
+    capi.vector_upload(b, sp.shape[0], 1, np.ones(sp.shape[0]))
+    path = str(tmp_path / "out.mtx")
+    capi.write_system(A, b, 0, path)
+    A2 = capi.matrix_create(res, "dDDI")
+    b2 = capi.vector_create(res, "dDDI")
+    capi.read_system(A2, b2, 0, path)
+    n, _, _ = capi.matrix_get_size(A2)
+    assert n == sp.shape[0]
+    np.testing.assert_allclose(capi.vector_download(b2), 1.0)
+
+
+def test_mode_dFFI():
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    A, sp = _upload_poisson(res, mode="dFFI")
+    slv = capi.solver_create(res, "dFFI", cfg)
+    capi.solver_setup(slv, A)
+    b = capi.vector_create(res, "dFFI")
+    x = capi.vector_create(res, "dFFI")
+    n = sp.shape[0]
+    capi.vector_upload(b, n, 1, np.ones(n, np.float32))
+    capi.vector_set_zero(x, n, 1)
+    capi.solver_solve(slv, b, x)
+    sol = capi.vector_download(x)
+    assert sol.dtype == np.float32
+    rel = np.linalg.norm(np.ones(n) - sp @ sol) / np.sqrt(n)
+    assert rel < 1e-4
+
+
+def test_generate_poisson():
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    A = capi.matrix_create(res, "dDDI")
+    b = capi.vector_create(res, "dDDI")
+    capi.generate_distributed_poisson_7pt(A, b, 0, 6, 6, 6)
+    n, _, _ = capi.matrix_get_size(A)
+    assert n == 216
