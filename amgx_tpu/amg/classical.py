@@ -3,10 +3,11 @@ strength of connection (strength/ahat), PMIS/HMIS C/F selection
 (selectors/pmis.cu), direct distance-1 interpolation (interpolators/
 distance1.cu) with truncation, Galerkin RAP.
 
-Host-side setup (numpy/scipy) with deterministic hashes — the reference's
-determinism_flag path.  Interpolators: D1 (direct) and D2 (standard,
-distance-2); unknown interpolator names fall back to D2 with a warning.
-Aggressive coarsening and true multipass interpolation are still pending.
+Host-side setup (numpy/scipy) with deterministic hashes (determinism is
+structural here — no GPU races, SURVEY §5.2).  Interpolators: D1
+(direct), D2 (standard distance-2, sign-restricted redistribution) and
+MULTIPASS; selectors PMIS and two-stage aggressive PMIS
+(aggressive_levels); unknown names fall back with a warning.
 """
 
 from __future__ import annotations
@@ -77,15 +78,21 @@ def _hash_weights(n: int, seed: int = 0x9E3779B9) -> np.ndarray:
     return (z % np.uint64(1 << 30)).astype(np.float64) / float(1 << 30)
 
 
-def pmis_select(S: sps.csr_matrix, deterministic: bool = True,
-                seed: int = 0) -> np.ndarray:
+def pmis_select(S: sps.csr_matrix, seed: int = 0) -> np.ndarray:
     """PMIS C/F splitting (reference selectors/pmis.cu): parallel MIS on
     the symmetrized strength graph with weights = strong-transpose-degree
-    + hash.  Returns int8 array: 1 = coarse, 0 = fine."""
+    + hash.  Returns int8 array: 1 = coarse, 0 = fine.
+
+    Always deterministic: the hash weights are reproducible for a fixed
+    seed, so the reference's determinism_flag distinction (deterministic
+    vs GPU-race-dependent selection) does not arise here (SURVEY §5.2:
+    determinism is structural on TPU)."""
     n = S.shape[0]
     Ssym = ((S + S.T) > 0).astype(np.int8).tocsr()
     lam = np.asarray(S.T.sum(axis=1)).ravel().astype(np.float64)
-    rnd = _hash_weights(n, seed=0 if deterministic else seed)
+    # hash weights are deterministic for a fixed seed either way; the
+    # seed distinguishes independent selection stages
+    rnd = _hash_weights(n, seed=seed)
     w = lam + rnd
     state = np.zeros(n, dtype=np.int8)  # 0 undecided, 1 C, -1 F
     # isolated vertices (no strong links at all) become fine points handled
@@ -110,6 +117,83 @@ def pmis_select(S: sps.csr_matrix, deterministic: bool = True,
         state[(state == 0) & cnb] = -1
     state[state == 0] = 1  # leftovers become coarse
     return (state == 1).astype(np.int8)
+
+
+def aggressive_pmis_select(S: sps.csr_matrix) -> np.ndarray:
+    """Two-stage aggressive coarsening (reference selectors
+    AGGRESSIVE_PMIS/AGGRESSIVE_HMIS): PMIS on S, then a second PMIS among
+    the stage-1 C points on the distance-2 strength graph S + S@S."""
+    cf1 = pmis_select(S)
+    c_idx = np.nonzero(cf1 == 1)[0]
+    if c_idx.size <= 1:
+        return cf1
+    Sb = S.astype(bool).astype(np.int8)
+    S2 = ((Sb + Sb @ Sb) > 0).astype(np.int8).tocsr()
+    Sc = S2[c_idx][:, c_idx].tocsr()
+    Sc.setdiag(0)
+    Sc.eliminate_zeros()
+    cf2 = pmis_select(Sc, seed=1)
+    cf = np.zeros_like(cf1)
+    cf[c_idx[cf2 == 1]] = 1
+    return cf
+
+
+def multipass_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
+                            cf: np.ndarray,
+                            max_passes: int = 10) -> sps.csr_matrix:
+    """Multipass interpolation (reference interpolators/multipass.cu) for
+    aggressive coarsening, where F points may lack direct strong C
+    neighbours: in pass k, F points with strong *assigned* neighbours
+    (C points or previously assigned F points) interpolate through their
+    neighbours' interpolation rows:
+
+        P_i = -(1/ã_ii) * sum_{j strong, assigned} a_ij * P_j
+        ã_ii = a_ii + sum over non-interpolatory neighbours a_ik
+    """
+    n = Asp.shape[0]
+    nc = int(cf.sum())
+    cmap = np.cumsum(cf) - 1
+    Sb = S.astype(bool)
+    A_strong = Asp.multiply(Sb).tocsr()
+    A_strong.setdiag(0.0)
+    A_strong.eliminate_zeros()
+
+    assigned = cf == 1
+    c_rows = np.nonzero(assigned)[0]
+    P = sps.csr_matrix(
+        (np.ones(nc), (c_rows, cmap[c_rows])), shape=(n, nc)
+    )
+
+    diag = Asp.diagonal().astype(np.float64)
+    row_total = np.asarray(Asp.sum(axis=1)).ravel() - diag
+
+    for _ in range(max_passes):
+        un = ~assigned
+        if not un.any():
+            break
+        # unassigned rows whose strong-assigned pattern is nonzero
+        pat = (abs(A_strong) @ assigned.astype(np.float64)) > 0
+        ready = un & pat
+        if not ready.any():
+            break
+        ridx = np.nonzero(ready)[0]
+        # work proportional to the newly-ready rows only
+        A_r = A_strong[ridx]
+        A_sa = (A_r @ sps.diags_array(assigned.astype(np.float64))
+                ).tocsr()
+        strong_sum = np.asarray(A_sa.sum(axis=1)).ravel()
+        atil = diag[ridx] + (row_total[ridx] - strong_sum)
+        atil = np.where(atil != 0, atil, 1.0)
+        W = sps.diags_array(-1.0 / atil) @ A_sa @ P
+        Wcoo = W.tocoo()
+        P = (P + sps.csr_matrix(
+            (Wcoo.data, (ridx[Wcoo.row], Wcoo.col)), shape=(n, nc)
+        )).tocsr()
+        assigned = assigned.copy()
+        assigned[ridx] = True
+    P.sum_duplicates()
+    P.sort_indices()
+    return P
 
 
 def direct_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
@@ -235,21 +319,39 @@ def standard_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
     SFFb = (AsFF != 0).astype(np.float64)
     T = ((SFCb + SFFb @ SFCb) != 0).astype(np.float64).tocsr()
 
-    # denominators d_ik on the S_FF pattern: row k of A_FC dotted with
-    # T row i  ->  sample E = (A_FC @ T^T)^T at S_FF entries
-    E = (T @ A_FC.T).tocsr()                     # E[i,k] = d_ik
+    # redistribution uses only entries opposite in sign to the row's
+    # diagonal (hypre-style sign restriction): positive off-diagonals in
+    # coarse Galerkin operators otherwise produce wrong-signed weights
+    # and non-convergent coarse smoothers
+    diag_all = Asp.diagonal()
+    fc = A_FC.tocoo()
+    keep_neg = fc.data * diag_all[fidx][fc.row] < 0
+    A_FC_neg = sps.csr_matrix(
+        (np.where(keep_neg, fc.data, 0.0), (fc.row, fc.col)),
+        shape=A_FC.shape,
+    )
+    A_FC_neg.eliminate_zeros()
+
+    # denominators d_ik on the S_FF pattern: row k of A_FC_neg dotted
+    # with T row i  ->  sample E = (A_FC_neg @ T^T)^T at S_FF entries
+    E = (T @ A_FC_neg.T).tocsr()                 # E[i,k] = d_ik
     D = SFFb.multiply(E).tocsr()                 # masked to F_i^s edges
 
     sff = AsFF.tocoo()
-    # align D entries with AsFF entries via dense-keyed lookup on rows
-    Dcsr = D.tocsr()
-    d_vals = np.asarray(Dcsr[sff.row, sff.col]).ravel()
-    with np.errstate(divide="ignore", invalid="ignore"):
-        b_vals = np.where(d_vals != 0, sff.data / d_vals, 0.0)
-    B = sps.csr_matrix((b_vals, (sff.row, sff.col)), shape=(nf, nf))
+    if sff.nnz:
+        # align D entries with AsFF entries via fancy-index lookup
+        Dcsr = D.tocsr()
+        d_vals = np.asarray(Dcsr[sff.row, sff.col]).ravel()
+        with np.errstate(divide="ignore", invalid="ignore"):
+            b_vals = np.where(d_vals != 0, sff.data / d_vals, 0.0)
+        B = sps.csr_matrix((b_vals, (sff.row, sff.col)), shape=(nf, nf))
+    else:
+        # no strong F-F links (e.g. after aggressive first stage)
+        d_vals = np.zeros(0)
+        B = sps.csr_matrix((nf, nf))
 
-    # numerator: (A^s_FC + B @ A_FC) masked to the extended pattern
-    Wnum = (AsFC + B @ A_FC).multiply(T).tocsr()
+    # numerator: (A^s_FC + B @ A_FC_neg) masked to the extended pattern
+    Wnum = (AsFC + B @ A_FC_neg).multiply(T).tocsr()
 
     # modified diagonal: a_ii + weak row sum + undistributable strong F
     diag = Asp.diagonal()[fidx]
@@ -325,37 +427,55 @@ def truncate_interp(P: sps.csr_matrix, trunc_factor: float,
     return Pt
 
 
-def build_classical_level(Asp, cfg, scope):
+def build_classical_level(Asp, cfg, scope, level_id: int = 0):
     """One classical level: S -> C/F -> P -> R=P^T -> RAP (reference
-    classical_amg_level.cu:213-489)."""
+    classical_amg_level.cu:213-489).  Levels below ``aggressive_levels``
+    use two-stage aggressive coarsening with the aggressive interpolator
+    (MULTIPASS default), reference amg_level setup."""
     theta = float(cfg.get("strength_threshold", scope))
     max_row_sum = float(cfg.get("max_row_sum", scope))
     strength = str(cfg.get("strength", scope)).upper()
     selector = str(cfg.get("selector", scope)).upper()
     interp = str(cfg.get("interpolator", scope)).upper()
-    deterministic = bool(cfg.get("determinism_flag", scope))
     trunc = float(cfg.get("interp_truncation_factor", scope))
     max_el = int(cfg.get("interp_max_elements", scope))
+    aggressive_levels = int(cfg.get("aggressive_levels", scope))
+    aggressive_interp = str(
+        cfg.get("aggressive_interpolator", scope)
+    ).upper()
 
     if strength == "ALL":
         S = strength_all(Asp)
     else:  # AHAT default; AFFINITY TBD
         S = strength_ahat(Asp, theta, max_row_sum)
 
+    aggressive = (
+        level_id < aggressive_levels
+        or selector in ("AGGRESSIVE_PMIS", "AGGRESSIVE_HMIS")
+    )
     if selector not in ("PMIS", "HMIS", "AGGRESSIVE_PMIS",
                         "AGGRESSIVE_HMIS", "RS", "CR", "DUMMY"):
         warnings.warn(f"selector {selector}: using PMIS")
-    cf = pmis_select(S, deterministic)
-
-    if interp == "D1":
-        P = direct_interpolation(Asp, S, cf)
-    elif interp in ("D2", "STD", "STANDARD"):
-        P = standard_interpolation(Asp, S, cf)
+    if aggressive:
+        cf = aggressive_pmis_select(S)
+        if aggressive_interp != "MULTIPASS":
+            warnings.warn(
+                f"aggressive interpolator {aggressive_interp}: "
+                "using MULTIPASS"
+            )
+        P = multipass_interpolation(Asp, S, cf)
     else:
-        warnings.warn(
-            f"interpolator {interp} not yet implemented; using D2 standard"
-        )
-        P = standard_interpolation(Asp, S, cf)
+        cf = pmis_select(S)
+        if interp == "D1":
+            P = direct_interpolation(Asp, S, cf)
+        elif interp in ("D2", "STD", "STANDARD"):
+            P = standard_interpolation(Asp, S, cf)
+        else:
+            warnings.warn(
+                f"interpolator {interp} not yet implemented; "
+                "using D2 standard"
+            )
+            P = standard_interpolation(Asp, S, cf)
     P = truncate_interp(P, trunc, max_el)
     R = P.T.tocsr()
     Ac = (R @ Asp @ P).tocsr()

@@ -83,7 +83,7 @@ class AMGSolver(Solver):
     # ------------------------------------------------------------------
     # setup (reference AMG_Setup::setup, amg.cu:147-418)
 
-    def _build_coarse(self, Asp):
+    def _build_coarse(self, Asp, level_id: int):
         if self.algorithm == "AGGREGATION":
             from amgx_tpu.amg.aggregation import build_aggregation_level
 
@@ -92,7 +92,7 @@ class AMGSolver(Solver):
             raise NotImplementedError("ENERGYMIN algorithm TBD")
         from amgx_tpu.amg.classical import build_classical_level
 
-        return build_classical_level(Asp, self.cfg, self.scope)
+        return build_classical_level(Asp, self.cfg, self.scope, level_id)
 
     def _make_smoother(self, A: SparseMatrix) -> Solver:
         name, sscope = self.cfg.get_scoped("smoother", self.scope)
@@ -118,13 +118,6 @@ class AMGSolver(Solver):
             raise NotImplementedError(
                 "AMG on block matrices: scalarize for now"
             )
-        if int(self.cfg.get("aggressive_levels", self.scope)) > 0:
-            import warnings
-
-            warnings.warn(
-                "aggressive_levels not yet implemented; using standard "
-                "coarsening on all levels"
-            )
         self.levels = [AMGLevel(A, 0)]
         Asp = A.to_scipy()
         # reference amg.cu:207-230: when the coarse solver is dense LU,
@@ -142,7 +135,7 @@ class AMGSolver(Solver):
                 or n <= self.min_fine_rows
             ):
                 break
-            P, R, Ac = self._build_coarse(Asp)
+            P, R, Ac = self._build_coarse(Asp, lvl.level_id)
             nc = Ac.shape[0]
             if nc >= n or nc == 0:  # coarsening stalled
                 break

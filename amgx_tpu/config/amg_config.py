@@ -35,6 +35,7 @@ class AMGConfig:
         self._values: Dict[Tuple[str, str], Any] = {}
         # (scope, name) -> scope the named sub-solver reads its params from
         self._scope_links: Dict[Tuple[str, str], str] = {}
+        self._auto_scope = 0
 
     # -- construction ------------------------------------------------------
 
@@ -79,7 +80,14 @@ class AMGConfig:
 
     def _ingest(self, key: str, val: Any, scope: str):
         if isinstance(val, dict):
-            child_scope = val.get("scope", scope)
+            # a nested solver dict without an explicit scope gets its own
+            # auto scope — flattening into the parent would clobber the
+            # parent's parameters (reference behavior: unnamed nested
+            # scopes are unique)
+            child_scope = val.get("scope")
+            if child_scope is None:
+                self._auto_scope += 1
+                child_scope = f"_auto_scope_{self._auto_scope}"
             solver_name = val.get("solver")
             if solver_name is None:
                 raise ConfigError(

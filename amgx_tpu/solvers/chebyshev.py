@@ -1,14 +1,21 @@
 """Chebyshev iteration (reference cheb_solver.cu, chebyshev_poly.cu).
 
-One step applies an order-k Chebyshev polynomial in the Jacobi-
-preconditioned operator D^{-1}A over the interval [lmin, lmax].  Interval:
-user-provided (chebyshev_lambda_estimate_mode=1: cheby_min/max_lambda) or
-estimated at setup by power iteration on D^{-1}A (mode 0), with
-lmin = cheby_min_lambda * lmax (the reference default ratio 0.125).
+One step applies an order-k Chebyshev polynomial in the preconditioned
+operator M^{-1}A over the eigenvalue interval [lmin, lmax].  The
+preconditioner is the nested 'preconditioner' solver when configured
+(e.g. JACOBI_L1 in AMG_CLASSICAL_AGGRESSIVE_CHEB_L1_TRUNC.json),
+otherwise plain Jacobi D^{-1}.
+
+Interval: chebyshev_lambda_estimate_mode == 1 takes the user's
+cheby_min/max_lambda; every other mode estimates lmax by power iteration
+on M^{-1}A at setup (the reference's estimate modes differ only in GPU
+implementation strategy), with lmin = cheby_min_lambda * lmax (reference
+default ratio 0.125).
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -16,20 +23,6 @@ from amgx_tpu.ops.diagonal import invert_diag
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
-
-
-def estimate_lambda_max(A, dinv, iters=20, seed=0):
-    """Power iteration on D^{-1}A (host loop over device ops; setup-time)."""
-    rng = np.random.default_rng(seed)
-    v = jnp.asarray(rng.standard_normal(A.n_rows * A.block_size).astype(
-        np.asarray(A.values).real.dtype
-    ))
-    lam = 1.0
-    for _ in range(iters):
-        w = dinv * spmv(A, v)
-        lam = float(jnp.linalg.norm(w))
-        v = w / jnp.maximum(lam, 1e-30)
-    return lam
 
 
 @register_solver("CHEBYSHEV")
@@ -42,35 +35,77 @@ class ChebyshevSolver(Solver):
         )
         self.user_max = float(cfg.get("cheby_max_lambda", scope))
         self.user_min = float(cfg.get("cheby_min_lambda", scope))
+        from amgx_tpu.solvers.krylov import resolve_preconditioner
+
+        # NOSOLVER (or nothing configured in scope) -> Jacobi default
+        name, _ = cfg.get_scoped("preconditioner", scope)
+        self.precond = (
+            resolve_preconditioner(cfg, scope)
+            if cfg.has("preconditioner", scope) and name != "NOSOLVER"
+            else None
+        )
+
+    def _make_M(self):
+        if self.precond is None:
+            return lambda Mp, r: Mp * r  # Mp is dinv
+        return self.precond.make_apply()
 
     def _setup_impl(self, A):
-        if A.block_size != 1:
+        if A.block_size != 1 and self.precond is None:
             raise NotImplementedError("Chebyshev block matrices TBD")
-        dinv = invert_diag(A)
-        if self.lambda_mode == 0:
-            lmax = 1.1 * estimate_lambda_max(A, dinv)
-            lmin = self.user_min * lmax  # ratio semantics, default 0.125
+        if self.precond is not None:
+            self.precond.setup(A)
+            Mp = self.precond.apply_params()
         else:
+            Mp = invert_diag(A)
+        M = self._make_M()
+        # reference cheb_solver.cu:153-216: mode 3 takes the user's
+        # cheby_max/min_lambda verbatim; the other modes estimate lmax
+        if self.lambda_mode == 3:
             lmax, lmin = self.user_max, self.user_min
+        else:
+            lmax = 1.1 * self._estimate_lambda_max(A, M, Mp)
+            lmin = self.user_min * lmax  # ratio semantics, default 0.125
         self.lmax, self.lmin = float(lmax), float(lmin)
-        self._params = (A, dinv)
+        self._params = (A, Mp)
+
+    def _estimate_lambda_max(self, A, M, Mp, iters=20, seed=0):
+        """Power iteration on M^{-1}A (setup-time, jitted step)."""
+        rng = np.random.default_rng(seed)
+        rdt = np.zeros((), A.values.dtype).real.dtype
+        v = jnp.asarray(
+            rng.standard_normal(A.n_rows * A.block_size).astype(rdt)
+        )
+
+        @jax.jit
+        def step(v):
+            w = M(Mp, spmv(A, v))
+            lam = jnp.linalg.norm(w)
+            return w / jnp.maximum(lam, 1e-30), lam
+
+        lam = 1.0
+        for _ in range(iters):
+            v, lam_j = step(v)
+            lam = float(lam_j)
+        return max(lam, 1e-12)
 
     def make_step(self):
         k = max(self.order, 1)
         theta = (self.lmax + self.lmin) / 2.0
-        delta = (self.lmax - self.lmin) / 2.0
+        delta = max((self.lmax - self.lmin) / 2.0, 1e-30)
         sigma = theta / delta
+        M = self._make_M()
 
         def step(params, b, x):
-            A, dinv = params
+            A, Mp = params
             rho_old = 1.0 / sigma
             r = b - spmv(A, x)
-            d = dinv * r / theta
+            d = M(Mp, r) / theta
             x = x + d
             for _ in range(k - 1):
                 rho = 1.0 / (2.0 * sigma - rho_old)
                 r = b - spmv(A, x)
-                d = rho * rho_old * d + (2.0 * rho / delta) * (dinv * r)
+                d = rho * rho_old * d + (2.0 * rho / delta) * M(Mp, r)
                 x = x + d
                 rho_old = rho
             return x

@@ -1,0 +1,59 @@
+"""Acceptance matrix: shipped reference configs must solve a 3D Poisson
+system end-to-end (SURVEY §5.6: the 61 shipped configs are the de-facto
+public contract).  A representative subset runs in CI; the full sweep is
+scripts-level."""
+
+import contextlib
+import io
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import amgx_tpu
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.io.poisson import poisson_3d_7pt, poisson_rhs
+from amgx_tpu.solvers import create_solver
+
+amgx_tpu.initialize()
+
+CONFIG_DIR = "/root/reference/src/configs"
+
+REPRESENTATIVE = [
+    "FGMRES_AGGREGATION.json",
+    "AMG_CLASSICAL_PMIS.json",
+    "PCG_CLASSICAL_V_JACOBI.json",
+    "AMG_CLASSICAL_CG.json",
+    "CLASSICAL_W_CYCLE.json",
+    "F.json",
+    "IDR_DILU.json",
+    "GMRES_AMG_D2.json",
+    "AMG_CLASSICAL_AGGRESSIVE_CHEB_L1_TRUNC.json",
+    "V-cheby-smoother.json",
+    "PBICGSTAB_AGGREGATION_W_JACOBI.json",
+    "AGGREGATION_MULTI_PAIRWISE.json",
+]
+
+
+@pytest.mark.parametrize("name", REPRESENTATIVE)
+def test_reference_config_solves_poisson(name):
+    path = os.path.join(CONFIG_DIR, name)
+    if not os.path.exists(path):
+        pytest.skip(f"{name} not in reference checkout")
+    A = poisson_3d_7pt(12)
+    b = poisson_rhs(A.n_rows)
+    cfg = AMGConfig.from_file(path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            s = create_solver(cfg, "default")
+            s.setup(A)
+            res = s.solve(b)
+    x = np.asarray(res.x)
+    rel = float(
+        np.linalg.norm(b - A.to_scipy() @ x) / np.linalg.norm(b)
+    )
+    assert int(res.status) == 0, (name, int(res.iters), rel)
+    assert rel < 1e-3, (name, rel)
