@@ -89,7 +89,9 @@ class AMGSolver(Solver):
 
             return build_aggregation_level(Asp, self.cfg, self.scope)
         if self.algorithm == "ENERGYMIN":
-            raise NotImplementedError("ENERGYMIN algorithm TBD")
+            from amgx_tpu.amg.energymin import build_energymin_level
+
+            return build_energymin_level(Asp, self.cfg, self.scope)
         from amgx_tpu.amg.classical import build_classical_level
 
         return build_classical_level(Asp, self.cfg, self.scope, level_id)

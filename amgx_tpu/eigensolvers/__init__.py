@@ -3,8 +3,8 @@ eigensolver.h:25-150; factories eigensolvers.cu:38-48; shipped configs
 src/configs/eigen_configs/).
 
 Registered: POWER_ITERATION, SINGLE_ITERATION, INVERSE_ITERATION,
-PAGERANK, SUBSPACE_ITERATION, LANCZOS, ARNOLDI, LOBPCG.
-JACOBI_DAVIDSON is pending.
+PAGERANK, SUBSPACE_ITERATION, LANCZOS, ARNOLDI, LOBPCG,
+JACOBI_DAVIDSON.
 """
 
 from amgx_tpu.eigensolvers.base import (
@@ -14,6 +14,7 @@ from amgx_tpu.eigensolvers.base import (
     create_eigensolver,
 )
 from amgx_tpu.eigensolvers import algorithms  # noqa: F401  (registration)
+from amgx_tpu.eigensolvers import jacobi_davidson  # noqa: F401
 
 __all__ = [
     "EigenResult",

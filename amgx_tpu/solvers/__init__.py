@@ -6,9 +6,9 @@ registerClasses at amgx::initialize, reference core.cu:552-688).
 Registered here: PCG, CG, PCGF, PBICGSTAB, BICGSTAB, FGMRES, GMRES,
 IDR, IDRMSYNC, BLOCK_JACOBI, JACOBI_L1, GS, MULTICOLOR_GS, FIXCOLOR_GS,
 MULTICOLOR_DILU, MULTICOLOR_ILU, CHEBYSHEV, CHEBYSHEV_POLY, POLYNOMIAL,
-KPZ_POLYNOMIAL, KACZMARZ, DENSE_LU_SOLVER, NOSOLVER.
+KPZ_POLYNOMIAL, KACZMARZ, CF_JACOBI, DENSE_LU_SOLVER, NOSOLVER.
 The AMG solver registers when amgx_tpu.amg is imported (amgx_tpu.initialize
-does both).  Pending reference parity: CF_JACOBI (needs C/F plumbing).
+does both).
 """
 
 from amgx_tpu.solvers.registry import (
@@ -20,6 +20,7 @@ from amgx_tpu.solvers.base import Solver, SolveResult
 
 # registration side effects
 from amgx_tpu.solvers import (  # noqa: F401
+    cf_jacobi,
     chebyshev,
     dense_lu,
     dilu,

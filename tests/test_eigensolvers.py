@@ -135,4 +135,15 @@ def test_pagerank():
 
 def test_unknown_eigensolver():
     with pytest.raises(KeyError):
-        create_eigensolver(_cfg("eig_solver=JACOBI_DAVIDSON"))
+        create_eigensolver(_cfg("eig_solver=QUANTUM_ANNEALER"))
+
+
+def test_jacobi_davidson(system):
+    A, sp, evals, _ = system
+    cfg = _cfg("eig_solver=JACOBI_DAVIDSON, eig_max_iters=60,"
+               " eig_tolerance=1e-8, eig_which=largest,"
+               " eig_subspace_size=12")
+    es = create_eigensolver(cfg).setup(A)
+    r = es.solve()
+    assert r.converged
+    np.testing.assert_allclose(r.eigenvalues[0], evals[0], rtol=1e-6)

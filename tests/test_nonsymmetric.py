@@ -1,0 +1,107 @@
+"""Nonsymmetric-system acceptance (BASELINE config 4 shape: GMRES +
+ILU0-class smoother on a nonsymmetric operator; atmosmodd itself is not
+available offline, so a 2D upwind convection-diffusion operator stands
+in)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import amgx_tpu
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.solvers import create_solver
+from amgx_tpu.solvers.base import SUCCESS
+
+amgx_tpu.initialize()
+
+
+def convection_diffusion_2d(n, peclet=20.0):
+    """-eps*Lap(u) + c . grad(u), first-order upwind; nonsymmetric."""
+    h = 1.0 / (n + 1)
+    cx, cy = peclet, peclet * 0.5
+    main = 4.0 + h * (abs(cx) + abs(cy))
+    west = -1.0 - h * max(cx, 0)
+    east = -1.0 + h * min(cx, 0)
+    south = -1.0 - h * max(cy, 0)
+    north = -1.0 + h * min(cy, 0)
+    I = sps.eye_array(n)
+    T = sps.diags_array(
+        [west * np.ones(n - 1), main * np.ones(n), east * np.ones(n - 1)],
+        offsets=[-1, 0, 1],
+    )
+    S = sps.diags_array(
+        [south * np.ones(n - 1), np.zeros(n), north * np.ones(n - 1)],
+        offsets=[-1, 0, 1],
+    )
+    A = (sps.kron(I, T) + sps.kron(S, I)).tocsr()
+    A.sort_indices()
+    return A
+
+
+@pytest.fixture(scope="module")
+def cd_system():
+    A = convection_diffusion_2d(24)
+    rng = np.random.default_rng(7)
+    xtrue = rng.standard_normal(A.shape[0])
+    return SparseMatrix.from_scipy(A), A, A @ xtrue, xtrue
+
+
+def test_gmres_dilu_nonsymmetric(cd_system):
+    """GMRES(30) + ILU0-class smoother — acceptance config 4."""
+    Am, Asp, b, xtrue = cd_system
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "GMRES", "gmres_n_restart": 30,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        ' "tolerance": 1e-08, "max_iters": 200,'
+        ' "preconditioner": {"scope": "ilu",'
+        ' "solver": "MULTICOLOR_ILU", "ilu_sparsity_level": 0,'
+        ' "max_iters": 1, "monitor_residual": 0}}}'
+    )
+    s = create_solver(cfg, "default")
+    s.setup(Am)
+    res = s.solve(b)
+    assert int(res.status) == SUCCESS
+    x = np.asarray(res.x)
+    rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
+    assert rel < 1e-7
+    assert int(res.iters) < 60
+
+
+def test_bicgstab_nonsymmetric(cd_system):
+    Am, Asp, b, xtrue = cd_system
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PBICGSTAB", "monitor_residual": 1,'
+        ' "convergence": "RELATIVE_INI", "tolerance": 1e-08,'
+        ' "max_iters": 300, "preconditioner": {"scope": "p",'
+        ' "solver": "MULTICOLOR_DILU", "max_iters": 1,'
+        ' "monitor_residual": 0}}}'
+    )
+    s = create_solver(cfg, "default")
+    s.setup(Am)
+    res = s.solve(b)
+    assert int(res.status) == SUCCESS
+
+
+def test_classical_amg_nonsymmetric_preconditioner(cd_system):
+    """Classical AMG as GMRES preconditioner on the nonsym operator."""
+    Am, Asp, b, xtrue = cd_system
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "FGMRES", "gmres_n_restart": 20,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        ' "tolerance": 1e-08, "max_iters": 120,'
+        ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+        ' "algorithm": "CLASSICAL", "selector": "PMIS",'
+        ' "interpolator": "D1",'
+        ' "smoother": {"scope": "j", "solver": "JACOBI_L1",'
+        ' "relaxation_factor": 0.8, "monitor_residual": 0},'
+        ' "max_iters": 1, "monitor_residual": 0}}}'
+    )
+    s = create_solver(cfg, "default")
+    s.setup(Am)
+    res = s.solve(b)
+    assert int(res.status) == SUCCESS
+    assert int(res.iters) < 60

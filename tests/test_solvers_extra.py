@@ -139,3 +139,16 @@ def test_scaler_unknown_name():
     with pytest.raises(KeyError):
         create_scaler("MAGIC")
     assert create_scaler("NONE") is None
+
+
+def test_cf_jacobi_converges():
+    A = poisson_2d_5pt(16)
+    b = poisson_rhs(A.n_rows)
+    cfg = (
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "CF_JACOBI", "monitor_residual": 1,'
+        ' "relaxation_factor": 0.9, "convergence": "RELATIVE_INI",'
+        ' "tolerance": 1e-06, "max_iters": 1500}}'
+    )
+    s, res = _solve(cfg, A, b)
+    _check(A, res, b, 1e-5)
