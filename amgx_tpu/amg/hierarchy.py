@@ -116,10 +116,9 @@ class AMGSolver(Solver):
         return cs
 
     def _setup_impl(self, A: SparseMatrix):
-        if A.block_size != 1:
-            raise NotImplementedError(
-                "AMG on block matrices: scalarize for now"
-            )
+        from amgx_tpu.ops.diagonal import scalarized
+
+        A = scalarized(A, "AMG")
         self.levels = [AMGLevel(A, 0)]
         Asp = A.to_scipy()
         # reference amg.cu:207-230: when the coarse solver is dense LU,

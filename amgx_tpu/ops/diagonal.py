@@ -12,6 +12,31 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def scalarized(A, solver_name: str):
+    """Scalar expansion of a block matrix (block rows/cols unrolled).
+
+    Solvers without native block kernels operate on the expanded scalar
+    operator — identical linear algebra, though block-coupled variants
+    (e.g. block DILU) differ from their scalar expansions; native block
+    paths are future work.  Vectors are flat (n*b,) either way, so no
+    caller-visible change."""
+    if A.block_size == 1:
+        return A
+    import warnings
+
+    from amgx_tpu.core.matrix import SparseMatrix
+
+    warnings.warn(
+        f"{solver_name}: block_size {A.block_size} handled by scalar "
+        "expansion (native block kernels TBD)"
+    )
+    sp = A.to_scipy()
+    # the block expansion stores all b*b entries per block; drop explicit
+    # zeros so the iteration operator (and colorings) keep the true graph
+    sp.eliminate_zeros()
+    return SparseMatrix.from_scipy(sp)
+
+
 def invert_diag(A):
     """Inverse of the (block) diagonal, host-side at setup."""
     d = np.asarray(A.diag)

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from amgx_tpu.ops.diagonal import invert_diag
+from amgx_tpu.ops.diagonal import invert_diag, scalarized
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
@@ -31,8 +31,7 @@ class CFJacobiSolver(Solver):
         self.max_row_sum = float(cfg.get("max_row_sum", scope))
 
     def _setup_impl(self, A):
-        if A.block_size != 1:
-            raise NotImplementedError("CF-Jacobi: scalar matrices only")
+        A = scalarized(A, "CF_JACOBI")
         from amgx_tpu.amg.classical import pmis_select, strength_ahat
 
         sp = A.to_scipy()

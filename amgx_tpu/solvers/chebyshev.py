@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from amgx_tpu.ops.diagonal import invert_diag
+from amgx_tpu.ops.diagonal import invert_diag, scalarized
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
@@ -51,12 +51,11 @@ class ChebyshevSolver(Solver):
         return self.precond.make_apply()
 
     def _setup_impl(self, A):
-        if A.block_size != 1 and self.precond is None:
-            raise NotImplementedError("Chebyshev block matrices TBD")
         if self.precond is not None:
             self.precond.setup(A)
             Mp = self.precond.apply_params()
         else:
+            A = scalarized(A, "CHEBYSHEV")
             Mp = invert_diag(A)
         M = self._make_M()
         # reference cheb_solver.cu:153-216: mode 3 takes the user's

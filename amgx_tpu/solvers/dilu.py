@@ -38,8 +38,9 @@ class MulticolorDILUSolver(Solver):
         self.deterministic = bool(cfg.get("determinism_flag", scope))
 
     def _setup_impl(self, A: SparseMatrix):
-        if A.block_size != 1:
-            raise NotImplementedError("DILU block matrices TBD")
+        from amgx_tpu.ops.diagonal import scalarized
+
+        A = scalarized(A, "MULTICOLOR_DILU")
         colors = color_matrix(A, self.scheme, self.deterministic)
         self.num_colors = int(colors.max()) + 1
 

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from amgx_tpu.ops.coloring import color_matrix
-from amgx_tpu.ops.diagonal import invert_diag
+from amgx_tpu.ops.diagonal import invert_diag, scalarized
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
@@ -34,8 +34,7 @@ class MulticolorGSSolver(Solver):
         self.deterministic = bool(cfg.get("determinism_flag", scope))
 
     def _setup_impl(self, A):
-        if A.block_size != 1:
-            raise NotImplementedError("multicolor GS: block matrices TBD")
+        A = scalarized(A, "MULTICOLOR_GS")
         colors = color_matrix(A, self.scheme, self.deterministic)
         self.num_colors = int(colors.max()) + 1
         self._params = (A, invert_diag(A), jnp.asarray(colors))

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from amgx_tpu.ops.diagonal import apply_dinv, invert_diag
+from amgx_tpu.ops.diagonal import apply_dinv, invert_diag, scalarized
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
@@ -24,7 +24,9 @@ class _DiagSmootherBase(Solver):
 
     def make_residual_step(self):
         omega = self.relaxation_factor
-        b_sz = self.A.block_size
+        # block size of the OPERATOR matrix in params (JACOBI_L1
+        # scalarizes at setup, so self.A.block_size may differ)
+        b_sz = self._params[0].block_size
 
         def rstep(params, b, x, r):
             _, dinv = params
@@ -37,7 +39,7 @@ class _DiagSmootherBase(Solver):
         # sweeps use full steps (reference smooth_with_0_initial_guess)
         step = self.make_step()
         omega = self.relaxation_factor
-        b_sz = self.A.block_size
+        b_sz = self._params[0].block_size
         iters = max(self.max_iters, 1)
 
         def apply(params, r):
@@ -68,13 +70,10 @@ class JacobiL1Solver(_DiagSmootherBase):
     for any symmetric A (reference jacobi_l1_solver.cu)."""
 
     def _setup_impl(self, A):
+        A = scalarized(A, "JACOBI_L1")
         vals = np.asarray(A.values)
         row_ids = np.asarray(A.row_ids)
         cols = np.asarray(A.col_indices)
-        if A.block_size != 1:
-            raise NotImplementedError(
-                "JACOBI_L1 block matrices: use BLOCK_JACOBI"
-            )
         offdiag = np.zeros(A.n_rows, dtype=np.abs(vals).dtype)
         np.add.at(offdiag, row_ids, np.abs(vals) * (cols != row_ids))
         d = np.abs(np.asarray(A.diag)) + offdiag

@@ -14,6 +14,7 @@ import numpy as np
 
 from amgx_tpu.core.matrix import SparseMatrix
 from amgx_tpu.ops.coloring import color_matrix
+from amgx_tpu.ops.diagonal import scalarized
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
@@ -30,8 +31,7 @@ class KaczmarzSolver(Solver):
         )
 
     def _setup_impl(self, A: SparseMatrix):
-        if A.block_size != 1:
-            raise NotImplementedError("Kaczmarz: scalar matrices only")
+        A = scalarized(A, "KACZMARZ")
         sp = A.to_scipy()
         At = SparseMatrix.from_scipy(sp.T.tocsr().astype(sp.dtype))
         rownorm2 = np.asarray(sp.multiply(sp).sum(axis=1)).ravel()

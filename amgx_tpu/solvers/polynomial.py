@@ -9,7 +9,7 @@ Both are gather-free chains of SpMV + AXPY — TPU-friendly.
 
 from __future__ import annotations
 
-from amgx_tpu.ops.diagonal import invert_diag
+from amgx_tpu.ops.diagonal import invert_diag, scalarized
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
@@ -24,8 +24,7 @@ class PolynomialSolver(Solver):
         self.order = max(int(cfg.get(self.order_param, scope)), 1)
 
     def _setup_impl(self, A):
-        if A.block_size != 1:
-            raise NotImplementedError("polynomial smoother: scalar only")
+        A = scalarized(A, "POLYNOMIAL")
         self._params = (A, invert_diag(A))
 
     def make_residual_step(self):

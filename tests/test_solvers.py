@@ -213,3 +213,30 @@ def test_absolute_convergence(poisson2d):
     )
     s, res = _solve_cfg(cfg_text, A, b)
     assert float(np.max(np.asarray(res.final_norm))) < 1e-6
+
+
+def test_block_matrix_amg_pcg():
+    """Block matrices flow through AMG/DILU via scalar expansion."""
+    import warnings
+    from tests.conftest import random_csr
+    from amgx_tpu.core.matrix import SparseMatrix
+
+    b_sz = 2
+    sp = random_csr(32 * b_sz, density=0.15, seed=11, spd=True)
+    A = SparseMatrix.from_scipy(sp, block_size=b_sz)
+    assert A.block_size == b_sz
+    rhs = np.random.default_rng(11).standard_normal(sp.shape[0])
+    cfg_text = (
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "monitor_residual": 1,'
+        ' "convergence": "RELATIVE_INI", "tolerance": 1e-08,'
+        ' "max_iters": 200, "preconditioner": {"scope": "amg",'
+        ' "solver": "AMG", "algorithm": "AGGREGATION",'
+        ' "selector": "SIZE_2", "smoother": {"scope": "j",'
+        ' "solver": "MULTICOLOR_DILU", "monitor_residual": 0,'
+        ' "max_iters": 1}, "max_iters": 1, "monitor_residual": 0}}}'
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s, res = _solve_cfg(cfg_text, A, rhs)
+    _check(A, res, rhs, 1e-7)
