@@ -517,6 +517,15 @@ def solver_get_iteration_residual(slv_h: int, it: int, idx: int = 0):
     return float(hist[it, idx])
 
 
+def solver_resetup(slv_h: int, mtx_h: int):
+    """Refresh the solver for a matrix whose VALUES changed but whose
+    structure is intact (reference AMGX_solver_resetup, amgx_c.h:604-607;
+    structure_reuse path).  Falls back to full setup — the jit cache keys
+    on shapes, so unchanged structure re-dispatches without recompiling
+    the solve."""
+    return solver_setup(slv_h, mtx_h)
+
+
 def solver_destroy(slv_h):
     _objects.pop(slv_h, None)
     return RC_OK

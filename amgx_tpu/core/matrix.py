@@ -44,6 +44,11 @@ _ELL_MAX_WIDTH = 128
 # matrices like Poisson 5/7/27-pt are pure DIA).
 _DIA_MAX_DIAGS = 48
 _DIA_MAX_OVERHEAD = 2.0
+# Dense acceleration structure: small unstructured matrices (AMG coarse
+# Galerkin operators lose banded structure) store a dense copy so SpMV is
+# a matmul on the MXU — cheaper than TPU gathers below this row count
+# (4096^2 f32 = 64 MB).
+_DENSE_MAX_ROWS = 4096
 
 
 def _static_field(**kw):
@@ -82,6 +87,8 @@ class SparseMatrix:
     ell_vals: Optional[jnp.ndarray]
     # DIA structure: dia_vals[k, i] = A[i, i + dia_offsets[k]] (0 outside)
     dia_vals: Optional[jnp.ndarray] = None
+    # dense copy for small unstructured matrices (SpMV = MXU matmul)
+    dense: Optional[jnp.ndarray] = None
 
     n_rows: int = _static_field(default=0)
     n_cols: int = _static_field(default=0)
@@ -118,6 +125,10 @@ class SparseMatrix:
         return self.dia_offsets is not None
 
     @property
+    def has_dense(self) -> bool:
+        return self.dense is not None
+
+    @property
     def is_square(self) -> bool:
         return self.n_rows == self.n_cols
 
@@ -147,6 +158,10 @@ class SparseMatrix:
             new = dataclasses.replace(
                 new, dia_vals=_scatter_dia_vals(self, values)
             )
+        if self.has_dense:
+            d = jnp.zeros_like(self.dense)
+            d = d.at[self.row_ids, self.col_indices].add(values)
+            new = dataclasses.replace(new, dense=d)
         return new
 
     def astype(self, dtype) -> "SparseMatrix":
@@ -157,6 +172,8 @@ class SparseMatrix:
             rep["ell_vals"] = self.ell_vals.astype(dtype)
         if self.has_dia:
             rep["dia_vals"] = self.dia_vals.astype(dtype)
+        if self.has_dense:
+            rep["dense"] = self.dense.astype(dtype)
         return dataclasses.replace(self, **rep)
 
     # ---- host conversions ----------------------------------------------
@@ -201,8 +218,26 @@ class SparseMatrix:
                 row_offsets, col_indices, values, row_ids, n_rows
             )
 
+        dense = None
+        dense_bytes = n_rows * n_cols * values.dtype.itemsize
+        if (
+            build_ell  # opt-out flag covers all acceleration structures
+            and b == 1
+            and dia_offsets is None
+            and 0 < n_rows <= _DENSE_MAX_ROWS
+            and n_cols <= _DENSE_MAX_ROWS
+            and dense_bytes <= 64 * 1024 * 1024
+        ):
+            dense = np.zeros((n_rows, n_cols), dtype=values.dtype)
+            np.add.at(dense, (row_ids, col_indices), values)
+
         ell_cols = ell_vals = None
-        if build_ell and n_rows > 0 and dia_offsets is None:
+        if (
+            build_ell
+            and n_rows > 0
+            and dia_offsets is None
+            and dense is None
+        ):
             w = int(row_lens.max()) if nnz else 0
             if w <= _ELL_MAX_WIDTH and w * n_rows <= _ELL_MAX_OVERHEAD * max(
                 nnz, 1
@@ -221,6 +256,7 @@ class SparseMatrix:
             ell_cols=None if ell_cols is None else dev(ell_cols),
             ell_vals=None if ell_vals is None else dev(ell_vals),
             dia_vals=None if dia_vals is None else dev(dia_vals),
+            dense=None if dense is None else dev(dense),
             n_rows=int(n_rows),
             n_cols=int(n_cols),
             block_size=int(b),

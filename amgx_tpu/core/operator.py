@@ -1,0 +1,74 @@
+"""Operator abstraction (reference include/operators/operator.h:14-57):
+solvers can work on plain matrices or composed operators.
+
+  MatrixOperator   — wraps a SparseMatrix (apply = SpMV)
+  ShiftedOperator  — A - sigma*I (reference shifted_operator.h; used by
+                     shift-invert eigensolvers)
+  SolveOperator    — apply = inner solve (reference solve_operator.h:15-38;
+                     operator = approximate inverse of another solver)
+
+Each exposes ``apply(x)`` plus ``as_fn()`` returning a pure jit-safe
+function for embedding in outer loops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.ops.spmv import spmv
+
+
+class Operator:
+    shape = (0, 0)
+
+    def apply(self, x):
+        raise NotImplementedError
+
+    def as_fn(self):
+        """Returns (params, pure_fn) with pure_fn(params, x) -> y."""
+        raise NotImplementedError
+
+
+class MatrixOperator(Operator):
+    def __init__(self, A: SparseMatrix):
+        self.A = A
+        self.shape = A.shape
+
+    def apply(self, x):
+        return spmv(self.A, x)
+
+    def as_fn(self):
+        return self.A, lambda A, x: spmv(A, x)
+
+
+class ShiftedOperator(Operator):
+    """(A - sigma I) x without materializing the shifted matrix."""
+
+    def __init__(self, A: SparseMatrix, sigma: float):
+        self.A = A
+        self.sigma = float(sigma)
+        self.shape = A.shape
+
+    def apply(self, x):
+        return spmv(self.A, x) - self.sigma * x
+
+    def as_fn(self):
+        sigma = self.sigma
+        return self.A, lambda A, x: spmv(A, x) - sigma * x
+
+
+class SolveOperator(Operator):
+    """apply(x) = (approximate) A^{-1} x via an inner solver."""
+
+    def __init__(self, solver):
+        self.solver = solver
+        A = solver.A
+        self.shape = A.shape if A is not None else (0, 0)
+
+    def apply(self, x):
+        params = self.solver.apply_params()
+        return self.solver.make_apply()(params, jnp.asarray(x))
+
+    def as_fn(self):
+        return self.solver.apply_params(), self.solver.make_apply()

@@ -47,6 +47,9 @@ def spmv(A: SparseMatrix, x: jnp.ndarray, n_rows: int | None = None):
 def _spmv_scalar(A, x):
     if A.has_dia:
         return _spmv_dia(A, x)
+    if A.has_dense:
+        # small unstructured matrices: one MXU matmul beats TPU gathers
+        return A.dense @ x
     if A.has_ell:
         xg = x[A.ell_cols]  # (n, w)
         return jnp.sum(A.ell_vals * xg, axis=1)

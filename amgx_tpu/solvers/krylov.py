@@ -155,12 +155,15 @@ class PCGSolver(KrylovSolver):
             A, Mp = params
             r, p, rho = extra
             q = spmv(A, p)
-            alpha = rho / dot(p, q)
+            pq = dot(p, q)
+            # guards: exact breakdown (converged mid-fixed-iteration run)
+            # must yield a no-op, not 0/0 = NaN
+            alpha = jnp.where(pq != 0, rho / pq, 0.0)
             x = x + alpha * p
             r = r - alpha * q
             z = M(Mp, r)
             rho_new = dot(r, z)
-            beta = rho_new / rho
+            beta = jnp.where(rho != 0, rho_new / rho, 0.0)
             p = z + beta * p
             return x, (r, p, rho_new)
 
@@ -199,12 +202,17 @@ class PCGFSolver(KrylovSolver):
             A, Mp = params
             r, p, rho = extra
             q = spmv(A, p)
-            alpha = rho / dot(p, q)
+            pq = dot(p, q)
+            alpha = jnp.where(pq != 0, rho / pq, 0.0)
             x = x + alpha * p
             r_new = r - alpha * q
             z = M(Mp, r_new)
             rho_new = dot(r_new, z)
-            beta = dot(z, r_new - r) / rho
+            beta = jnp.where(
+                rho != 0,
+                dot(z, r_new - r) / jnp.where(rho != 0, rho, 1.0),
+                0.0,
+            )
             p = z + beta * p
             return x, (r_new, p, rho_new)
 
@@ -233,15 +241,25 @@ class PBiCGStabSolver(KrylovSolver):
             A, Mp = params
             r, r0, p, v, rho, alpha, omega = extra
             rho1 = dot(r0, r)
-            beta = (rho1 / rho) * (alpha / omega)
+            # guard each factor separately: the PRODUCT rho*omega can
+            # underflow while both ratios remain well-defined
+            ok = (rho != 0) & (omega != 0)
+            beta = jnp.where(
+                ok,
+                (rho1 / jnp.where(rho != 0, rho, 1.0))
+                * (alpha / jnp.where(omega != 0, omega, 1.0)),
+                0.0,
+            )
             p = r + beta * (p - omega * v)
             phat = M(Mp, p)
             v = spmv(A, phat)
-            alpha = rho1 / dot(r0, v)
+            r0v = dot(r0, v)
+            alpha = jnp.where(r0v != 0, rho1 / r0v, 0.0)
             s = r - alpha * v
             shat = M(Mp, s)
             t = spmv(A, shat)
-            omega = dot(t, s) / dot(t, t)
+            tt = dot(t, t)
+            omega = jnp.where(tt != 0, dot(t, s) / tt, 0.0)
             x = x + alpha * phat + omega * shat
             r = s - omega * t
             return x, (r, r0, p, v, rho1, alpha, omega)

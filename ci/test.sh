@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# CI entry point (reference ci/test.sh runs amgx_tests_launcher).
+# Runs the full suite on the 8-device virtual CPU mesh, then the bench
+# smoke on whatever backend is available.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q
+python bench.py

@@ -32,15 +32,33 @@ def test_from_coo_duplicates_summed():
     np.testing.assert_allclose(dense, [[3.0, 0.0], [4.0, 8.0]])
 
 
-def test_ell_built_for_regular_matrix():
-    sp = random_csr(30, density=0.2, seed=3)
-    A = SparseMatrix.from_scipy(sp)
-    assert A.has_ell
-    # padded entries contribute zero
-    x = np.ones(30)
-    y_ell = np.asarray(A.ell_vals @ np.ones(A.ell_cols.shape[1]))
+def test_acceleration_format_priority():
+    """Small unstructured -> dense (MXU matmul); mid-size unstructured ->
+    ELL; stencil -> DIA."""
+    small = SparseMatrix.from_scipy(random_csr(30, density=0.2, seed=3))
+    assert small.has_dense and not small.has_ell and not small.has_dia
     np.testing.assert_allclose(
-        np.asarray(A.ell_vals).sum(axis=1), sp @ x
+        np.asarray(small.dense), small.to_dense()
+    )
+    # above the dense byte cap -> ELL; verify the ELL SpMV numerically
+    from amgx_tpu.ops.spmv import spmv
+
+    sp = random_csr(5000, density=0.002, seed=3)
+    mid = SparseMatrix.from_scipy(sp)
+    assert mid.has_ell and not mid.has_dense
+    x = np.random.default_rng(3).standard_normal(5000)
+    np.testing.assert_allclose(
+        np.asarray(spmv(mid, x)), sp @ x, rtol=1e-12
+    )
+    # build_ell=False opts out of ALL acceleration structures
+    bare = SparseMatrix.from_scipy(
+        random_csr(100, density=0.1, seed=4), build_ell=False
+    )
+    assert not bare.has_ell and not bare.has_dense
+    x4 = np.random.default_rng(4).standard_normal(100)
+    sp4 = random_csr(100, density=0.1, seed=4)
+    np.testing.assert_allclose(
+        np.asarray(spmv(bare, x4)), sp4 @ x4, rtol=1e-12
     )
 
 
