@@ -58,6 +58,52 @@ def strength_ahat(Asp: sps.csr_matrix, theta: float, max_row_sum: float):
     return S
 
 
+def strength_affinity(Asp: sps.csr_matrix, theta: float,
+                      n_vectors: int = 4, n_iters: int = 4,
+                      seed: int = 29) -> sps.csr_matrix:
+    """AFFINITY strength (reference strength/affinity.cu, Livne-Brandt
+    LAMG affinity): relax a few random vectors with Jacobi on A x = 0;
+    connections whose relaxed values correlate are strong:
+
+        c_ij = |<X_i, X_j>|^2 / (<X_i, X_i> <X_j, X_j>)
+
+    over the affinity_vectors test vectors; j is strong for i when
+    c_ij >= theta * max_k c_ik."""
+    n = Asp.shape[0]
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, n_vectors))
+    # L1-Jacobi relaxation: unconditionally convergent for SPD operators
+    # (plain damped Jacobi can amplify high-frequency modes when
+    # lambda_max(D^-1 A) is large, corrupting the affinities)
+    diag = np.abs(Asp.diagonal())
+    offsum = np.asarray(abs(Asp).sum(axis=1)).ravel() - diag
+    d_l1 = diag + offsum
+    dinv = 1.0 / np.where(d_l1 != 0, d_l1, 1.0)
+    for _ in range(n_iters):
+        X = X - dinv[:, None] * (Asp @ X)
+    coo = Asp.tocoo()
+    off = coo.row != coo.col
+    r, c = coo.row[off], coo.col[off]
+    # accumulate per vector: keeps transients at (nnz,) instead of
+    # materializing (nnz, n_vectors) gathers
+    dot_rc = np.zeros(r.shape[0])
+    for k in range(n_vectors):
+        dot_rc += X[r, k] * X[c, k]
+    num = dot_rc**2
+    nrm2 = np.einsum("ik,ik->i", X, X)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        aff = num / np.maximum(nrm2[r] * nrm2[c], 1e-300)
+    rowmax = np.zeros(n)
+    np.maximum.at(rowmax, r, aff)
+    strong = aff >= theta * np.maximum(rowmax[r], 1e-300)
+    S = sps.csr_matrix(
+        (strong.astype(np.int8), (r, c)), shape=(n, n)
+    )
+    S.eliminate_zeros()
+    S.sort_indices()
+    return S
+
+
 def strength_all(Asp: sps.csr_matrix):
     """ALL: every off-diagonal is strong (reference strength ALL)."""
     n = Asp.shape[0]
@@ -446,7 +492,14 @@ def build_classical_level(Asp, cfg, scope, level_id: int = 0):
 
     if strength == "ALL":
         S = strength_all(Asp)
-    else:  # AHAT default; AFFINITY TBD
+    elif strength == "AFFINITY":
+        S = strength_affinity(
+            Asp,
+            theta,
+            n_vectors=int(cfg.get("affinity_vectors", scope)),
+            n_iters=int(cfg.get("affinity_iterations", scope)),
+        )
+    else:  # AHAT default
         S = strength_ahat(Asp, theta, max_row_sum)
 
     aggressive = (

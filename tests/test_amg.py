@@ -251,3 +251,31 @@ def test_energymin_reduces_energy_heterogeneous():
     assert e2 < e1
     drift = np.abs(np.asarray((P2 - P1).sum(axis=1))).max()
     assert drift < 1e-10
+
+
+def test_affinity_strength_amg():
+    """AFFINITY strength (reference classical_strength_affinity.cu):
+    correlation of relaxed test vectors; the resulting AMG must solve
+    Poisson, and on an anisotropic operator affinity must find the
+    strong (stiff) direction."""
+    import scipy.sparse as sps
+    from amgx_tpu.amg.classical import strength_affinity
+
+    tpl = AMG_STANDALONE % ("CLASSICAL", "PMIS", "V")
+    tpl = tpl.replace('"selector": "PMIS"',
+                      '"selector": "PMIS", "strength": "AFFINITY"')
+    A = poisson_2d_5pt(24)
+    b = poisson_rhs(A.n_rows)
+    s, res = _solve(tpl, A, b)
+    assert int(res.status) == SUCCESS
+
+    # anisotropic: strong couplings must align with the stiff axis
+    n = 16
+    T = sps.diags_array([-np.ones(n - 1), 2 * np.ones(n),
+                         -np.ones(n - 1)], offsets=[-1, 0, 1])
+    I = sps.eye_array(n)
+    Ah = (sps.kron(I, T) + 100.0 * sps.kron(T, I)).tocsr()
+    S = strength_affinity(Ah, 0.5)
+    coo = S.tocoo()
+    stiff = np.abs(coo.col - coo.row) >= n  # y-direction couplings
+    assert stiff.mean() > 0.8  # strong links predominantly stiff-axis
