@@ -448,20 +448,30 @@ def solver_create(res_h: int, mode: str, cfg_h: int) -> int:
     )
 
 
-def solver_setup(slv_h: int, mtx_h: int):
-    from amgx_tpu.solvers.registry import create_solver
-
-    s = _get(slv_h, _SolverHandle)
+def _create_and_setup(handle, mtx_h, factory):
+    """Shared setup body for solver_setup / eig_solver_setup: guard the
+    matrix, allocate via the factory (KeyError -> RC_BAD_CONFIGURATION),
+    convert to the mode's matrix dtype, run setup."""
     m = _get(mtx_h, _Matrix)
     if m.A is None:
         raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
     try:
-        s.solver = create_solver(s.cfg.cfg, "default")
+        solver = factory(handle.cfg.cfg)
     except KeyError as e:
         raise AMGXError(RC_BAD_CONFIGURATION, str(e)) from None
     A = m.A
-    if np.dtype(A.values.dtype) != np.dtype(s.mode.mat_dtype):
-        A = A.astype(s.mode.mat_dtype)
+    if np.dtype(A.values.dtype) != np.dtype(handle.mode.mat_dtype):
+        A = A.astype(handle.mode.mat_dtype)
+    return solver, A, m
+
+
+def solver_setup(slv_h: int, mtx_h: int):
+    from amgx_tpu.solvers.registry import create_solver
+
+    s = _get(slv_h, _SolverHandle)
+    s.solver, A, m = _create_and_setup(
+        s, mtx_h, lambda cfg: create_solver(cfg, "default")
+    )
     s.solver.setup(A)
     s.matrix = m
     return RC_OK
@@ -527,6 +537,102 @@ def solver_resetup(slv_h: int, mtx_h: int):
 
 
 def solver_destroy(slv_h):
+    _objects.pop(slv_h, None)
+    return RC_OK
+
+
+# ---------------------------------------------------------------------------
+# eigensolver API (reference amgx_eig_c.h / src/amgx_eig_c.cu:
+# AMGX_eig_solver_create/setup/solve + AMG_EigenSolver wrapper)
+
+
+class _EigSolverHandle:
+    def __init__(self, res, mode, cfg):
+        self.res = res
+        self.mode = mode
+        self.cfg = cfg
+        self.solver = None
+        self.result = None
+        self.personalization = None
+
+
+def eig_solver_create(res_h: int, mode: str, cfg_h: int) -> int:
+    try:
+        m = mode_from_name(mode)
+    except ValueError as e:
+        raise AMGXError(RC_BAD_MODE, str(e)) from None
+    _ensure_dtype_support(m)
+    return _new(
+        _EigSolverHandle(_get(res_h, _Resources), m, _get(cfg_h, _Config))
+    )
+
+
+def eig_solver_setup(slv_h: int, mtx_h: int):
+    from amgx_tpu.eigensolvers import create_eigensolver
+
+    s = _get(slv_h, _EigSolverHandle)
+    s.solver, A, _ = _create_and_setup(
+        s, mtx_h, lambda cfg: create_eigensolver(cfg, "default")
+    )
+    if s.personalization is not None:
+        s.solver.personalization = s.personalization
+    s.solver.setup(A)
+    return RC_OK
+
+
+def eig_solver_pagerank_setup(slv_h: int, vec_h: int):
+    """Reference AMG_EigenSolver::pagerank_setup: the vector supplies the
+    teleport/dangling-redistribution distribution.  Must be called before
+    eig_solver_setup."""
+    s = _get(slv_h, _EigSolverHandle)
+    if vec_h:
+        v = _get(vec_h, _Vector)
+        if v.data is None:
+            raise AMGXError(RC_BAD_PARAMETERS, "vector empty")
+        s.personalization = np.asarray(v.data, dtype=np.float64)
+    return RC_OK
+
+
+def eig_solver_solve(slv_h: int, x0_h: int = 0):
+    s = _get(slv_h, _EigSolverHandle)
+    if s.solver is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "eigensolver not set up")
+    x0 = None
+    if x0_h:
+        v = _get(x0_h, _Vector)
+        x0 = v.data
+    s.result = s.solver.solve(x0=x0)
+    return RC_OK
+
+
+def eig_solver_get_eigenvalues(slv_h: int) -> np.ndarray:
+    s = _get(slv_h, _EigSolverHandle)
+    if s.result is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no eig solve yet")
+    lam = np.asarray(s.result.eigenvalues)
+    # honor the mode's value dtype (the C shim sizes buffers by it):
+    # real modes get the real part (Arnoldi may return complex pairs)
+    vdt = np.dtype(s.mode.vec_dtype)
+    if np.issubdtype(vdt, np.complexfloating):
+        return lam.astype(vdt)
+    return np.ascontiguousarray(np.real(lam), dtype=vdt)
+
+
+def eig_solver_get_eigenvector(slv_h: int, idx: int, vec_h: int):
+    s = _get(slv_h, _EigSolverHandle)
+    if s.result is None or s.result.eigenvectors is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no eigenvectors available")
+    ev = s.result.eigenvectors
+    if not (0 <= idx < ev.shape[1]):
+        raise AMGXError(RC_BAD_PARAMETERS, f"eigenvector {idx} not found")
+    v = _get(vec_h, _Vector)
+    v.data = np.ascontiguousarray(
+        np.real(ev[:, idx]), dtype=v.mode.vec_dtype
+    )
+    return RC_OK
+
+
+def eig_solver_destroy(slv_h: int):
     _objects.pop(slv_h, None)
     return RC_OK
 

@@ -65,9 +65,14 @@ class SingleIterationEigenSolver(EigenSolver):
             self._inner = make_nested(create_solver(self.cfg, self.scope))
             self._inner.setup(solve_A)
         if self.which == "pagerank":
-            # column-normalized |A| as the link matrix (host)
+            # column-normalized |A| as the link matrix; dangling columns
+            # (no out-links) redistribute their mass via the teleport
+            # distribution (reference update_dangling_nodes)
             sp = A.to_scipy()
             colsum = np.asarray(np.abs(sp).sum(axis=0)).ravel()
+            self._dangling = jnp.asarray(
+                (colsum == 0).astype(np.float64)
+            )
             colsum = np.where(colsum > 0, colsum, 1.0)
             import scipy.sparse as sps
 
@@ -76,6 +81,18 @@ class SingleIterationEigenSolver(EigenSolver):
             self._google = SparseMatrix.from_scipy(
                 (abs(sp) @ sps.diags_array(1.0 / colsum)).tocsr()
             )
+            # teleport distribution: personalization vector when supplied
+            # (AMGX_eigensolver_pagerank_setup), else uniform
+            pers = getattr(self, "personalization", None)
+            if pers is not None:
+                pers = np.abs(np.asarray(pers, dtype=np.float64))
+                tot = pers.sum()
+                pers = pers / (tot if tot > 0 else 1.0)
+                self._teleport = jnp.asarray(pers)
+            else:
+                self._teleport = jnp.full(
+                    (A.n_rows,), 1.0 / A.n_rows
+                )
 
     def solve(self, x0=None) -> EigenResult:
         A = self.A
@@ -92,12 +109,17 @@ class SingleIterationEigenSolver(EigenSolver):
         if self.which == "pagerank":
             G = self._google
             d = self.damping
-            # Perron vector: start uniform positive (stays positive)
-            v = jnp.full((n,), 1.0 / n, dtype=dtype)
+            dang = self._dangling.astype(dtype)
+            tele = self._teleport.astype(dtype)
+            # Perron vector: start from the teleport distribution
+            v = tele
 
             @jax.jit
             def step(v):
-                w = d * spmv(G, v) + (1.0 - d) / n * jnp.sum(v)
+                dangling_mass = jnp.dot(dang, v)
+                w = d * (spmv(G, v) + dangling_mass * tele) + (
+                    1.0 - d
+                ) * jnp.sum(v) * tele
                 return w / jnp.sum(jnp.abs(w))
 
             for it in range(1, self.max_iters + 1):

@@ -164,3 +164,30 @@ def test_generate_poisson():
     capi.generate_distributed_poisson_7pt(A, b, 0, 6, 6, 6)
     n, _, _ = capi.matrix_get_size(A)
     assert n == 216
+
+
+def test_eig_solver_api():
+    """AMGX_eig_* handle flow (reference amgx_eig_c.h)."""
+    cfg = capi.config_create(
+        "eig_solver=LANCZOS, eig_max_iters=200, eig_tolerance=1e-8,"
+        " eig_which=largest, eig_wanted_count=2, eig_subspace_size=60"
+    )
+    res = capi.resources_create_simple(cfg)
+    A, sp = _upload_poisson(res, n_side=12)
+    es = capi.eig_solver_create(res, "dDDI", cfg)
+    capi.eig_solver_setup(es, A)
+    capi.eig_solver_solve(es)
+    lam = capi.eig_solver_get_eigenvalues(es)
+    import scipy.sparse.linalg as spla
+
+    true = np.sort(spla.eigsh(sp, k=2, which="LM")[0])[::-1]
+    np.testing.assert_allclose(lam[:2], true, rtol=1e-6)
+    v = capi.vector_create(res, "dDDI")
+    capi.eig_solver_get_eigenvector(es, 0, v)
+    x = capi.vector_download(v)
+    x = x / np.linalg.norm(x)
+    rel = np.linalg.norm(sp @ x - lam[0] * x) / lam[0]
+    assert rel < 1e-5
+    with pytest.raises(capi.AMGXError):
+        capi.eig_solver_get_eigenvector(es, 99, v)
+    capi.eig_solver_destroy(es)
