@@ -71,15 +71,35 @@ def main():
 
     rng = np.random.default_rng(0)
     n1, n2 = 20, 120
-    t1 = _time_chain(_chain(n1), A, n, rng)
-    t2 = _time_chain(_chain(n2), A, n, rng)
-    per_iter = max((t2 - t1) / (n2 - n1), 1e-9)
+    # physical floor: ~2 bytes/nnz at 2 TB/s — generous enough for any
+    # real chip (a v5p DIA SpMV still moves >=4 bytes/nnz), but orders of
+    # magnitude above the axon tunnel's async-caching artifacts (which
+    # report near-zero marginals).  Retry on artifacts; fall back to the
+    # overhead-inclusive bound validated across attempts.
+    floor = 2.0 * nnz / 2e12
+    chain1, chain2 = _chain(n1), _chain(n2)  # compile once
+    per_iter = None
+    t2_samples = []
+    for attempt in range(5):
+        t1 = _time_chain(chain1, A, n, rng)
+        t2 = _time_chain(chain2, A, n, rng)
+        t2_samples.append(t2)
+        cand = (t2 - t1) / (n2 - n1)
+        print(
+            f"bench[{attempt}]: chains {n1}:{t1*1e3:.1f}ms "
+            f"{n2}:{t2*1e3:.1f}ms -> {cand*1e3:.3f} ms/SpMV",
+            file=sys.stderr,
+        )
+        if cand >= floor:
+            per_iter = cand
+            break
+    if per_iter is None:
+        # conservative, overhead-inclusive; median across attempts so a
+        # single artifacted sample cannot set the number
+        per_iter = max(float(np.median(t2_samples)) / n2, floor)
+        print("bench: marginal timing unstable; using total-time bound",
+              file=sys.stderr)
     gflops = 2.0 * nnz / per_iter / 1e9
-    print(
-        f"bench: chains {n1}:{t1*1e3:.1f}ms {n2}:{t2*1e3:.1f}ms -> "
-        f"{per_iter*1e3:.3f} ms/SpMV",
-        file=sys.stderr,
-    )
     print(
         json.dumps(
             {
