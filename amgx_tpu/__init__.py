@@ -39,6 +39,19 @@ def initialize():
     global _initialized
     if _initialized:
         return
+    # Honor an explicit JAX_PLATFORMS env pin.  Platform plugins (e.g.
+    # a remote-TPU sitecustomize) may override jax_platforms via
+    # jax.config at interpreter start, which silently defeats the user's
+    # env selection — embedded-interpreter hosts (the native C API) have
+    # no other way to choose the backend.
+    import os
+
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        import jax
+
+        if jax.config.jax_platforms != env_platforms:
+            jax.config.update("jax_platforms", env_platforms)
     # Importing the registries triggers registration (reference core.cu:552-688).
     import amgx_tpu.solvers  # noqa: F401
     import amgx_tpu.amg  # noqa: F401

@@ -148,7 +148,115 @@ SELECTOR_PASSES = {
     "SIZE_8": 3,
     "MULTI_PAIRWISE": None,  # uses aggregation_passes config
     "DUMMY": 1,
+    "GEO": 3,
 }
+
+
+# ---------------------------------------------------------------------------
+# Structured (geometric) aggregation — the TPU fast path.
+#
+# Reference parity: GEO selector (src/aggregation/selectors/geo_selector.cu)
+# aggregates by spatial blocks using user-attached geometry.  Here the
+# geometry is *inferred* from the stencil structure instead: a matrix whose
+# distinct diagonals decompose as a + b*nx + c*nx*ny (a,b,c in {-1,0,1})
+# is a <=27-point stencil on an (nx, ny, nz) grid.  Aggregating such
+# matrices in 2x2x2 lexicographic blocks keeps EVERY Galerkin coarse
+# operator a <=27-point stencil on the coarser grid, so the whole AMG
+# hierarchy rides the DIA shift+FMA SpMV path (no TPU gathers at any
+# level).  Irregular matching (the fallback below) destroys bandedness
+# and forces coarse levels onto gather-bound formats.
+
+
+def stencil_offsets(Asp: sps.csr_matrix, max_diags: int = 64):
+    """Distinct diagonal offsets of A if there are few, else None."""
+    coo = Asp.tocoo()
+    offs = np.unique(coo.col.astype(np.int64) - coo.row.astype(np.int64))
+    if offs.size > max_diags:
+        return None
+    return offs
+
+
+def infer_grid(offsets, n: int):
+    """Infer (nx, ny, nz) with nx*ny*nz == n from stencil diagonal
+    offsets; None if the offsets are not <=27-point-stencil shaped.
+
+    A wrong-but-validating guess only degrades aggregate shapes (the
+    Galerkin product is correct for any partition), never correctness.
+    """
+    offs = set(int(o) for o in offsets)
+    pos = sorted(o for o in offs if o > 0)
+    if not pos or n < 8:
+        return None
+
+    def allowed_set(nx, ny, nz):
+        out = set()
+        for a in (-1, 0, 1) if nx > 1 else (0,):
+            for b in (-1, 0, 1) if ny > 1 else (0,):
+                for c in (-1, 0, 1) if nz > 1 else (0,):
+                    out.add(a + b * nx + c * nx * ny)
+        return out
+
+    cands_nx = {n}  # 1D chain
+    for o in pos:
+        for d in (o - 1, o, o + 1):
+            if 2 <= d < n and n % d == 0:
+                cands_nx.add(d)
+    best = None
+    best_score = None
+    for nx in sorted(cands_nx):
+        rem = n // nx
+        cands_ny = {rem}
+        for o in pos:
+            for d in (o - 1, o, o + 1):
+                if d >= 2 * nx and d % nx == 0 and rem % (d // nx) == 0:
+                    cands_ny.add(d // nx)
+        for ny in sorted(cands_ny):
+            if ny < 1 or rem % ny:
+                continue
+            nz = rem // ny
+            if offs <= allowed_set(nx, ny, nz):
+                # prefer geometries whose primary strides are actual
+                # offsets (true stencil axes), then the most cubic one
+                score = (
+                    (nx in offs or ny == 1)
+                    + (nx * ny in offs or nz == 1),
+                    -(max(nx, ny, nz) / max(min(nx, ny, nz), 1)),
+                )
+                if best is None or score > best_score:
+                    best, best_score = (nx, ny, nz), score
+    return best
+
+
+def geo_aggregate(nx: int, ny: int, nz: int, passes: int) -> np.ndarray:
+    """Blocked lexicographic aggregation on an (nx, ny, nz) grid.
+
+    Each pass halves the currently-largest axis (ties: x before y before
+    z), so SIZE_2 -> 2x1x1, SIZE_4 -> 2x2x1, SIZE_8 -> 2x2x2 on a cube —
+    the reference selector sizes — and coarse aggregates are numbered
+    lexicographically on the coarse grid (bandedness preserved).
+    """
+    dims = [nx, ny, nz]
+    block = [1, 1, 1]
+    for _ in range(passes):
+        ratios = [
+            dims[a] / block[a] if dims[a] > block[a] else 0.0
+            for a in range(3)
+        ]
+        axis = int(np.argmax(ratios))
+        if ratios[axis] <= 1.0:
+            break
+        block[axis] *= 2
+    cdims = [-(-dims[a] // block[a]) for a in range(3)]
+    i = np.arange(nx * ny * nz, dtype=np.int64)
+    ix = i % nx
+    iy = (i // nx) % ny
+    iz = i // (nx * ny)
+    agg = (
+        ix // block[0]
+        + cdims[0] * (iy // block[1])
+        + cdims[0] * cdims[1] * (iz // block[2])
+    )
+    return agg.astype(np.int32)
 
 
 def build_aggregation_level(Asp, cfg, scope):
@@ -161,7 +269,16 @@ def build_aggregation_level(Asp, cfg, scope):
         passes = int(cfg.get("aggregation_passes", scope))
     formula = int(cfg.get("weight_formula", scope))
     merge = bool(cfg.get("merge_singletons", scope))
-    agg = aggregate(Asp, passes, formula, merge)
+    agg = None
+    if bool(cfg.get("structured_aggregation", scope)) or selector == "GEO":
+        offs = stencil_offsets(Asp)
+        grid = (
+            infer_grid(offs, Asp.shape[0]) if offs is not None else None
+        )
+        if grid is not None:
+            agg = geo_aggregate(*grid, passes)
+    if agg is None:
+        agg = aggregate(Asp, passes, formula, merge)
     n = Asp.shape[0]
     nc = int(agg.max()) + 1
     P = sps.csr_matrix(

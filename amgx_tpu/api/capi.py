@@ -22,27 +22,31 @@ from amgx_tpu.config.amg_config import AMGConfig, ConfigError
 from amgx_tpu.core.matrix import SparseMatrix
 from amgx_tpu.core.types import mode_from_name
 
-# AMGX_RC codes (reference amgx_c.h:52-69)
+# AMGX_RC codes — exact reference values (amgx_c.h:52-69) so host apps
+# compiled against the reference header interpret codes identically.
+# THRUST_FAILURE / NO_MEMORY are kept as placeholders for ABI parity.
 RC_OK = 0
 RC_BAD_PARAMETERS = 1
 RC_UNKNOWN = 2
 RC_NOT_SUPPORTED_TARGET = 3
 RC_NOT_SUPPORTED_BLOCKSIZE = 4
 RC_CUDA_FAILURE = 5
-RC_IO_ERROR = 6
-RC_BAD_MODE = 7
-RC_CORE = 8
-RC_PLUGIN = 9
-RC_BAD_CONFIGURATION = 10
-RC_NOT_IMPLEMENTED = 11
-RC_LICENSE_NOT_FOUND = 12
-RC_INTERNAL = 13
+RC_THRUST_FAILURE = 6
+RC_NO_MEMORY = 7
+RC_IO_ERROR = 8
+RC_BAD_MODE = 9
+RC_CORE = 10
+RC_PLUGIN = 11
+RC_BAD_CONFIGURATION = 12
+RC_NOT_IMPLEMENTED = 13
+RC_LICENSE_NOT_FOUND = 14
+RC_INTERNAL = 15
 
-# solve status (reference AMGX_SOLVE_*)
+# solve status (reference AMGX_SOLVE_*, amgx_c.h:75-80)
 SOLVE_SUCCESS = 0
 SOLVE_FAILED = 1
 SOLVE_DIVERGED = 2
-SOLVE_NOT_CONVERGED = 2
+SOLVE_NOT_CONVERGED = 3
 
 
 class AMGXError(Exception):

@@ -95,7 +95,7 @@ register("kpz_mu", I, 4, "KPZ polynomial mu")
 register("kpz_order", I, 3, "KPZ polynomial order")
 register("chebyshev_polynomial_order", I, 5, "Chebyshev order")
 register("chebyshev_lambda_estimate_mode", I, 0,
-         "0: power-iteration estimate, 1: user lambda")
+         "0-2: power-iteration estimate, 3: user cheby_min/max_lambda")
 register("cheby_max_lambda", F, 1.0, "user max eigenvalue guess")
 register("cheby_min_lambda", F, 0.125, "user min eigenvalue guess")
 register("kaczmarz_coloring_needed", I, 1, "")
@@ -137,6 +137,10 @@ register("max_matching_iterations", I, 15, "pairwise matching iterations")
 register("max_unassigned_percentage", F, 0.05, "")
 register("weight_formula", I, 0, "aggregation edge-weight formula")
 register("aggregation_passes", I, 3, "MULTI_PAIRWISE passes")
+register("structured_aggregation", I, 1,
+         "aggregate stencil-structured matrices in geometric blocks so "
+         "coarse operators stay banded (TPU DIA fast path); 0 forces "
+         "matching-based aggregation")
 register("filter_weights", I, 0, "")
 register("filter_weights_alpha", F, 0.5, "")
 register("full_ghost_level", I, 0, "")

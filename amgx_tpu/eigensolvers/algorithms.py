@@ -289,7 +289,7 @@ class LanczosEigenSolver(EigenSolver):
             eigenvalues=lam,
             eigenvectors=X,
             iterations=len(alphas),
-            converged=res < max(self.tolerance, 1e-8) * 100,
+            converged=res < self.tolerance,
             residual=res,
         )
 
@@ -335,7 +335,7 @@ class ArnoldiEigenSolver(EigenSolver):
             eigenvalues=lam,
             eigenvectors=X,
             iterations=m,
-            converged=True,
+            converged=res < self.tolerance,
             residual=res,
         )
 

@@ -38,10 +38,11 @@ from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.ops.norms import norm as _norm, block_norm as _block_norm
 from amgx_tpu.solvers.convergence import make_convergence_check
 
-# AMGX_SOLVE_* status codes (reference amgx_c.h / AMGX_STATUS)
+# AMGX_SOLVE_* status codes (reference amgx_c.h:75-80)
 SUCCESS = 0
-FAILED = 1  # diverged or NaN
-NOT_CONVERGED = 2
+FAILED = 1  # hard failure (NaN/Inf residual)
+DIVERGED = 2  # rel_div_tolerance exceeded
+NOT_CONVERGED = 3
 
 
 @jax.tree_util.register_dataclass
@@ -49,7 +50,7 @@ NOT_CONVERGED = 2
 class SolveResult:
     x: jnp.ndarray
     iters: jnp.ndarray  # i32 scalar
-    status: jnp.ndarray  # i32 scalar: SUCCESS/FAILED/NOT_CONVERGED
+    status: jnp.ndarray  # i32: SUCCESS/FAILED/DIVERGED/NOT_CONVERGED
     final_norm: jnp.ndarray  # (ncomp,) real
     initial_norm: jnp.ndarray  # (ncomp,) real
     history: jnp.ndarray  # (max_iters+1, ncomp) real, NaN-padded
@@ -244,13 +245,13 @@ class Solver:
         hist = hist.at[it].set(nrm)
         done_ok = self._conv_check(nrm, nrm_ini, nrm_max)
         bad = ~jnp.all(jnp.isfinite(nrm))
-        if self.rel_div_tolerance > 0:
-            bad = bad | jnp.any(nrm > self.rel_div_tolerance * nrm_ini)
         status = jnp.where(
-            bad,
-            jnp.int32(FAILED),
-            jnp.where(done_ok, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)),
+            done_ok, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
         )
+        if self.rel_div_tolerance > 0:
+            div = jnp.any(nrm > self.rel_div_tolerance * nrm_ini)
+            status = jnp.where(div, jnp.int32(DIVERGED), status)
+        status = jnp.where(bad, jnp.int32(FAILED), status)
         return (it, x, extra, nrm, nrm_ini, nrm_max, hist, status)
 
     def _fixed_result(self, x, b, iters) -> SolveResult:
@@ -386,7 +387,12 @@ class Solver:
                 rate = r / prev if prev > 0 else 0.0
                 lines.append(f"            {i:3d} {r:18.6e} {rate:14.4f}")
         st = int(res.status)
-        label = {0: "success", 1: "failed (diverged/nan)", 2: "not converged"}[st]
+        label = {
+            SUCCESS: "success",
+            FAILED: "failed (nan/inf)",
+            DIVERGED: "diverged",
+            NOT_CONVERGED: "not converged",
+        }.get(st, f"unknown ({st})")
         lines.append("         --------------------------------------")
         emit("\n".join(lines))
         emit(

@@ -6,8 +6,9 @@ preconditioner is the nested 'preconditioner' solver when configured
 (e.g. JACOBI_L1 in AMG_CLASSICAL_AGGRESSIVE_CHEB_L1_TRUNC.json),
 otherwise plain Jacobi D^{-1}.
 
-Interval: chebyshev_lambda_estimate_mode == 1 takes the user's
-cheby_min/max_lambda; every other mode estimates lmax by power iteration
+Interval: chebyshev_lambda_estimate_mode == 3 takes the user's
+cheby_min/max_lambda verbatim (reference cheb_solver.cu:209-211); modes
+0-2 estimate lmax by power iteration
 on M^{-1}A at setup (the reference's estimate modes differ only in GPU
 implementation strategy), with lmin = cheby_min_lambda * lmax (reference
 default ratio 0.125).

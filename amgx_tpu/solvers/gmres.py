@@ -20,7 +20,13 @@ import jax
 import jax.numpy as jnp
 
 from amgx_tpu.ops.spmv import spmv
-from amgx_tpu.solvers.base import NOT_CONVERGED, SUCCESS, SolveResult
+from amgx_tpu.solvers.base import (
+    DIVERGED,
+    FAILED,
+    NOT_CONVERGED,
+    SUCCESS,
+    SolveResult,
+)
 from amgx_tpu.solvers.krylov import KrylovSolver
 from amgx_tpu.solvers.registry import register_solver
 
@@ -106,15 +112,17 @@ class FGMRESSolver(KrylovSolver):
                 nrm = jnp.atleast_1d(res_est)
                 mx = jnp.maximum(mx, nrm)
                 done = conv_check(nrm, ini, mx)
-                bad = ~jnp.isfinite(res_est)
-                if rel_div > 0:
-                    bad = bad | jnp.any(nrm > rel_div * ini)
                 status = jnp.where(
-                    bad,
-                    jnp.int32(1),
-                    jnp.where(
-                        done, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
-                    ),
+                    done, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
+                )
+                if rel_div > 0:
+                    status = jnp.where(
+                        jnp.any(nrm > rel_div * ini),
+                        jnp.int32(DIVERGED),
+                        status,
+                    )
+                status = jnp.where(
+                    ~jnp.isfinite(res_est), jnp.int32(FAILED), status
                 )
                 return (j + 1, V, Z, H, g, cs, sn, it, hist, status, ini, mx)
 

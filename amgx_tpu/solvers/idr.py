@@ -16,6 +16,7 @@ import numpy as np
 from amgx_tpu.ops.blas import dot
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import (
+    DIVERGED,
     FAILED,
     NOT_CONVERGED,
     SUCCESS,
@@ -100,15 +101,17 @@ class IDRSolver(KrylovSolver):
                 nrm_max = jnp.maximum(nrm_max, nrm)
                 hist = hist.at[it].set(nrm)
                 done = conv_check(nrm, nrm0, nrm_max)
-                bad = ~jnp.all(jnp.isfinite(nrm))
-                if rel_div > 0:
-                    bad = bad | jnp.any(nrm > rel_div * nrm0)
                 status = jnp.where(
-                    bad,
-                    jnp.int32(FAILED),
-                    jnp.where(
-                        done, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
-                    ),
+                    done, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
+                )
+                if rel_div > 0:
+                    status = jnp.where(
+                        jnp.any(nrm > rel_div * nrm0),
+                        jnp.int32(DIVERGED),
+                        status,
+                    )
+                status = jnp.where(
+                    ~jnp.all(jnp.isfinite(nrm)), jnp.int32(FAILED), status
                 )
                 return (it, x, r, G, U, Mm, om, nrm_max, hist, status)
 

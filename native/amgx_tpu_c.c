@@ -17,6 +17,8 @@
 #include <Python.h>
 #include <string.h>
 #include <stdio.h>
+#include <dlfcn.h>
+#include <libgen.h>
 
 #include "amgx_tpu_c.h"
 
@@ -126,9 +128,32 @@ static AMGX_RC call_rc(const char *fn, PyObject *args, int had_args) {
 
 /* ------------------------------------------------------------------ */
 
+/* The amgx_tpu package lives next to this library's directory
+ * (<repo>/native/libamgx_tpu_c.so, <repo>/amgx_tpu/).  Host apps can run
+ * from anywhere, so locate the .so via dladdr and put its parent dir —
+ * plus the cwd — on sys.path before the first import (GIL held). */
+static void add_package_to_syspath(void) {
+  Dl_info info;
+  char buf[4096];
+  PyObject *sys_path = PySys_GetObject("path"); /* borrowed */
+  if (!sys_path) return;
+  if (dladdr((void *)&add_package_to_syspath, &info) && info.dli_fname) {
+    strncpy(buf, info.dli_fname, sizeof(buf) - 1);
+    buf[sizeof(buf) - 1] = '\0';
+    char *dir = dirname(buf);    /* <repo>/native */
+    char *repo = dirname(dir);   /* <repo> */
+    PyObject *p = PyUnicode_FromString(repo);
+    if (p) {
+      PyList_Append(sys_path, p);
+      Py_DECREF(p);
+    }
+  }
+}
+
 AMGX_RC AMGX_initialize(void) {
   if (!Py_IsInitialized()) {
     Py_Initialize();
+    add_package_to_syspath();
     PyObject *mod = PyImport_ImportModule("amgx_tpu.api.capi");
     if (!mod) {
       PyErr_Print();

@@ -17,6 +17,8 @@
 extern "C" {
 #endif
 
+/* Exact reference enum values (amgx_c.h:52-69); THRUST_FAILURE and
+ * NO_MEMORY are placeholders kept so every later code matches. */
 typedef enum {
   AMGX_RC_OK = 0,
   AMGX_RC_BAD_PARAMETERS = 1,
@@ -24,21 +26,23 @@ typedef enum {
   AMGX_RC_NOT_SUPPORTED_TARGET = 3,
   AMGX_RC_NOT_SUPPORTED_BLOCKSIZE = 4,
   AMGX_RC_CUDA_FAILURE = 5,
-  AMGX_RC_IO_ERROR = 6,
-  AMGX_RC_BAD_MODE = 7,
-  AMGX_RC_CORE = 8,
-  AMGX_RC_PLUGIN = 9,
-  AMGX_RC_BAD_CONFIGURATION = 10,
-  AMGX_RC_NOT_IMPLEMENTED = 11,
-  AMGX_RC_LICENSE_NOT_FOUND = 12,
-  AMGX_RC_INTERNAL = 13
+  AMGX_RC_THRUST_FAILURE = 6,
+  AMGX_RC_NO_MEMORY = 7,
+  AMGX_RC_IO_ERROR = 8,
+  AMGX_RC_BAD_MODE = 9,
+  AMGX_RC_CORE = 10,
+  AMGX_RC_PLUGIN = 11,
+  AMGX_RC_BAD_CONFIGURATION = 12,
+  AMGX_RC_NOT_IMPLEMENTED = 13,
+  AMGX_RC_LICENSE_NOT_FOUND = 14,
+  AMGX_RC_INTERNAL = 15
 } AMGX_RC;
 
 typedef enum {
   AMGX_SOLVE_SUCCESS = 0,
   AMGX_SOLVE_FAILED = 1,
   AMGX_SOLVE_DIVERGED = 2,
-  AMGX_SOLVE_NOT_CONVERGED = 2
+  AMGX_SOLVE_NOT_CONVERGED = 3
 } AMGX_SOLVE_STATUS;
 
 typedef uintptr_t AMGX_config_handle;

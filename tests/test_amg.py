@@ -279,3 +279,66 @@ def test_affinity_strength_amg():
     coo = S.tocoo()
     stiff = np.abs(coo.col - coo.row) >= n  # y-direction couplings
     assert stiff.mean() > 0.8  # strong links predominantly stiff-axis
+
+
+# ---------------------------------------------------------------------------
+# structured (geometric) aggregation — the TPU all-DIA hierarchy path
+# (reference GEO selector, src/aggregation/selectors/geo_selector.cu; here
+# geometry is inferred from the stencil diagonals)
+
+
+def test_infer_grid_from_stencils():
+    from amgx_tpu.amg.aggregation import infer_grid, stencil_offsets
+
+    A3 = poisson_3d_7pt(12).to_scipy()
+    assert infer_grid(stencil_offsets(A3), 12 ** 3) == (12, 12, 12)
+    A2 = poisson_2d_5pt(20).to_scipy()
+    nx, ny, nz = infer_grid(stencil_offsets(A2), 400)
+    assert (nx, ny) == (20, 20) and nz == 1
+    # unstructured matrix -> None
+    from tests.conftest import random_csr
+
+    R = random_csr(512, density=0.02, seed=5)
+    offs = stencil_offsets(R)
+    assert offs is None or infer_grid(offs, 512) is None
+
+
+def test_geo_aggregate_blocks():
+    from amgx_tpu.amg.aggregation import geo_aggregate
+
+    agg = geo_aggregate(4, 4, 4, 3)  # 2x2x2 blocks
+    assert agg.shape == (64,)
+    assert int(agg.max()) + 1 == 8
+    sizes = np.bincount(agg)
+    assert (sizes == 8).all()
+    # lexicographic block numbering: node (0,0,0) and (1,1,1) share a block
+    assert agg[0] == agg[1 + 4 + 16]
+
+
+def test_structured_aggregation_all_dia_hierarchy():
+    """Every Galerkin coarse operator of a stencil problem stays DIA."""
+    A = poisson_3d_7pt(16)
+    b = poisson_rhs(A.n_rows)
+    s, res = _solve(
+        AMG_STANDALONE % ("AGGREGATION", "SIZE_8", "V"), A, b
+    )
+    assert int(res.status) == SUCCESS
+    for lvl in s.levels:
+        assert lvl.A.has_dia or lvl.A.n_rows <= 64, (
+            lvl.level_id,
+            lvl.A.n_rows,
+        )
+
+
+def test_structured_aggregation_opt_out():
+    from amgx_tpu.amg.aggregation import build_aggregation_level
+
+    A = poisson_3d_7pt(8).to_scipy()
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "AMG", "selector": "SIZE_2",'
+        ' "structured_aggregation": 0}}'
+    )
+    P, R, Ac = build_aggregation_level(A, cfg, "main")
+    # matching-based path still works and coarsens
+    assert Ac.shape[0] < A.shape[0]
