@@ -227,23 +227,53 @@ def infer_grid(offsets, n: int):
     return best
 
 
-def geo_aggregate(nx: int, ny: int, nz: int, passes: int) -> np.ndarray:
+def axis_strengths(Asp: sps.csr_matrix, nx: int, ny: int, nz: int):
+    """Mean |coupling| along each grid axis (offsets ±1, ±nx, ±nx·ny).
+
+    Drives the semicoarsening decision: anisotropic stencils must be
+    aggregated along the STRONG axis (classical strength-of-connection
+    semantics), not by grid shape.
+    """
+    coo = Asp.tocoo()
+    d = coo.col.astype(np.int64) - coo.row.astype(np.int64)
+    av = np.abs(coo.data)
+    out = []
+    for stride, dim in ((1, nx), (nx, ny), (nx * ny, nz)):
+        if dim <= 1:
+            out.append(0.0)
+            continue
+        m = np.abs(d) == stride
+        out.append(float(av[m].mean()) if m.any() else 0.0)
+    return out
+
+
+def geo_aggregate(
+    nx: int, ny: int, nz: int, passes: int, strengths=None
+) -> np.ndarray:
     """Blocked lexicographic aggregation on an (nx, ny, nz) grid.
 
-    Each pass halves the currently-largest axis (ties: x before y before
-    z), so SIZE_2 -> 2x1x1, SIZE_4 -> 2x2x1, SIZE_8 -> 2x2x2 on a cube —
-    the reference selector sizes — and coarse aggregates are numbered
-    lexicographically on the coarse grid (bandedness preserved).
+    Each pass halves one axis: the one with the largest remaining
+    coupling-strength-to-block ratio (``strengths`` from
+    :func:`axis_strengths`; unit strengths when absent).  Isotropic
+    stencils get the reference selector block shapes (SIZE_2 -> 2x1x1,
+    SIZE_4 -> 2x2x1, SIZE_8 -> 2x2x2 on a cube); anisotropic stencils
+    semicoarsen along the strong axis.  Coarse aggregates are numbered
+    lexicographically on the coarse grid, so bandedness is preserved.
     """
     dims = [nx, ny, nz]
     block = [1, 1, 1]
+    s = list(strengths) if strengths is not None else [1.0, 1.0, 1.0]
+    smax = max(s) if max(s) > 0 else 1.0
+    # breaking exact ties by dims keeps large axes first on cubes
     for _ in range(passes):
         ratios = [
-            dims[a] / block[a] if dims[a] > block[a] else 0.0
+            (s[a] / smax + 1e-9 * dims[a]) / block[a]
+            if dims[a] > block[a]
+            else 0.0
             for a in range(3)
         ]
         axis = int(np.argmax(ratios))
-        if ratios[axis] <= 1.0:
+        if ratios[axis] <= 0.0:
             break
         block[axis] *= 2
     cdims = [-(-dims[a] // block[a]) for a in range(3)]
@@ -276,7 +306,9 @@ def build_aggregation_level(Asp, cfg, scope):
             infer_grid(offs, Asp.shape[0]) if offs is not None else None
         )
         if grid is not None:
-            agg = geo_aggregate(*grid, passes)
+            agg = geo_aggregate(
+                *grid, passes, strengths=axis_strengths(Asp, *grid)
+            )
     if agg is None:
         agg = aggregate(Asp, passes, formula, merge)
     n = Asp.shape[0]

@@ -55,6 +55,21 @@ def _static_field(**kw):
     return dataclasses.field(metadata=dict(static=True), **kw)
 
 
+def _want_tiled_ell() -> bool:
+    """Build the Pallas tiled-ELL arrays?  TPU backends only (the XLA
+    fallback uses the plain layout); AMGX_TPU_TILED_ELL=1/0 overrides
+    (tests force-build on CPU to exercise the interpret-mode kernel)."""
+    import os
+
+    env = os.environ.get("AMGX_TPU_TILED_ELL")
+    if env is not None:
+        return env == "1"
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class SparseMatrix:
@@ -85,6 +100,10 @@ class SparseMatrix:
     diag: jnp.ndarray
     ell_cols: Optional[jnp.ndarray]
     ell_vals: Optional[jnp.ndarray]
+    # Tiled ELL arrays (ops.pallas_spmv.tile_ell layout) for the Pallas
+    # lane-gather SpMV kernel; built on TPU backends only.
+    ell_tcols: Optional[jnp.ndarray] = None
+    ell_tvals: Optional[jnp.ndarray] = None
     # DIA structure: dia_vals[k, i] = A[i, i + dia_offsets[k]] (0 outside)
     dia_vals: Optional[jnp.ndarray] = None
     # dense copy for small unstructured matrices (SpMV = MXU matmul)
@@ -154,6 +173,12 @@ class SparseMatrix:
         if self.has_ell:
             ell_vals = _scatter_ell_vals(self, values)
             new = dataclasses.replace(new, ell_vals=ell_vals)
+            if self.ell_tvals is not None:
+                from amgx_tpu.ops.pallas_spmv import tile_ell_jnp
+
+                new = dataclasses.replace(
+                    new, ell_tvals=tile_ell_jnp(ell_vals)
+                )
         if self.has_dia:
             new = dataclasses.replace(
                 new, dia_vals=_scatter_dia_vals(self, values)
@@ -170,6 +195,8 @@ class SparseMatrix:
         )
         if self.has_ell:
             rep["ell_vals"] = self.ell_vals.astype(dtype)
+            if self.ell_tvals is not None:
+                rep["ell_tvals"] = self.ell_tvals.astype(dtype)
         if self.has_dia:
             rep["dia_vals"] = self.dia_vals.astype(dtype)
         if self.has_dense:
@@ -232,6 +259,7 @@ class SparseMatrix:
             np.add.at(dense, (row_ids, col_indices), values)
 
         ell_cols = ell_vals = None
+        ell_tcols = ell_tvals = None
         if (
             build_ell
             and n_rows > 0
@@ -245,6 +273,10 @@ class SparseMatrix:
                 ell_cols, ell_vals = _build_ell_np(
                     row_offsets, col_indices, values, n_rows, w, b
                 )
+                if b == 1 and w > 0 and _want_tiled_ell():
+                    from amgx_tpu.ops.pallas_spmv import tile_ell
+
+                    ell_tcols, ell_tvals = tile_ell(ell_cols, ell_vals)
 
         dev = jnp.asarray
         return SparseMatrix(
@@ -255,6 +287,8 @@ class SparseMatrix:
             diag=dev(diag),
             ell_cols=None if ell_cols is None else dev(ell_cols),
             ell_vals=None if ell_vals is None else dev(ell_vals),
+            ell_tcols=None if ell_tcols is None else dev(ell_tcols),
+            ell_tvals=None if ell_tvals is None else dev(ell_tvals),
             dia_vals=None if dia_vals is None else dev(dia_vals),
             dense=None if dense is None else dev(dense),
             n_rows=int(n_rows),

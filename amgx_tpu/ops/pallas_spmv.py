@@ -1,0 +1,194 @@
+"""Pallas TPU kernel for gather-bound (ELL) SpMV.
+
+Reference parity: the TPU answer to cuSPARSE bsrmv
+(/root/reference/src/amgx_cusparse.cu:49-102) — the reference's fast
+path for unstructured matrices.  Stencil-structured matrices ride the
+DIA shift+FMA path in :mod:`amgx_tpu.ops.spmv`; this kernel covers
+matrices (and AMG coarse levels) with no banded structure, where the
+stock XLA gather lowering is latency-bound (~50 ms for 6M elements on
+v5e, BENCHMARKS.md round 1).
+
+Design
+------
+ELL arrays are pre-arranged on host into a *tiled* layout: rows are
+grouped in tiles of 1024 = 8 sublanes x 128 lanes, and the ELL width
+axis is interleaved so slot ``k`` of the 128 rows ``r`` in sublane
+group ``s`` occupies the contiguous lane window ``[k*128, (k+1)*128)``:
+
+    tcols[t, s, k*128 + r] = ell_cols[t*1024 + s*128 + r, k]
+
+One kernel step then does a single wide ``jnp.take_along_axis`` along
+the lane axis of a sublane-replicated ``x`` (Mosaic's dynamic-gather),
+multiplies by the identically-laid-out values, and reduces the width
+axis as ``w`` static 128-lane register adds.  The (8, 128) result tile
+IS the output layout — flattening (t, s, r) row-major recovers ``y``
+with no final permutation.
+
+HBM traffic is ``8*nnz_padded + O(n)`` bytes — near-CSR — vs. the
+x-sized random-access stream of the XLA gather.  ``x`` wider than
+``_XCOL_MAX`` is processed in column blocks with masked accumulation so
+the staged block always fits VMEM.
+
+Mosaic support for wide dynamic lane gathers varies by TPU generation
+and jaxlib; :func:`pallas_spmv_supported` compile-probes the kernel
+once per backend, and callers fall back to the XLA path when
+unsupported.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # soft import: CPU-only deployments never touch the TPU dialect
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+_SUB = 8  # f32 sublanes
+_LANE = 128
+_ROW_TILE = _SUB * _LANE  # 1024 rows per grid step
+# Max x columns staged per pass: 8 replicated copies of a 128K block
+# of f32 = 4 MB of VMEM.
+_XCOL_MAX = 128 * 1024
+
+
+def tile_ell(ell_cols: np.ndarray, ell_vals: np.ndarray):
+    """Host-side re-layout (n, w) -> (ntiles, 8, w*128), k-major lanes."""
+    n, w = ell_cols.shape
+    pad = (-n) % _ROW_TILE
+    if pad:
+        ell_cols = np.pad(ell_cols, ((0, pad), (0, 0)))
+        ell_vals = np.pad(ell_vals, ((0, pad), (0, 0)))
+    nt = ell_cols.shape[0] // _ROW_TILE
+
+    def arrange(a):
+        a = a.reshape(nt, _SUB, _LANE, w)  # [t, s, r, k]
+        a = a.transpose(0, 1, 3, 2)  # [t, s, k, r]
+        return np.ascontiguousarray(a.reshape(nt, _SUB, w * _LANE))
+
+    return arrange(ell_cols.astype(np.int32)), arrange(ell_vals)
+
+
+def tile_ell_jnp(ell_vals):
+    """Traced value-only re-layout matching :func:`tile_ell` — used by
+    SparseMatrix.replace_values to refresh ell_tvals without leaving
+    the jit trace.  Must stay in lockstep with tile_ell's geometry."""
+    n, w = ell_vals.shape
+    pad = (-n) % _ROW_TILE
+    ev = jnp.pad(ell_vals, ((0, pad), (0, 0)))
+    nt = ev.shape[0] // _ROW_TILE
+    ev = ev.reshape(nt, _SUB, _LANE, w).transpose(0, 1, 3, 2)
+    return ev.reshape(nt, _SUB, w * _LANE)
+
+
+def _ell_kernel(cols_ref, vals_ref, x_ref, o_ref, *, w, nb, xb):
+    j = pl.program_id(1)
+    base = j * xb
+    x8 = jnp.broadcast_to(x_ref[:], (_SUB, xb))
+    idx = cols_ref[0]  # (8, w*128) absolute column ids
+    vals = vals_ref[0]
+    if nb > 1:
+        local = idx - base
+        in_blk = (local >= 0) & (local < xb)
+        local = jnp.where(in_blk, local, 0)
+        vals = jnp.where(in_blk, vals, 0)
+    else:
+        local = idx
+    g = jnp.take_along_axis(x8, local, axis=1)  # (8, w*128)
+    contrib = vals * g
+    acc = contrib[:, 0:_LANE]
+    for k in range(1, w):
+        acc = acc + contrib[:, k * _LANE:(k + 1) * _LANE]
+
+    if nb > 1:
+        @pl.when(j == 0)
+        def _init():
+            o_ref[0] = acc
+
+        @pl.when(j > 0)
+        def _accum():
+            o_ref[0] = o_ref[0] + acc
+    else:
+        o_ref[0] = acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_rows", "n_cols", "interpret")
+)
+def _pallas_ell_spmv(tcols, tvals, x, n_rows, n_cols, interpret=False):
+    """y = A @ x from tiled ELL arrays (see tile_ell)."""
+    nt, _, wl = tcols.shape
+    w = wl // _LANE
+    xb = min(_XCOL_MAX, -(-n_cols // _LANE) * _LANE)
+    nb = -(-n_cols // xb)
+    xp = jnp.pad(x, (0, nb * xb - n_cols)).reshape(nb, xb)
+
+    out = pl.pallas_call(
+        functools.partial(_ell_kernel, w=w, nb=nb, xb=xb),
+        grid=(nt, nb),
+        in_specs=[
+            pl.BlockSpec((1, _SUB, wl), lambda t, j: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _SUB, wl), lambda t, j: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, xb), lambda t, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _SUB, _LANE), lambda t, j: (t, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nt, _SUB, _LANE), tvals.dtype),
+        interpret=interpret,
+    )(tcols, tvals, xp)
+    return out.reshape(nt * _ROW_TILE)[:n_rows]
+
+
+class _Probe:
+    """Once-per-backend compile-and-run probe for the kernel."""
+
+    def __init__(self):
+        self._ok = {}
+
+    def __call__(self) -> bool:
+        if not _HAVE_PALLAS:
+            return False
+        backend = jax.default_backend()
+        if backend not in self._ok:
+            if backend != "tpu":
+                self._ok[backend] = False
+            else:
+                try:
+                    rng = np.random.default_rng(0)
+                    n, w = 2048, 3
+                    cols = rng.integers(0, n, (n, w))
+                    vals = rng.standard_normal((n, w)).astype(np.float32)
+                    tc, tv = tile_ell(cols, vals)
+                    y = _pallas_ell_spmv(
+                        jnp.asarray(tc), jnp.asarray(tv),
+                        jnp.arange(n, dtype=jnp.float32), n, n,
+                    )
+                    ref = (vals * np.arange(n, dtype=np.float32)[cols]).sum(1)
+                    ok = np.allclose(np.asarray(y), ref, rtol=1e-5)
+                    self._ok[backend] = bool(ok)
+                except Exception:
+                    self._ok[backend] = False
+        return self._ok[backend]
+
+
+pallas_spmv_supported = _Probe()
+
+
+def pallas_ell_spmv(A, x, interpret=False):
+    """y = A @ x via the Pallas kernel (A must carry tiled ELL arrays)."""
+    return _pallas_ell_spmv(
+        A.ell_tcols, A.ell_tvals, x, A.n_rows, A.n_cols,
+        interpret=interpret,
+    )

@@ -51,6 +51,14 @@ def _spmv_scalar(A, x):
         # small unstructured matrices: one MXU matmul beats TPU gathers
         return A.dense @ x
     if A.has_ell:
+        if A.ell_tcols is not None:
+            from amgx_tpu.ops.pallas_spmv import (
+                pallas_ell_spmv,
+                pallas_spmv_supported,
+            )
+
+            if pallas_spmv_supported():
+                return pallas_ell_spmv(A, x)
         xg = x[A.ell_cols]  # (n, w)
         return jnp.sum(A.ell_vals * xg, axis=1)
     contrib = A.values * x[A.col_indices]

@@ -130,8 +130,8 @@ static AMGX_RC call_rc(const char *fn, PyObject *args, int had_args) {
 
 /* The amgx_tpu package lives next to this library's directory
  * (<repo>/native/libamgx_tpu_c.so, <repo>/amgx_tpu/).  Host apps can run
- * from anywhere, so locate the .so via dladdr and put its parent dir —
- * plus the cwd — on sys.path before the first import (GIL held). */
+ * from anywhere, so locate the .so via dladdr and put its parent dir on
+ * sys.path before the first import (GIL held). */
 static void add_package_to_syspath(void) {
   Dl_info info;
   char buf[4096];
@@ -168,6 +168,7 @@ AMGX_RC AMGX_initialize(void) {
   }
   ENTER();
   if (!g_capi) {
+    add_package_to_syspath(); /* host may have pre-initialized Python */
     PyObject *mod = PyImport_ImportModule("amgx_tpu.api.capi");
     if (!mod) LEAVE_RET(AMGX_RC_CORE);
     g_capi = mod;

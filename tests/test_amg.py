@@ -342,3 +342,37 @@ def test_structured_aggregation_opt_out():
     P, R, Ac = build_aggregation_level(A, cfg, "main")
     # matching-based path still works and coarsens
     assert Ac.shape[0] < A.shape[0]
+
+
+def test_geo_aggregation_semicoarsens_anisotropic():
+    """Strong-axis aggregation on anisotropic stencils (the geometric
+    analogue of strength-of-connection: weak couplings must not be
+    aggregated across)."""
+    from amgx_tpu.amg.aggregation import (
+        axis_strengths,
+        geo_aggregate,
+        infer_grid,
+        stencil_offsets,
+    )
+    import scipy.sparse as sps
+
+    # 2D anisotropic diffusion: -u_xx - eps*u_yy, eps=1e-3
+    nx = ny = 16
+    eps = 1e-3
+    n = nx * ny
+    main = np.full(n, 2.0 + 2.0 * eps)
+    ex = np.full(n - 1, -1.0)
+    ex[nx - 1 :: nx] = 0.0
+    ey = np.full(n - nx, -eps)
+    A = sps.diags_array(
+        [main, ex, ex, ey, ey], offsets=[0, 1, -1, nx, -nx]
+    ).tocsr()
+    grid = infer_grid(stencil_offsets(A), n)
+    assert grid == (nx, ny, 1)
+    s = axis_strengths(A, *grid)
+    assert s[0] > 100 * s[1]
+    agg = geo_aggregate(*grid, 3, strengths=s)
+    # 8x1 blocks along x: node (0,0) through (7,0) share an aggregate,
+    # nodes differing in y do not
+    assert agg[0] == agg[7]
+    assert agg[0] != agg[nx]
