@@ -289,28 +289,34 @@ def geo_aggregate(
     return agg.astype(np.int32)
 
 
-def build_aggregation_level(Asp, cfg, scope):
-    """Returns (P, R, A_coarse) scipy matrices for one aggregation level
-    (reference aggregation_amg_level.cu:238-371 R/P from aggregate map +
-    coarseAGenerator computeAOperator)."""
+def select_aggregates(Asp, cfg, scope) -> np.ndarray:
+    """The selector decision shared by the serial and distributed
+    setup paths: geometric blocks when the matrix is stencil-structured
+    (and structured_aggregation allows it, or selector is GEO),
+    matching-based aggregation otherwise."""
     selector = str(cfg.get("selector", scope)).upper()
     passes = SELECTOR_PASSES.get(selector, 1)
     if passes is None:
         passes = int(cfg.get("aggregation_passes", scope))
-    formula = int(cfg.get("weight_formula", scope))
-    merge = bool(cfg.get("merge_singletons", scope))
-    agg = None
     if bool(cfg.get("structured_aggregation", scope)) or selector == "GEO":
         offs = stencil_offsets(Asp)
         grid = (
             infer_grid(offs, Asp.shape[0]) if offs is not None else None
         )
         if grid is not None:
-            agg = geo_aggregate(
+            return geo_aggregate(
                 *grid, passes, strengths=axis_strengths(Asp, *grid)
             )
-    if agg is None:
-        agg = aggregate(Asp, passes, formula, merge)
+    formula = int(cfg.get("weight_formula", scope))
+    merge = bool(cfg.get("merge_singletons", scope))
+    return aggregate(Asp, passes, formula, merge)
+
+
+def build_aggregation_level(Asp, cfg, scope):
+    """Returns (P, R, A_coarse) scipy matrices for one aggregation level
+    (reference aggregation_amg_level.cu:238-371 R/P from aggregate map +
+    coarseAGenerator computeAOperator)."""
+    agg = select_aggregates(Asp, cfg, scope)
     n = Asp.shape[0]
     nc = int(agg.max()) + 1
     P = sps.csr_matrix(

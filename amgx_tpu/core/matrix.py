@@ -55,8 +55,9 @@ def _static_field(**kw):
     return dataclasses.field(metadata=dict(static=True), **kw)
 
 
-def _want_tiled_ell() -> bool:
-    """Build the Pallas tiled-ELL arrays?  TPU backends only (the XLA
+def _want_tiled_ell(dtype) -> bool:
+    """Build the Pallas tiled-ELL arrays?  TPU backends with a TPU-
+    native dtype only (the kernel's tiling is f32/bf16-shaped; the XLA
     fallback uses the plain layout); AMGX_TPU_TILED_ELL=1/0 overrides
     (tests force-build on CPU to exercise the interpret-mode kernel)."""
     import os
@@ -64,6 +65,8 @@ def _want_tiled_ell() -> bool:
     env = os.environ.get("AMGX_TPU_TILED_ELL")
     if env is not None:
         return env == "1"
+    if np.dtype(dtype) not in (np.dtype(np.float32), np.dtype(jnp.bfloat16)):
+        return False
     try:
         return jax.default_backend() == "tpu"
     except Exception:
@@ -273,7 +276,7 @@ class SparseMatrix:
                 ell_cols, ell_vals = _build_ell_np(
                     row_offsets, col_indices, values, n_rows, w, b
                 )
-                if b == 1 and w > 0 and _want_tiled_ell():
+                if b == 1 and w > 0 and _want_tiled_ell(values.dtype):
                     from amgx_tpu.ops.pallas_spmv import tile_ell
 
                     ell_tcols, ell_tvals = tile_ell(ell_cols, ell_vals)

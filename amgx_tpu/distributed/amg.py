@@ -1,19 +1,17 @@
-"""Distributed AMG: fine level sharded over the mesh, coarse hierarchy
-consolidated.
+"""Distributed AMG solve path: multi-level sharded V-cycle + PCG.
 
-Reference mapping (SURVEY §2.6/§5.8): the reference shrinks the active
-rank set on coarse levels (consolidation/"glue", glue.h) because coarse
-work cannot saturate the machine.  Taken to its TPU-native limit: the
-FINE level — where nearly all memory traffic lives — is block-row
-sharded with B2L halo exchange over ICI; every coarser level is
-replicated on all chips (full consolidation), so the coarse V-cycle
-runs redundantly-but-identically everywhere with zero communication.
-Restriction ends with a ``psum`` (the consolidation gather);
-prolongation needs no communication at all (P rows are owned rows).
+Reference mapping (SURVEY §2.6/§5.8): the sharded levels come from
+:mod:`amgx_tpu.distributed.hierarchy` (the distributed setup loop,
+amg.cu:425-660); each distributed level smooths with damped Jacobi and
+exchanges halos via neighbor ppermute; restriction/prolongation are
+communication-free (shard-local aggregates).  Below the consolidation
+threshold the remaining hierarchy is replicated on every chip
+(reference glue_matrices/glue_vector, glue.h:200,525) and runs as a
+standard AMG cycle with zero communication; entry/exit are one
+all_gather / local slice per outer cycle.
 
-Solve = distributed PCG preconditioned by this two-tier cycle — one
-shard_map program (acceptance config 5: distributed aggregation AMG on
-partitioned Poisson).
+Solve = distributed PCG preconditioned by this cycle, one shard_map
+program (acceptance config 5: distributed aggregation AMG).
 """
 
 from __future__ import annotations
@@ -27,48 +25,27 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import scipy.sparse as sps
 
-from amgx_tpu.distributed.partition import (
-    DistributedMatrix,
-    partition_matrix,
+from amgx_tpu.distributed.hierarchy import (
+    DistHierarchy,
+    build_distributed_hierarchy,
 )
-from amgx_tpu.distributed.solve import _local_spmv, _pdot, _shard_params
-
-
-def _pad_csr_rows(sp: sps.csr_matrix, n_parts: int, rows_pp: int):
-    """Split sp (n_rows x m) into row blocks, pad each to uniform ELL and
-    stack [N, rows_pp, w] (+ cols).  Column space untouched."""
-    blocks = []
-    w = 1
-    for p in range(n_parts):
-        blk = sp[p * rows_pp : (p + 1) * rows_pp].tocsr()
-        blocks.append(blk)
-        lens = np.diff(blk.indptr)
-        if lens.size:
-            w = max(w, int(lens.max()))
-    cols = np.zeros((n_parts, rows_pp, w), dtype=np.int32)
-    vals = np.zeros((n_parts, rows_pp, w), dtype=sp.dtype)
-    for p, blk in enumerate(blocks):
-        lens = np.diff(blk.indptr)
-        nrows = blk.shape[0]
-        row_ids = np.repeat(np.arange(nrows), lens)
-        pos = np.arange(blk.indices.shape[0]) - blk.indptr[
-            row_ids
-        ].astype(np.int64)
-        cols[p, row_ids, pos] = blk.indices
-        vals[p, row_ids, pos] = blk.data
-    return cols, vals
+from amgx_tpu.distributed.solve import (
+    _pdot,
+    _shard_params,
+    make_local_spmv,
+)
 
 
 class DistributedAMG:
-    """Two-tier distributed AMG-PCG solver."""
+    """Multi-level distributed AMG-PCG solver."""
 
     def __init__(self, Asp: sps.csr_matrix, mesh: Mesh, cfg=None,
-                 scope: str = "default"):
+                 scope: str = "default", consolidate_rows: int = 4096):
         from amgx_tpu.config.amg_config import AMGConfig
 
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
-        self.n_parts = mesh.devices.size
+        self.n_parts = int(mesh.devices.size)
         if cfg is None:
             cfg = AMGConfig.from_string(
                 '{"config_version": 2, "solver": {"scope": "amg",'
@@ -83,23 +60,19 @@ class DistributedAMG:
             scope = "amg"
         self.cfg = cfg
         self.scope = scope
+        self.consolidate_rows = consolidate_rows
         self._setup(Asp)
 
-    def _setup(self, Asp):
-        n = Asp.shape[0]
-        # fine level: sharded (B2L halo machinery)
-        self.fine = partition_matrix(Asp, self.n_parts)
-        rows_pp = self.fine.rows_per_part
+    # ------------------------------------------------------------------
 
-        # fine-level smoothing honors the config (Jacobi-type only for
-        # now: pointwise damped sweeps distribute trivially)
+    def _setup(self, Asp):
         sname, sscope = self.cfg.get_scoped("smoother", self.scope)
         if sname not in ("BLOCK_JACOBI", "JACOBI_L1"):
             import warnings
 
             warnings.warn(
-                f"distributed fine-level smoother {sname}: using damped "
-                "Jacobi (colored smoothers on the sharded level TBD)"
+                f"distributed smoother {sname}: using damped Jacobi "
+                "(colored smoothers on sharded levels TBD)"
             )
         self.omega = float(self.cfg.get("relaxation_factor", sscope))
         self.presweeps = max(int(self.cfg.get("presweeps", self.scope)), 0)
@@ -108,110 +81,137 @@ class DistributedAMG:
         )
         self._solve_cache = {}
 
-        # one coarsening step on the host builds P/R and the coarse
-        # operator; the coarse hierarchy below it is a standard
-        # (replicated) AMG solver
+        self.h: DistHierarchy = build_distributed_hierarchy(
+            Asp, self.n_parts, self.cfg, self.scope,
+            consolidate_rows=self.consolidate_rows,
+        )
+        self.fine = self.h.levels[0].A
+
+        # replicated tail: standard AMG on the consolidated matrix
         from amgx_tpu.amg.hierarchy import AMGSolver
         from amgx_tpu.core.matrix import SparseMatrix
 
-        amg = AMGSolver(self.cfg, self.scope)
-        P_, R_, Ac = amg._build_coarse(Asp, 0)
-        # pad the global operators to the padded row space
-        n_pad = rows_pp * self.n_parts
-        if n_pad > n:
-            P_ = sps.vstack(
-                [P_, sps.csr_matrix((n_pad - n, P_.shape[1]))]
-            ).tocsr()
-            R_ = sps.hstack(
-                [R_, sps.csr_matrix((R_.shape[0], n_pad - n))]
-            ).tocsr()
-        self.nc = Ac.shape[0]
-        # R columns partitioned by owner shard: rc = psum_p R_p r_p
-        Rl = R_.tocsc()
-        r_cols, r_vals = [], []
-        for p in range(self.n_parts):
-            blk = Rl[:, p * rows_pp : (p + 1) * rows_pp].tocsr()
-            r_cols.append(blk)
-        w = max(
-            max((int(np.diff(b.indptr).max()) if b.nnz else 1)
-                for b in r_cols), 1
-        )
-        R_cols = np.zeros((self.n_parts, self.nc, w), dtype=np.int32)
-        R_vals = np.zeros((self.n_parts, self.nc, w), dtype=Asp.dtype)
-        for p, blk in enumerate(r_cols):
-            lens = np.diff(blk.indptr)
-            rid = np.repeat(np.arange(self.nc), lens)
-            pos = np.arange(blk.indices.shape[0]) - blk.indptr[
-                rid
-            ].astype(np.int64)
-            R_cols[p, rid, pos] = blk.indices
-            R_vals[p, rid, pos] = blk.data
-        self.R_cols, self.R_vals = R_cols, R_vals
+        tail_amg = AMGSolver(self.cfg, self.scope)
+        tail_amg.setup(SparseMatrix.from_scipy(self.h.tail_matrix))
+        self.tail_amg = tail_amg
+        self._tail_cycle = tail_amg.make_cycle()
+        self._tail_params = tail_amg.apply_params()
 
-        # P rows partitioned by owner shard: x_loc += P_p e
-        self.P_cols, self.P_vals = _pad_csr_rows(
-            P_.tocsr(), self.n_parts, rows_pp
-        )
-
-        # coarse hierarchy: a standard replicated AMG on Ac
-        coarse_amg = AMGSolver(self.cfg, self.scope)
-        coarse_amg.setup(SparseMatrix.from_scipy(Ac.tocsr()))
-        self.coarse_amg = coarse_amg
-        self._coarse_cycle = coarse_amg.make_cycle()
-        self._coarse_params = coarse_amg.apply_params()
+        # stacked [N, rows_pp_L] global ids of the deepest level's owned
+        # slots (consolidation gather/scatter maps; padding -> id 0 with
+        # zero mask).  Single source of truth: closed over by the cycle
+        # as replicated constants, indexed per shard via axis_index.
+        last = self.h.levels[-1].A
+        ng = last.n_global
+        gids = np.zeros((last.n_parts, last.rows_per_part), np.int64)
+        msk = np.zeros((last.n_parts, last.rows_per_part), bool)
+        gids[last.owner, last.local_of] = np.arange(ng, dtype=np.int64)
+        msk[last.owner, last.local_of] = True
+        self._tail_gids = gids
+        self._tail_mask = msk
 
     # ------------------------------------------------------------------
 
-    def _local_cycle(self, shard, Rc, Rv, Pc, Pv, coarse_params, r_loc):
-        """One two-tier cycle applied to a local residual (zero guess)."""
-        ell_cols, ell_vals, diag, *_ = shard
-        dinv = jnp.where(diag != 0, 1.0 / diag, 1.0)
-        omega = jnp.asarray(self.omega, r_loc.dtype)
-        # pre-smooth (damped Jacobi, zero guess)
-        z = jnp.zeros_like(r_loc)
-        for i in range(max(self.presweeps, 1)):
-            rr = r_loc if i == 0 else (
-                r_loc - _local_spmv(shard, z, self.axis)
-            )
-            z = z + omega * dinv * rr
-        rr = r_loc - _local_spmv(shard, z, self.axis)
-        # restrict: rc = psum_p R_p rr_p  (consolidation gather)
-        rc_part = jnp.sum(Rv * rr[Rc], axis=1)
-        rc = jax.lax.psum(rc_part, self.axis)
-        # replicated coarse solve (identical on every shard)
-        ec = self._coarse_cycle(
-            coarse_params, rc, jnp.zeros_like(rc)
+    def _traced_level_params(self):
+        """Per-level traced arrays: (shard_params(A), P, R) stacks.
+        The deepest level is the consolidation bridge — its operator
+        lives in the replicated tail, so no arrays are shipped for it
+        (unless it is also the fine level, whose operator drives the
+        outer PCG)."""
+        out = []
+        ship = (
+            self.h.levels
+            if len(self.h.levels) == 1
+            else self.h.levels[:-1]
         )
-        # prolongate: z += P_p ec   (no communication)
-        z = z + jnp.sum(Pv * ec[Pc], axis=1)
-        # post-smooth
-        for _ in range(max(self.postsweeps, 1)):
-            rr = r_loc - _local_spmv(shard, z, self.axis)
-            z = z + omega * dinv * rr
-        return z
+        for lvl in ship:
+            entry = [_shard_params(lvl.A)]
+            for a in (lvl.P_cols, lvl.P_vals, lvl.R_cols, lvl.R_vals):
+                entry.append(None if a is None else jnp.asarray(a))
+            out.append(tuple(entry))
+        if len(self.h.levels) > 1:
+            out.append(())
+        return tuple(out)
+
+    def _make_cycle(self):
+        """Shard-local multi-level V-cycle closure (zero initial guess).
+
+        Returns fn(level_params_local, tail_params, tail_gids, tail_msk,
+        r_loc) -> z_loc, traced inside shard_map.
+        """
+        axis = self.axis
+        levels = self.h.levels
+        spmvs = [make_local_spmv(l.A, axis) for l in levels]
+        omega = self.omega
+        pre, post = max(self.presweeps, 1), max(self.postsweeps, 1)
+        tail_cycle = self._tail_cycle
+
+        def smooth(l, lp, r_l, z, sweeps):
+            sh = lp[0]
+            dinv = jnp.where(sh[2] != 0, 1.0 / sh[2], 1.0)
+            om = jnp.asarray(omega, r_l.dtype)
+            for i in range(sweeps):
+                rr = r_l if (i == 0 and z is None) else (
+                    r_l - spmvs[l](sh, z)
+                )
+                z = om * dinv * rr if z is None else z + om * dinv * rr
+            return z
+
+        # consolidation gather/scatter maps (replicated closure
+        # constants; per-shard rows selected via axis_index)
+        gids = jnp.asarray(self._tail_gids)  # [N, rows_pp_L]
+        msk = jnp.asarray(self._tail_mask)
+        pool_ids_flat = gids.reshape(-1)
+        pool_msk_flat = msk.reshape(-1)
+        ng = self.h.tail_matrix.shape[0]
+
+        def descend(l, lps, tail_params, r_l):
+            lp = lps[l]
+            if l == len(levels) - 1:
+                # consolidation bridge: gather -> replicated tail cycle
+                # -> scatter back to owned slots (glue_vector/unglue)
+                pool = jax.lax.all_gather(r_l, axis)  # [N, rows_pp]
+                rg = jnp.zeros((ng,), r_l.dtype)
+                # .add, not .set: padding slots alias id 0 (masked to 0)
+                rg = rg.at[pool_ids_flat].add(
+                    jnp.where(pool_msk_flat, pool.reshape(-1), 0.0)
+                )
+                eg = tail_cycle(tail_params, rg, jnp.zeros_like(rg))
+                me = jax.lax.axis_index(axis)
+                return jnp.where(msk[me], eg[gids[me]], 0.0)
+            sh = lp[0]
+            z = smooth(l, lp, r_l, None, pre)
+            rr = r_l - spmvs[l](sh, z)
+            Pc, Pv, Rc, Rv = lp[1], lp[2], lp[3], lp[4]
+            rc = jnp.sum(Rv * rr[Rc], axis=1)
+            ec = descend(l + 1, lps, tail_params, rc)
+            z = z + jnp.sum(Pv * ec[Pc], axis=1)
+            z = smooth(l, lp, r_l, z, post)
+            return z
+
+        def cycle(lps, tail_params, r0):
+            return descend(0, lps, tail_params, r0)
+
+        return cycle
 
     def _build_solve(self, max_iters, tol):
         axis = self.axis
-        n_shard_arrays = len(_shard_params(self.fine))
-        in_specs = (
-            tuple(P(axis) for _ in range(n_shard_arrays)),
-            P(axis), P(axis), P(axis), P(axis),  # R/P blocks
-            None,  # coarse params replicated
-            P(axis),  # b
-        )
+        lps = self._traced_level_params()
+        in_lps = jax.tree.map(lambda _: P(axis), lps)
+        cycle = self._make_cycle()
+        fine_spmv = make_local_spmv(self.fine, axis)
 
         @functools.partial(
             jax.shard_map,
             mesh=self.mesh,
-            in_specs=in_specs,
+            in_specs=(in_lps, None, P(axis)),
             out_specs=(P(axis), P(), P()),
         )
-        def solve_sm(shard_stk, Rc_, Rv_, Pc_, Pv_, coarse, b_stk):
-            sh = tuple(s[0] for s in shard_stk)
+        def solve_sm(lps_stk, tail_params, b_stk):
+            lps_loc = jax.tree.map(lambda s: s[0], lps_stk)
             b_loc = b_stk[0]
-            M = lambda r: self._local_cycle(
-                sh, Rc_[0], Rv_[0], Pc_[0], Pv_[0], coarse, r
-            )
+            sh0 = lps_loc[0][0]
+            M = lambda r: cycle(lps_loc, tail_params, r)
             x = jnp.zeros_like(b_loc)
             r = b_loc
             z = M(r)
@@ -221,11 +221,13 @@ class DistributedAMG:
 
             def cond(c):
                 it, x, r, p, rho, nrm = c
-                return (it < max_iters) & (nrm >= tol * nrm0) & (nrm0 > 0)
+                return (it < max_iters) & (nrm >= tol * nrm0) & (
+                    nrm0 > 0
+                )
 
             def body(c):
                 it, x, r, p, rho, nrm = c
-                q = _local_spmv(sh, p, axis)
+                q = fine_spmv(sh0, p)
                 alpha = rho / _pdot(p, q, axis)
                 x = x + alpha * p
                 r = r - alpha * q
@@ -240,28 +242,19 @@ class DistributedAMG:
             )
             return x[None], it, nrm
 
-        return jax.jit(solve_sm)
+        return jax.jit(solve_sm), lps
 
     def solve(self, b, max_iters=200, tol=1e-8):
-        """Distributed AMG-preconditioned CG. Returns (x, iters, nrm).
-        The jitted program is cached per (max_iters, tol) — repeated
-        solves dispatch without recompiling."""
+        """Distributed AMG-preconditioned CG -> (x, iters, nrm).  The
+        jitted program is cached per (max_iters, tol)."""
         key = (max_iters, float(tol))
-        fn = self._solve_cache.get(key)
-        if fn is None:
-            fn = self._build_solve(max_iters, tol)
-            self._solve_cache[key] = fn
-        shard = _shard_params(self.fine)
+        hit = self._solve_cache.get(key)
+        if hit is None:
+            hit = self._build_solve(max_iters, tol)
+            self._solve_cache[key] = hit
+        fn, lps = hit
         bp = jnp.asarray(self.fine.pad_vector(np.asarray(b)))
-        x, it, nrm = fn(
-            shard,
-            jnp.asarray(self.R_cols),
-            jnp.asarray(self.R_vals),
-            jnp.asarray(self.P_cols),
-            jnp.asarray(self.P_vals),
-            self._coarse_params,
-            bp,
-        )
+        x, it, nrm = fn(lps, self._tail_params, bp)
         return (
             self.fine.unpad_vector(jax.device_get(x)),
             int(it),

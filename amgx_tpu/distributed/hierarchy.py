@@ -1,0 +1,348 @@
+"""Multi-level distributed AMG setup (reference distributed setup loop
+src/amg.cu:425-660 setup_v2, distributed Galerkin with halo-row P/RAP
+exchange classical_amg_level.cu:297-318 + distributed_arranger.cu
+exchange_RAP_ext, consolidation glue.h:200).
+
+TPU-first structure
+-------------------
+Setup runs on host per *shard*: every coarsening step consumes only a
+shard's owned rows plus one-ring halo data, so on a multi-host
+deployment each process holds ~global/N of every level.  The steps per
+level, mirroring the reference flow:
+
+  1. shard-local aggregation on the owned submatrix (geometric blocks
+     when the local box is stencil-structured, matching handshake
+     otherwise) — aggregates never span shards, so P and R are block-
+     diagonal across shards and restriction/prolongation need NO
+     communication in the solve;
+  2. halo P-row exchange: a shard fetches the P rows of its fine halo
+     nodes from their owners (reference exchange_halo_rows_P);
+  3. shard-local Galerkin rows: Ac_p = P_pᵀ (A_p P_ext) — the coarse
+     rows owned by p, with columns in global coarse numbering
+     (reference exchange_RAP_ext + csr_RAP_sparse_add);
+  4. owned-first renumber of the coarse level (halo appended) and a new
+     neighbor-exchange plan.
+
+Coarsening continues until the global coarse size drops below the
+consolidation threshold; the remaining hierarchy is *consolidated*
+(gathered and replicated on every chip — reference glue_matrices) where
+coarse work is too small to shard profitably.  The solve-side cycle
+runs the distributed levels with ppermute halo exchange and damped
+Jacobi smoothing, then the replicated tail as a standard AMG cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import numpy as np
+import scipy.sparse as sps
+
+from amgx_tpu.distributed.partition import (
+    DistributedMatrix,
+    finalize_partition,
+    local_numbering,
+    localize_columns,
+    partition_rows,
+)
+
+# Stop sharding below this global size: coarse grids this small cannot
+# feed N chips and the replicated tail costs zero communication
+# (reference matrix_consolidation_lower_threshold semantics).
+_CONSOLIDATE_ROWS = 4096
+
+
+@dataclasses.dataclass
+class DistLevel:
+    """One distributed level: sharded operator + grid-transfer blocks."""
+
+    A: DistributedMatrix
+    # P block of shard p: owned fine rows x owned coarse cols (local
+    # numbering both sides); stacked padded ELL [N, rows_pp, wp].
+    P_cols: Optional[np.ndarray] = None
+    P_vals: Optional[np.ndarray] = None
+    # R = P^T block: owned coarse rows x owned fine cols.
+    R_cols: Optional[np.ndarray] = None
+    R_vals: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class DistHierarchy:
+    levels: List[DistLevel]
+    # consolidated (replicated) tail: a host scipy matrix in the LOCAL
+    # row order of the deepest distributed level's coarse numbering
+    tail_matrix: Any = None
+    # mapping: stacked coarse vector [N, rows_pp] <-> tail global rows
+    tail_owner: Optional[np.ndarray] = None
+    tail_local_of: Optional[np.ndarray] = None
+
+
+def _local_aggregate(A_pp: sps.csr_matrix, cfg, scope) -> np.ndarray:
+    """Aggregate one shard's owned submatrix — the same selector
+    decision as the serial path (shared helper)."""
+    from amgx_tpu.amg.aggregation import select_aggregates
+
+    return select_aggregates(A_pp, cfg, scope)
+
+
+class _ShardedLevelCSR:
+    """Host-side per-shard CSR state of one level (the arranger's view:
+    owned rows, local columns owned-first + halo, global halo ids)."""
+
+    def __init__(self, shards, halo_globs, g_rows, owner, local_of,
+                 counts):
+        self.shards = shards  # list[sps.csr_matrix] local cols
+        self.halo_globs = halo_globs  # list[np.ndarray] global ids
+        self.g_rows = g_rows  # list[np.ndarray] owned global row ids
+        self.owner = owner
+        self.local_of = local_of
+        self.counts = counts
+
+    @property
+    def n_parts(self):
+        return len(self.shards)
+
+    @property
+    def n_global(self):
+        return int(self.counts.sum())
+
+
+def _shard_the_matrix(Asp, owner, n_parts) -> _ShardedLevelCSR:
+    """Initial sharding of the (fine) matrix — the stand-in for the
+    reference's distributed upload; each entry of `shards` is what one
+    rank would hold."""
+    local_of, counts, part_rows = local_numbering(owner, n_parts)
+    rows_pp = max(int(counts.max()), 1)
+    shards, halo_globs = [], []
+    for p in range(n_parts):
+        local = Asp[part_rows[p]].tocsr()
+        d = localize_columns(
+            local.indptr, local.indices, local.data, owner, local_of,
+            p, rows_pp,
+        )
+        nloc = rows_pp + len(d["halo_glob"])
+        shards.append(
+            sps.csr_matrix(
+                (d["vals"], d["cols"], d["indptr"]),
+                shape=(counts[p], nloc),
+            )
+        )
+        halo_globs.append(d["halo_glob"])
+    return _ShardedLevelCSR(
+        shards, halo_globs, part_rows, owner, local_of, counts
+    )
+
+
+def _level_device_arrays(lvl: _ShardedLevelCSR) -> DistributedMatrix:
+    """Exchange plan + stacked arrays for one level's sharded operator."""
+    rows_pp = max(int(lvl.counts.max()), 1)
+    parts = []
+    for p in range(lvl.n_parts):
+        s = lvl.shards[p]
+        parts.append(
+            dict(
+                indptr=s.indptr,
+                cols=s.indices.astype(np.int32),
+                vals=s.data,
+                halo_glob=lvl.halo_globs[p],
+            )
+        )
+    return finalize_partition(
+        parts, lvl.owner, lvl.local_of, lvl.counts, lvl.n_global,
+        lvl.n_parts,
+    )
+
+
+def _pad_ell_blocks(mats, rows_pad):
+    """Stack per-shard CSR blocks as padded ELL [N, rows_pad, w]."""
+    n_parts = len(mats)
+    w = 1
+    for m in mats:
+        lens = np.diff(m.indptr)
+        if lens.size:
+            w = max(w, int(lens.max()))
+    dtype = mats[0].dtype if mats else np.float64
+    cols = np.zeros((n_parts, rows_pad, w), dtype=np.int32)
+    vals = np.zeros((n_parts, rows_pad, w), dtype=dtype)
+    for p, m in enumerate(mats):
+        lens = np.diff(m.indptr)
+        rid = np.repeat(np.arange(m.shape[0]), lens)
+        pos = np.arange(m.indices.shape[0]) - m.indptr[rid].astype(
+            np.int64
+        )
+        cols[p, rid, pos] = m.indices
+        vals[p, rid, pos] = m.data
+    return cols, vals
+
+
+def build_distributed_hierarchy(
+    Asp: sps.csr_matrix,
+    n_parts: int,
+    cfg,
+    scope: str,
+    grid=None,
+    owner=None,
+    max_levels: int = 20,
+    consolidate_rows: int = _CONSOLIDATE_ROWS,
+) -> DistHierarchy:
+    """The distributed setup loop (reference amg.cu:425-660)."""
+    from amgx_tpu.amg.aggregation import infer_grid, stencil_offsets
+
+    n = Asp.shape[0]
+    Asp = Asp.tocsr()
+    Asp.sort_indices()
+    if owner is None:
+        if grid is None:
+            offs = stencil_offsets(Asp)
+            grid = infer_grid(offs, n) if offs is not None else None
+        owner, _ = partition_rows(n, n_parts, grid)
+    else:
+        owner = np.asarray(owner, dtype=np.int32)
+
+    lvl = _shard_the_matrix(Asp, owner, n_parts)
+    levels: List[DistLevel] = []
+
+    while (
+        lvl.n_global > consolidate_rows and len(levels) < max_levels
+    ):
+        rows_pp = max(int(lvl.counts.max()), 1)
+        # 1. shard-local aggregation on the owned submatrix
+        aggs, ncs = [], []
+        for p in range(lvl.n_parts):
+            A_pp = lvl.shards[p][:, : lvl.counts[p]]
+            # owned cols use local slots 0..counts-1 (padding-free view)
+            A_pp = A_pp.tocsr()
+            agg = _local_aggregate(A_pp, cfg, scope)
+            aggs.append(agg)
+            ncs.append(int(agg.max()) + 1 if agg.size else 0)
+        nc_global = int(np.sum(ncs))
+        if nc_global >= lvl.n_global or nc_global == 0:
+            break  # coarsening stalled
+        coffs = np.concatenate([[0], np.cumsum(ncs)[:-1]])
+
+        # coarse global numbering: shard p owns [coffs[p], coffs[p]+nc_p)
+        owner_c = np.repeat(
+            np.arange(lvl.n_parts, dtype=np.int32), ncs
+        )
+
+        # per-shard P (owned fine x owned coarse, both local)
+        P_blocks = [
+            sps.csr_matrix(
+                (
+                    np.ones(lvl.counts[p], dtype=lvl.shards[p].dtype),
+                    (np.arange(lvl.counts[p]), aggs[p]),
+                ),
+                shape=(lvl.counts[p], ncs[p]),
+            )
+            for p in range(lvl.n_parts)
+        ]
+
+        # 2+3. halo P-row exchange and shard-local Galerkin rows:
+        # P_ext maps every LOCAL column of A_p (owned + halo) to global
+        # coarse ids; halo rows come from the owning shard's aggregate
+        # map — the single-process arranger reads them directly (a real
+        # multi-host build ships them point-to-point).
+        coarse_shards, coarse_halos = [], []
+        # global fine id -> global coarse id (the union of all shards'
+        # aggregate maps; each entry is produced by exactly one owner)
+        gagg = np.empty(lvl.n_global, dtype=np.int64)
+        for p in range(lvl.n_parts):
+            gagg[lvl.g_rows[p]] = coffs[p] + aggs[p]
+
+        for p in range(lvl.n_parts):
+            A_p = lvl.shards[p]
+            nloc = A_p.shape[1]
+            # local col -> global coarse id
+            col_to_gc = np.empty(nloc, dtype=np.int64)
+            col_to_gc[: lvl.counts[p]] = coffs[p] + aggs[p]
+            if rows_pp > lvl.counts[p]:
+                col_to_gc[lvl.counts[p]: rows_pp] = 0  # padding, no nnz
+            hg = lvl.halo_globs[p]
+            if len(hg):
+                col_to_gc[rows_pp: rows_pp + len(hg)] = gagg[hg]
+            # AP with global coarse columns
+            coo = A_p.tocoo()
+            AP = sps.csr_matrix(
+                (coo.data, (coo.row, col_to_gc[coo.col])),
+                shape=(lvl.counts[p], nc_global),
+            )
+            AP.sum_duplicates()
+            Ac_p = (P_blocks[p].T @ AP).tocsr()  # (nc_p, nc_global)
+            Ac_p.sum_duplicates()
+            Ac_p.sort_indices()
+            coarse_shards.append(Ac_p)
+
+        # 4. owned-first renumber of the coarse level
+        local_of_c, counts_c, g_rows_c = local_numbering(
+            owner_c, lvl.n_parts
+        )
+        rows_pp_c = max(int(counts_c.max()), 1)
+        new_shards, new_halos = [], []
+        for p in range(lvl.n_parts):
+            m = coarse_shards[p]
+            d = localize_columns(
+                m.indptr, m.indices, m.data, owner_c, local_of_c, p,
+                rows_pp_c,
+            )
+            nloc = rows_pp_c + len(d["halo_glob"])
+            new_shards.append(
+                sps.csr_matrix(
+                    (d["vals"], d["cols"], d["indptr"]),
+                    shape=(counts_c[p], nloc),
+                )
+            )
+            new_halos.append(d["halo_glob"])
+
+        # device arrays for this level (A + P/R stacked blocks)
+        A_dev = _level_device_arrays(lvl)
+        P_cols, P_vals = _pad_ell_blocks(P_blocks, rows_pp)
+        R_blocks = [P_blocks[p].T.tocsr() for p in range(lvl.n_parts)]
+        R_cols, R_vals = _pad_ell_blocks(R_blocks, rows_pp_c)
+        levels.append(
+            DistLevel(
+                A=A_dev, P_cols=P_cols, P_vals=P_vals,
+                R_cols=R_cols, R_vals=R_vals,
+            )
+        )
+
+        lvl = _ShardedLevelCSR(
+            new_shards, new_halos, g_rows_c, owner_c, local_of_c,
+            counts_c,
+        )
+
+    # deepest distributed level (operator only; smoothed, no transfer)
+    levels.append(DistLevel(A=_level_device_arrays(lvl)))
+
+    # consolidated tail: gather the last level's rows into one host
+    # matrix in GLOBAL coarse numbering (reference glue_matrices)
+    rows, cols, vals = [], [], []
+    for p in range(lvl.n_parts):
+        m = lvl.shards[p].tocoo()
+        rows_pp_l = max(int(lvl.counts.max()), 1)
+        hg = lvl.halo_globs[p]
+        col_to_g = np.empty(m.shape[1], dtype=np.int64)
+        col_to_g[: lvl.counts[p]] = lvl.g_rows[p]
+        if rows_pp_l > lvl.counts[p]:
+            col_to_g[lvl.counts[p]: rows_pp_l] = 0
+        if len(hg):
+            col_to_g[rows_pp_l: rows_pp_l + len(hg)] = hg
+        rows.append(lvl.g_rows[p][m.row])
+        cols.append(col_to_g[m.col])
+        vals.append(m.data)
+    tail = sps.csr_matrix(
+        (
+            np.concatenate(vals),
+            (np.concatenate(rows), np.concatenate(cols)),
+        ),
+        shape=(lvl.n_global, lvl.n_global),
+    )
+    tail.sum_duplicates()
+    tail.sort_indices()
+
+    return DistHierarchy(
+        levels=levels,
+        tail_matrix=tail,
+        tail_owner=lvl.owner,
+        tail_local_of=lvl.local_of,
+    )

@@ -1,32 +1,45 @@
 """Host-side matrix partitioner (reference DistributedManager +
-DistributedArranger, src/distributed/distributed_manager.cu:1040-1345:
-loadDistributedMatrix partition/renumber path).
+DistributedArranger, src/distributed/distributed_manager.cu:1040-1345
+loadDistributedMatrix partition/renumber path, distributed_arranger.h
+create_B2L/create_neighbors/create_boundary_lists).
 
-Block-row partition of a CSR matrix into N shards with owned-first local
-renumbering and appended halo columns — the same local index layout the
-reference builds (owned rows first, halo appended, B2L boundary maps).
-All per-shard arrays are padded to identical shapes and stacked along a
-leading shard axis so the solve path runs under ``shard_map`` with one
-static program (SPMD).
+Partitions a CSR matrix into N shards with owned-first local renumbering
+and appended halo columns — the reference's local index layout.  All
+per-shard arrays are padded to identical shapes and stacked along a
+leading shard axis so the solve path runs under ``shard_map`` as one
+static SPMD program.
 
-Halo exchange contract (executed on-device, see distributed/solve.py):
-  send = x_loc[send_idx]                  # B2L gather, [max_send]
-  pool = lax.all_gather(send, axis)       # [N, max_send] over ICI
-  halo = pool[halo_src_part, halo_src_pos]  # [max_halo]
-  x_full = concat([x_loc, halo])
+Two partition shapes:
+  * contiguous block rows (the reference's default partition vector)
+  * px×py×pz grid slabs when the matrix is stencil-structured
+    (AMGX_generate_distributed_poisson_7pt semantics, amgx_c.h:510-522)
+    — owned rows of a shard are a lexicographic sub-box, so boundary
+    (halo) size is O(surface), not O(volume).
+
+Halo exchange contract (on-device, distributed/solve.py): each shard
+gathers its boundary values into per-NEIGHBOR send buffers and the
+exchange is one ``lax.ppermute`` per direction over ICI — comm volume
+O(boundary).  Partitions whose halo graph is not a small neighbor set
+fall back to the all_gather pool (comm O(N·max_send)).
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Any, Optional
 
 import numpy as np
 import scipy.sparse as sps
 
+# Maximum distinct neighbor directions before falling back to the
+# all_gather pool exchange (3D face-adjacency needs 6; diagonal-coupled
+# 3D stencils on a 3D process grid need up to 26).
+_MAX_DIRECTIONS = 26
+
 
 @dataclasses.dataclass
 class DistributedMatrix:
-    """Stacked padded per-shard arrays (host numpy; move to device by
+    """Stacked padded per-shard arrays (host numpy; moved to device by
     feeding into jitted/shard_mapped functions)."""
 
     n_global: int
@@ -36,108 +49,266 @@ class DistributedMatrix:
     ell_cols: np.ndarray  # [N, rows, w] int32
     ell_vals: np.ndarray  # [N, rows, w]
     diag: np.ndarray  # [N, rows]
-    # halo machinery
-    send_idx: np.ndarray  # [N, max_send] int32 local indices to send
-    halo_src_part: np.ndarray  # [N, max_halo] int32
-    halo_src_pos: np.ndarray  # [N, max_halo] int32
+    # --- neighbor (ppermute) exchange: per direction d ---
+    # perms[d]: list[(src, dst)] device pairs; send_idx[d]: [N, ms_d]
+    # local indices to pack; each shard's halo is filled from the
+    # received buffers via (halo_dir, halo_pos).
+    perms: Any = None  # tuple of tuples of (src, dst)
+    send_idx_d: Any = None  # tuple of [N, ms_d] int32
+    halo_dir: Optional[np.ndarray] = None  # [N, max_halo] int32 (dir id)
+    halo_pos: Optional[np.ndarray] = None  # [N, max_halo] int32
+    # --- all_gather fallback exchange ---
+    send_idx: Optional[np.ndarray] = None  # [N, max_send] int32
+    halo_src_part: Optional[np.ndarray] = None  # [N, max_halo] int32
+    halo_src_pos: Optional[np.ndarray] = None  # [N, max_halo] int32
     max_send: int = 0
     max_halo: int = 0
+    # row ownership: owner[i] = part owning global row i;
+    # local_of[i] = its local slot — identity layout for contiguous
+    # partitions (owner = i // rows_per_part).
+    owner: Optional[np.ndarray] = None
+    local_of: Optional[np.ndarray] = None
+    # number of real (non-padding) owned rows per shard
+    n_owned: Optional[np.ndarray] = None
+    # process grid (px, py, pz) when the slab partition was used
+    proc_grid: Any = None
+
+    @property
+    def uses_ppermute(self) -> bool:
+        return self.perms is not None
 
     def pad_vector(self, v):
         """Global vector (n_global,) -> stacked padded [N, rows]."""
+        v = np.asarray(v)
         out = np.zeros((self.n_parts, self.rows_per_part), dtype=v.dtype)
-        flat = out.reshape(-1)
-        flat[: self.n_global] = v
-        return out.reshape(self.n_parts, self.rows_per_part)
+        if self.owner is None:
+            flat = out.reshape(-1)
+            flat[: self.n_global] = v
+        else:
+            out[self.owner, self.local_of] = v
+        return out
 
     def unpad_vector(self, vp):
-        return np.asarray(vp).reshape(-1)[: self.n_global]
+        vp = np.asarray(vp)
+        if self.owner is None:
+            return vp.reshape(-1)[: self.n_global]
+        return vp[self.owner, self.local_of]
 
 
-def partition_matrix(Asp: sps.csr_matrix, n_parts: int) -> DistributedMatrix:
-    """Contiguous block-row partition with halo renumbering."""
+def grid_partition_parts(grid, n_parts):
+    """Choose a process grid px*py*pz == n_parts matching the domain
+    aspect (largest domain axis gets the most parts)."""
+    nx, ny, nz = grid
+
+    def factorizations(n):
+        out = []
+        for px in range(1, n + 1):
+            if n % px:
+                continue
+            m = n // px
+            for py in range(1, m + 1):
+                if m % py:
+                    continue
+                out.append((px, py, m // py))
+        return out
+
+    best, best_cost = None, None
+    for px, py, pz in factorizations(n_parts):
+        if px > nx or py > ny or pz > nz:
+            continue
+        # surface-to-volume proxy: total boundary area
+        sx, sy, sz = nx / px, ny / py, nz / pz
+        cost = (px > 1) * sy * sz + (py > 1) * sx * sz + (pz > 1) * sx * sy
+        if best is None or cost < best_cost:
+            best, best_cost = (px, py, pz), cost
+    return best
+
+
+def partition_rows(n, n_parts, grid=None, proc_grid=None):
+    """owner[i] for each global row.  Contiguous blocks by default;
+    grid slabs when (nx, ny, nz) geometry is provided."""
+    if grid is None:
+        rows_pp = -(-n // n_parts)
+        return np.minimum(
+            np.arange(n, dtype=np.int64) // rows_pp, n_parts - 1
+        ).astype(np.int32), None
+    nx, ny, nz = grid
+    if proc_grid is None:
+        proc_grid = grid_partition_parts(grid, n_parts)
+    if proc_grid is None:
+        rows_pp = -(-n // n_parts)
+        return np.minimum(
+            np.arange(n, dtype=np.int64) // rows_pp, n_parts - 1
+        ).astype(np.int32), None
+    px, py, pz = proc_grid
+    i = np.arange(n, dtype=np.int64)
+    ix, iy, iz = i % nx, (i // nx) % ny, i // (nx * ny)
+    # balanced slab boundaries
+    bx = np.minimum(ix * px // nx, px - 1)
+    by = np.minimum(iy * py // ny, py - 1)
+    bz = np.minimum(iz * pz // nz, pz - 1)
+    return (bx + px * (by + py * bz)).astype(np.int32), proc_grid
+
+
+def partition_matrix(
+    Asp: sps.csr_matrix,
+    n_parts: int,
+    grid=None,
+    proc_grid=None,
+    owner=None,
+) -> DistributedMatrix:
+    """Partition + owned-first renumber + halo/exchange maps.
+
+    ``grid``/``proc_grid`` opt into the px×py×pz slab partition;
+    ``owner`` supplies an arbitrary precomputed partition vector
+    (reference partition-vector upload path).
+    """
     n = Asp.shape[0]
-    rows_pp = -(-n // n_parts)  # ceil
-    n_pad = rows_pp * n_parts
-    if n_pad > n:
-        # pad with identity rows (affect nothing: b is zero-padded)
-        Asp = sps.block_diag(
-            [Asp, sps.eye_array(n_pad - n, format="csr")], format="csr"
-        )
     Asp = Asp.tocsr()
     Asp.sort_indices()
+    if owner is None:
+        owner, proc_grid = partition_rows(n, n_parts, grid, proc_grid)
+    else:
+        owner = np.asarray(owner, dtype=np.int32)
 
+    local_of, counts, part_rows = local_numbering(owner, n_parts)
+    rows_pp = max(int(counts.max()), 1)
     parts = []
     for p in range(n_parts):
-        r0, r1 = p * rows_pp, (p + 1) * rows_pp
-        local = Asp[r0:r1].tocsr()
-        owned = (local.indices >= r0) & (local.indices < r1)
-        halo_glob = np.unique(local.indices[~owned])
-        g2l = {}
-        for li, g in enumerate(halo_glob):
-            g2l[g] = rows_pp + li
-        # remap columns
-        cols = local.indices.copy()
-        cols[owned] = cols[owned] - r0
-        if halo_glob.size:
-            cols[~owned] = np.array(
-                [g2l[g] for g in local.indices[~owned]], dtype=cols.dtype
-            )
+        local = Asp[part_rows[p]].tocsr()
         parts.append(
-            dict(
-                indptr=local.indptr,
-                cols=cols,
-                vals=local.data,
-                halo_glob=halo_glob,
-                r0=r0,
-                r1=r1,
+            localize_columns(
+                local.indptr, local.indices, local.data, owner,
+                local_of, p, rows_pp,
             )
         )
+    return finalize_partition(
+        parts, owner, local_of, counts, n, n_parts, proc_grid
+    )
 
-    # who sends what: for each part, the sorted union of its rows needed
-    # by others = boundary list (B2L, reference create_boundary_lists)
-    send_lists = [[] for _ in range(n_parts)]
+
+def local_numbering(owner, n_parts):
+    """(local_of, counts, part_rows): slot of each global row within its
+    part (global order preserved within a part), owned-row counts, and
+    the global row list per part."""
+    n = owner.shape[0]
+    order = np.argsort(owner, kind="stable")
+    counts = np.bincount(owner, minlength=n_parts)
+    local_of = np.zeros(n, dtype=np.int32)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos_in_part = np.arange(n, dtype=np.int64) - starts[owner[order]]
+    local_of[order] = pos_in_part.astype(np.int32)
+    part_rows = [order[starts[p]: starts[p] + counts[p]]
+                 for p in range(n_parts)]
+    return local_of, counts, part_rows
+
+
+def localize_columns(indptr, gcols, vals, owner, local_of, p, rows_pp):
+    """Owned-first renumbering of one shard's rows: owned columns map to
+    their local slot, off-shard columns to appended halo slots
+    (reference loadDistributed_LocalToGlobal/InitLocalMatrix)."""
+    is_owned = owner[gcols] == p
+    halo_glob = np.unique(gcols[~is_owned])
+    cols = np.empty(gcols.shape, dtype=np.int32)
+    cols[is_owned] = local_of[gcols[is_owned]]
+    if halo_glob.size:
+        cols[~is_owned] = (
+            rows_pp + np.searchsorted(halo_glob, gcols[~is_owned])
+        ).astype(np.int32)
+    return dict(indptr=indptr, cols=cols, vals=vals, halo_glob=halo_glob)
+
+
+def finalize_partition(
+    parts, owner, local_of, counts, n, n_parts, proc_grid=None
+):
+    """Build the exchange plan + stacked device arrays from per-shard
+    localized CSRs (the output of localize_columns)."""
+    rows_pp = max(int(counts.max()), 1)
+    Adtype = parts[0]["vals"].dtype if parts else np.float64
+
+    # boundary (B2L) lists: rows of p needed by q, sorted by global id
+    send_sorted = {}  # (src_owner, dst) -> sorted global ids
     for p, part in enumerate(parts):
         for g in part["halo_glob"]:
-            owner = int(g // rows_pp)
-            send_lists[owner].append(int(g))
-    send_sorted = []
-    for p in range(n_parts):
-        s = np.unique(np.array(send_lists[p], dtype=np.int64))
-        send_sorted.append(s)
-    max_send = max((len(s) for s in send_sorted), default=0)
-    max_send = max(max_send, 1)
+            key = (int(owner[g]), p)
+            send_sorted.setdefault(key, []).append(int(g))
+    for key in send_sorted:
+        send_sorted[key] = np.unique(
+            np.array(send_sorted[key], dtype=np.int64)
+        )
 
-    # per-part recv maps: halo slot -> (owner part, position in owner's
-    # send buffer)
     max_halo = max((len(p["halo_glob"]) for p in parts), default=0)
     max_halo = max(max_halo, 1)
+
+    # ---- neighbor-direction (ppermute) plan -------------------------
+    # direction = the shard-index displacement function; for grid slab
+    # partitions every (src, dst) pair with traffic maps to one of a
+    # small set of displacements, one ppermute each.
+    pairs = sorted(send_sorted.keys())
+    deltas = sorted({dst - src for (src, dst) in pairs})
+    dm = None
+    if pairs and len(deltas) <= _MAX_DIRECTIONS:
+        perms, send_idx_d = [], []
+        halo_dir = np.zeros((n_parts, max_halo), dtype=np.int32)
+        halo_pos = np.zeros((n_parts, max_halo), dtype=np.int32)
+        for d, delta in enumerate(deltas):
+            dpairs = [(s, t) for (s, t) in pairs if t - s == delta]
+            ms = max(len(send_sorted[k]) for k in dpairs)
+            sidx = np.zeros((n_parts, ms), dtype=np.int32)
+            for (s, t) in dpairs:
+                ids = send_sorted[(s, t)]
+                sidx[s, : len(ids)] = local_of[ids]
+            perms.append(tuple(dpairs))
+            send_idx_d.append(sidx)
+            for (s, t) in dpairs:
+                ids = send_sorted[(s, t)]
+                hg = parts[t]["halo_glob"]
+                mine = np.isin(hg, ids)
+                li = np.nonzero(mine)[0]
+                halo_dir[t, li] = d
+                halo_pos[t, li] = np.searchsorted(ids, hg[mine])
+        dm = dict(
+            perms=tuple(perms),
+            send_idx_d=tuple(send_idx_d),
+            halo_dir=halo_dir,
+            halo_pos=halo_pos,
+        )
+
+    # ---- all_gather fallback maps (always built: small, and used by
+    # setup-side consistency checks) ----------------------------------
+    send_union = [np.array([], dtype=np.int64)] * n_parts
+    for (s, t), ids in send_sorted.items():
+        send_union[s] = np.union1d(send_union[s], ids)
+    max_send = max(max((len(s) for s in send_union), default=0), 1)
     send_idx = np.zeros((n_parts, max_send), dtype=np.int32)
     halo_src_part = np.zeros((n_parts, max_halo), dtype=np.int32)
     halo_src_pos = np.zeros((n_parts, max_halo), dtype=np.int32)
     for p in range(n_parts):
-        s = send_sorted[p]
-        send_idx[p, : len(s)] = (s - p * rows_pp).astype(np.int32)
+        su = send_union[p]
+        send_idx[p, : len(su)] = local_of[su]
         hg = parts[p]["halo_glob"]
         for li, g in enumerate(hg):
-            owner = int(g // rows_pp)
-            pos = int(np.searchsorted(send_sorted[owner], g))
-            halo_src_part[p, li] = owner
-            halo_src_pos[p, li] = pos
+            o = int(owner[g])
+            halo_src_part[p, li] = o
+            halo_src_pos[p, li] = int(np.searchsorted(send_union[o], g))
 
-    # ELL with uniform width across shards
+    # ---- ELL with uniform width across shards -----------------------
     w = 1
     for part in parts:
         lens = np.diff(part["indptr"])
         if lens.size:
             w = max(w, int(lens.max()))
     ell_cols = np.zeros((n_parts, rows_pp, w), dtype=np.int32)
-    ell_vals = np.zeros((n_parts, rows_pp, w), dtype=Asp.dtype)
-    diag = np.zeros((n_parts, rows_pp), dtype=Asp.dtype)
+    ell_vals = np.zeros((n_parts, rows_pp, w), dtype=Adtype)
+    diag = np.zeros((n_parts, rows_pp), dtype=Adtype)
+    # padding rows get unit diagonal so smoothers stay finite there
+    diag[:, :] = 1.0
     for p, part in enumerate(parts):
+        nr = counts[p]
+        diag[p, :nr] = 0.0
         indptr, cols, vals = part["indptr"], part["cols"], part["vals"]
         lens = np.diff(indptr)
-        row_ids = np.repeat(np.arange(rows_pp), lens)
+        row_ids = np.repeat(np.arange(nr), lens)
         pos = np.arange(cols.shape[0]) - indptr[row_ids].astype(np.int64)
         ell_cols[p, row_ids, pos] = cols
         ell_vals[p, row_ids, pos] = vals
@@ -151,9 +322,17 @@ def partition_matrix(Asp: sps.csr_matrix, n_parts: int) -> DistributedMatrix:
         ell_cols=ell_cols,
         ell_vals=ell_vals,
         diag=diag,
+        perms=None if dm is None else dm["perms"],
+        send_idx_d=None if dm is None else dm["send_idx_d"],
+        halo_dir=None if dm is None else dm["halo_dir"],
+        halo_pos=None if dm is None else dm["halo_pos"],
         send_idx=send_idx,
         halo_src_part=halo_src_part,
         halo_src_pos=halo_src_pos,
         max_send=max_send,
         max_halo=max_halo,
+        owner=owner,
+        local_of=local_of,
+        n_owned=counts.astype(np.int32),
+        proc_grid=proc_grid,
     )

@@ -1,14 +1,13 @@
 """Distributed SpMV and Krylov solves under shard_map.
 
-The solve loop runs entirely inside ``shard_map`` over a 1-axis device
-mesh: halo exchange is B2L-gather -> ``all_gather`` -> halo-gather
-(reference exchange_halo, comms_mpi_hostbuffer_stream.cu), reductions are
-``psum`` (reference global_reduce).  The while_loop condition uses the
-psum'd scalar, identical on every shard — standard SPMD.
-
-This is the distributed minimum slice (Krylov + Jacobi); the distributed
-AMG hierarchy (coarse-level RAP exchange, consolidation onto sub-meshes)
-builds on the same primitives in a later milestone.
+The solve loop runs entirely inside ``shard_map`` over a device mesh.
+Halo exchange (reference exchange_halo, comms_mpi_hostbuffer_stream.cu)
+is one ``lax.ppermute`` per neighbor direction — B2L gather into a
+per-direction send buffer, neighbor permute over ICI, halo scatter —
+with comm volume O(boundary).  Partitions without a small neighbor-
+direction set fall back to the all_gather pool (O(N·max_send)).
+Reductions are ``psum`` (reference global_reduce).  The while_loop
+condition uses the psum'd scalar, identical on every shard — SPMD.
 """
 
 from __future__ import annotations
@@ -23,36 +22,70 @@ from amgx_tpu.distributed.partition import DistributedMatrix
 
 
 def _shard_params(A: DistributedMatrix):
-    """The traced per-shard arrays, stacked on the shard axis."""
-    return (
+    """Traced per-shard arrays, stacked on the shard axis: the local ELL
+    operator plus halo-exchange maps."""
+    base = (
         jnp.asarray(A.ell_cols),
         jnp.asarray(A.ell_vals),
         jnp.asarray(A.diag),
-        jnp.asarray(A.send_idx),
-        jnp.asarray(A.halo_src_part),
-        jnp.asarray(A.halo_src_pos),
     )
+    if A.uses_ppermute:
+        ex = (
+            tuple(jnp.asarray(s) for s in A.send_idx_d),
+            jnp.asarray(A.halo_dir),
+            jnp.asarray(A.halo_pos),
+        )
+    else:
+        ex = (
+            jnp.asarray(A.send_idx),
+            jnp.asarray(A.halo_src_part),
+            jnp.asarray(A.halo_src_pos),
+        )
+    return base + ex
 
 
-def _local_spmv(shard, x_loc, axis):
-    """y_loc = (A x)_loc with halo exchange over `axis`."""
-    ell_cols, ell_vals, diag, send_idx, hsp, hpos = shard
+def exchange_halo(A: DistributedMatrix, shard, x_loc, axis):
+    """halo values for x (reference exchange_halo_v2).  Runs inside
+    shard_map; `shard` is the _shard_params tuple with the leading
+    shard axis dropped."""
+    if A.uses_ppermute:
+        send_idx_d, halo_dir, halo_pos = shard[3], shard[4], shard[5]
+        halo = jnp.zeros((halo_pos.shape[0],), x_loc.dtype)
+        for d, perm in enumerate(A.perms):
+            buf = x_loc[send_idx_d[d]]
+            recv = jax.lax.ppermute(buf, axis, perm=list(perm))
+            halo = jnp.where(halo_dir == d, recv[halo_pos], halo)
+        return halo
+    send_idx, hsp, hpos = shard[3], shard[4], shard[5]
     send = x_loc[send_idx]  # B2L gather
-    pool = jax.lax.all_gather(send, axis)  # [N, max_send] over ICI
-    halo = pool[hsp, hpos]  # [max_halo]
-    xf = jnp.concatenate([x_loc, halo])
-    return jnp.sum(ell_vals * xf[ell_cols], axis=1)
+    pool = jax.lax.all_gather(send, axis)  # [N, max_send]
+    return pool[hsp, hpos]
+
+
+def make_local_spmv(A: DistributedMatrix, axis):
+    """Shard-local y = (A x)_loc with halo exchange over `axis`."""
+
+    def spmv(shard, x_loc):
+        ell_cols, ell_vals = shard[0], shard[1]
+        halo = exchange_halo(A, shard, x_loc, axis)
+        xf = jnp.concatenate([x_loc, halo])
+        return jnp.sum(ell_vals * xf[ell_cols], axis=1)
+
+    return spmv
 
 
 def _pdot(a, b, axis):
     return jax.lax.psum(jnp.dot(a, b), axis)
 
 
-def _make_dist_solver(preconditioned: bool):
-    """Builds the shard-local PCG body (Jacobi-preconditioned or plain)."""
+def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
+    axis = mesh.axis_names[0]
+    shard = _shard_params(A)
+    bp = jnp.asarray(A.pad_vector(b_global))
+    local_spmv = make_local_spmv(A, axis)
 
-    def local_solve(shard, b_loc, max_iters, tol, axis):
-        ell_cols, ell_vals, diag, *_ = shard
+    def local_solve(sh, b_loc):
+        diag = sh[2]
         dinv = jnp.where(diag != 0, 1.0 / diag, 1.0)
         x = jnp.zeros_like(b_loc)
         r = b_loc  # x0 = 0
@@ -67,7 +100,7 @@ def _make_dist_solver(preconditioned: bool):
 
         def body(c):
             it, x, r, p, rho, nrm = c
-            q = _local_spmv(shard, p, axis)
+            q = local_spmv(sh, p)
             alpha = rho / _pdot(p, q, axis)
             x = x + alpha * p
             r = r - alpha * q
@@ -82,16 +115,7 @@ def _make_dist_solver(preconditioned: bool):
         )
         return x, it, nrm
 
-    return local_solve
-
-
-def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
-    axis = mesh.axis_names[0]
-    shard = _shard_params(A)
-    bp = jnp.asarray(A.pad_vector(b_global))
-    local = _make_dist_solver(preconditioned)
-
-    in_shard = tuple(P(axis) for _ in shard)
+    in_shard = jax.tree.map(lambda _: P(axis), shard)
 
     @functools.partial(
         jax.shard_map,
@@ -100,8 +124,8 @@ def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
         out_specs=(P(axis), P(), P()),
     )
     def solve_sm(shard_stk, b_stk):
-        shard_loc = tuple(s[0] for s in shard_stk)  # drop unit shard axis
-        x, it, nrm = local(shard_loc, b_stk[0], max_iters, tol, axis)
+        sh = jax.tree.map(lambda s: s[0], shard_stk)
+        x, it, nrm = local_solve(sh, b_stk[0])
         return x[None], it, nrm
 
     x, it, nrm = jax.jit(solve_sm)(shard, bp)
@@ -119,12 +143,13 @@ def dist_cg(A: DistributedMatrix, b, mesh: Mesh, max_iters=200, tol=1e-8):
 
 
 def dist_spmv_replicated_check(A: DistributedMatrix, x, mesh: Mesh):
-    """y = A x through the distributed path (for validation against the
+    """y = A x through the distributed path (validation against the
     single-device SpMV — the distributed_io test pattern, SURVEY §4)."""
     axis = mesh.axis_names[0]
     shard = _shard_params(A)
     xp = jnp.asarray(A.pad_vector(x))
-    in_shard = tuple(P(axis) for _ in shard)
+    local_spmv = make_local_spmv(A, axis)
+    in_shard = jax.tree.map(lambda _: P(axis), shard)
 
     @functools.partial(
         jax.shard_map,
@@ -133,8 +158,8 @@ def dist_spmv_replicated_check(A: DistributedMatrix, x, mesh: Mesh):
         out_specs=P(axis),
     )
     def spmv_sm(shard_stk, x_stk):
-        shard_loc = tuple(s[0] for s in shard_stk)
-        return _local_spmv(shard_loc, x_stk[0], axis)[None]
+        sh = jax.tree.map(lambda s: s[0], shard_stk)
+        return local_spmv(sh, x_stk[0])[None]
 
     y = jax.jit(spmv_sm)(shard, xp)
     return A.unpad_vector(jax.device_get(y))

@@ -51,7 +51,10 @@ def _spmv_scalar(A, x):
         # small unstructured matrices: one MXU matmul beats TPU gathers
         return A.dense @ x
     if A.has_ell:
-        if A.ell_tcols is not None:
+        if A.ell_tcols is not None and A.values.dtype in (
+            jnp.float32,
+            jnp.bfloat16,
+        ):
             from amgx_tpu.ops.pallas_spmv import (
                 pallas_ell_spmv,
                 pallas_spmv_supported,
