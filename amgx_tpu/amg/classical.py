@@ -165,6 +165,133 @@ def pmis_select(S: sps.csr_matrix, seed: int = 0) -> np.ndarray:
     return (state == 1).astype(np.int8)
 
 
+def rs_select(S: sps.csr_matrix) -> np.ndarray:
+    """Classical Ruge-Stüben first-pass C/F splitting (reference
+    selectors/rs.cu:315 markCoarseFinePoints_1x1): priority queue on
+    the S^T degree measure with dynamic weight updates — pick the
+    max-measure point as C, its strong dependents become F, and each
+    F point's strong influences gain weight.  Host-side setup (the
+    reference runs this on host too and copies back).  Ties break to
+    the smallest index (rs.cu compare functor)."""
+    import heapq
+
+    n = S.shape[0]
+    ST = S.T.tocsr()
+    indptr, indices = S.indptr, S.indices
+    st_ptr, st_idx = ST.indptr, ST.indices
+    w = np.diff(st_ptr).astype(np.int64)  # |S^T_i|
+    UNASSIGNED, COARSE, FINE = 0, 1, -1
+    cf = np.zeros(n, dtype=np.int8)
+    # isolated: no strong outgoing connections -> strong-fine
+    iso = np.diff(indptr) == 0
+    cf[iso] = FINE
+    # zero-measure non-isolated points become F and bump the weights of
+    # their strong influences (rs.cu initial pass)
+    zero_m = (~iso) & (w == 0)
+    for j in np.nonzero(zero_m)[0]:
+        cf[j] = FINE
+        for k in indices[indptr[j]: indptr[j + 1]]:
+            if cf[k] == UNASSIGNED:
+                w[k] += 1
+    heap = [(-int(w[j]), j) for j in np.nonzero(cf == UNASSIGNED)[0]]
+    heapq.heapify(heap)
+    while heap:
+        neg, i = heapq.heappop(heap)
+        if cf[i] != UNASSIGNED or -neg != w[i]:
+            continue  # stale entry
+        cf[i] = COARSE
+        w[i] = 0
+        for j in st_idx[st_ptr[i]: st_ptr[i + 1]]:
+            if cf[j] != UNASSIGNED:
+                continue
+            cf[j] = FINE
+            for k in indices[indptr[j]: indptr[j + 1]]:
+                if cf[k] == UNASSIGNED:
+                    w[k] += 1
+                    heapq.heappush(heap, (-int(w[k]), k))
+    cf[cf == UNASSIGNED] = COARSE
+    return (cf == COARSE).astype(np.int8)
+
+
+def hmis_select(S: sps.csr_matrix) -> np.ndarray:
+    """HMIS (reference selectors/hmis.cu): Ruge-Stüben first pass, then
+    a PMIS cleanup over any points still undecided.  Single-process RS
+    decides every point, so the PMIS stage is the distributed-boundary
+    consistency step of the reference — a no-op here, kept for shape."""
+    cf = rs_select(S)
+    und = cf < 0  # rs_select returns a complete 0/1 split
+    if und.any():  # pragma: no cover - defensive
+        sub = pmis_select(S)
+        cf = np.where(und, sub, cf)
+    return cf
+
+
+def cr_select(
+    S: sps.csr_matrix,
+    Asp: sps.csr_matrix,
+    sweeps: int = 5,
+    target_rate: float = 0.7,
+    max_rounds: int = 10,
+) -> np.ndarray:
+    """Compatible-relaxation C/F splitting (reference selectors/cr.cu):
+    start all-fine, run damped-Jacobi CR sweeps on the homogeneous
+    F-point system, and promote the slowest-converging points to C
+    until the CR rate drops below the target."""
+    n = Asp.shape[0]
+    rng = np.random.default_rng(42)
+    cf = np.zeros(n, dtype=np.int8)
+    d = Asp.diagonal()
+    dinv = np.where(d != 0, 1.0 / np.where(d != 0, d, 1.0), 1.0)
+    for _ in range(max_rounds):
+        fmask = cf == 0
+        if not fmask.any():
+            break
+        e = rng.standard_normal(n)
+        e[~fmask] = 0.0
+        e /= max(np.linalg.norm(e), 1e-30)
+        prev = np.linalg.norm(e)
+        rate = 0.0
+        for _s in range(sweeps):
+            r = -(Asp @ e)
+            e = e + 0.7 * dinv * r
+            e[~fmask] = 0.0
+            cur = np.linalg.norm(e)
+            rate = cur / max(prev, 1e-30)
+            prev = cur
+        if rate <= target_rate:
+            break
+        # candidates: F points with the largest persistent error
+        score = np.abs(e)
+        score[~fmask] = -1.0
+        k = max(int(0.05 * n), 1)
+        cand = np.argpartition(score, -k)[-k:]
+        cand = cand[score[cand] > 0]
+        if cand.size == 0:
+            break
+        # independent-set filter so new C points are not S-adjacent
+        picked = []
+        blocked = np.zeros(n, dtype=bool)
+        for i in cand[np.argsort(-score[cand])]:
+            if blocked[i]:
+                continue
+            picked.append(i)
+            blocked[S.indices[S.indptr[i]: S.indptr[i + 1]]] = True
+        cf[np.array(picked, dtype=np.int64)] = 1
+    if not (cf == 1).any():
+        return pmis_select(S)  # degenerate fallback
+    # cleanup pass: every strongly-connected F point needs a C
+    # neighbor or interpolation has nothing to draw from (the RS
+    # second-pass invariant)
+    Ssym = ((S + S.T) > 0).astype(np.int8).tocsr()
+    for i in range(S.shape[0]):
+        if cf[i]:
+            continue
+        nb = Ssym.indices[Ssym.indptr[i]: Ssym.indptr[i + 1]]
+        if nb.size and not cf[nb].any():
+            cf[i] = 1
+    return cf
+
+
 def aggressive_pmis_select(S: sps.csr_matrix) -> np.ndarray:
     """Two-stage aggressive coarsening (reference selectors
     AGGRESSIVE_PMIS/AGGRESSIVE_HMIS): PMIS on S, then a second PMIS among
@@ -518,7 +645,14 @@ def build_classical_level(Asp, cfg, scope, level_id: int = 0):
             )
         P = multipass_interpolation(Asp, S, cf)
     else:
-        cf = pmis_select(S)
+        if selector in ("RS",):
+            cf = rs_select(S)
+        elif selector == "HMIS":
+            cf = hmis_select(S)
+        elif selector == "CR":
+            cf = cr_select(S, Asp)
+        else:
+            cf = pmis_select(S)
         if interp == "D1":
             P = direct_interpolation(Asp, S, cf)
         elif interp in ("D2", "STD", "STANDARD"):

@@ -58,6 +58,9 @@ def build_energymin_level(Asp, cfg, scope):
     path."""
     from amgx_tpu.amg.classical import (
         aggressive_pmis_select,
+        cr_select,
+        hmis_select,
+        rs_select,
         strength_all,
         truncate_interp,
     )
@@ -76,7 +79,14 @@ def build_energymin_level(Asp, cfg, scope):
     )
     if selector in ("AGGRESSIVE_PMIS", "AGGRESSIVE_HMIS"):
         cf = aggressive_pmis_select(S)
-    else:  # PMIS/HMIS/CR collapse to PMIS here (reference CR is TBD)
+    elif selector == "CR":
+        # reference energymin default: compatible relaxation (cr.cu)
+        cf = cr_select(S, Asp)
+    elif selector == "RS":
+        cf = rs_select(S)
+    elif selector == "HMIS":
+        cf = hmis_select(S)
+    else:
         cf = pmis_select(S)
     P = energymin_interpolation(Asp, S, cf)
     P = truncate_interp(P, trunc, max_el)

@@ -40,7 +40,8 @@ class DistributedAMG:
     """Multi-level distributed AMG-PCG solver."""
 
     def __init__(self, Asp: sps.csr_matrix, mesh: Mesh, cfg=None,
-                 scope: str = "default", consolidate_rows: int = 4096):
+                 scope: str = "default",
+                 consolidate_rows: int | None = None):
         from amgx_tpu.config.amg_config import AMGConfig
 
         self.mesh = mesh
@@ -58,9 +59,14 @@ class DistributedAMG:
                 ' "monitor_residual": 0}}'
             )
             scope = "amg"
+        from amgx_tpu.distributed.hierarchy import _CONSOLIDATE_ROWS
+
         self.cfg = cfg
         self.scope = scope
-        self.consolidate_rows = consolidate_rows
+        self.consolidate_rows = (
+            _CONSOLIDATE_ROWS if consolidate_rows is None
+            else consolidate_rows
+        )
         self._setup(Asp)
 
     # ------------------------------------------------------------------

@@ -1,102 +1,85 @@
-"""Multicolor DILU (diagonal-ILU(0)) smoother — the reference's workhorse
-preconditioner (multicolor_dilu_solver.cu, 4259 LoC of block-size
-specialized CUDA).
+"""Multicolor DILU and true multicolor ILU(k) smoothers.
 
-Math: with coloring-induced ordering and E the DILU diagonal,
+Reference parity: multicolor_dilu_solver.cu (4259 LoC, block sizes
+1-10 — the reference's workhorse preconditioner) and
+multicolor_ilu_solver.cu (2222 LoC, ILU(0)/ILU(1) with fill via
+csr_sparsity).
+
+DILU math: with coloring-induced ordering and E the DILU diagonal,
 
     E_i = a_ii - sum_{j in N(i), color(j) < color(i)} a_ij E_j^{-1} a_ji
     M   = (E + L) E^{-1} (E + U)
 
-where L/U are the strictly lower/upper (by color order) parts of A.
 Apply M^{-1} r: forward color sweep solves (E+L) y = r, backward sweep
 solves (E+U) z = E y.
 
-TPU form: E is computed at setup with a host loop over colors (vectorized
-scipy per color — the analogue of the reference's per-color setup
-kernels); L/U are the same CSR structure with masked values, so each
-sweep stage is one masked SpMV + select, ``2 * num_colors`` stages per
-application, all fused under jit.  Scalar (block_size 1) for now.
+TPU form: rows are sliced PER COLOR at setup into compact ELL slices,
+so one application costs O(nnz) total (each stored entry is touched by
+exactly one forward and one backward stage) — not the
+O(num_colors * nnz) of a masked full-matrix sweep.  Blocks are native:
+E is a batched b×b inverse and sweep updates are einsum block
+mat-vecs, matching the reference's block-specialized kernels instead
+of scalar expansion.
+
+ILU(k): exact multicolor ILU factors on the level-k fill pattern
+(pattern of A^(k+1) sums, the reference csr_sparsity product for
+ILU1).  Rows of one color are structurally independent in the fill
+pattern (the pattern graph is what gets colored), so the numeric
+factorization vectorizes over color pairs:
+
+    for color c ascending, for earlier color c2 ascending:
+        L_block = Rc[:, rows_c2] / u_kk          (column scaling)
+        Rc      = Rc - (L_block @ U[rows_c2]) restricted to the pattern
+        Rc[:, rows_c2] = L_block
+
+Apply M^{-1} r = U^{-1} L^{-1} r by the same per-color ELL sweeps
+(L unit-diagonal forward, U backward with inverted diagonal).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
+import scipy.sparse as sps
 
 from amgx_tpu.core.matrix import SparseMatrix
 from amgx_tpu.ops.coloring import color_matrix
-from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
 
 
-@register_solver("MULTICOLOR_DILU")
-class MulticolorDILUSolver(Solver):
-    def __init__(self, cfg, scope="default"):
-        super().__init__(cfg, scope)
-        self.scheme = str(cfg.get("matrix_coloring_scheme", scope))
-        self.deterministic = bool(cfg.get("determinism_flag", scope))
+def _color_ell_slices(Asp: sps.csr_matrix, rows_by_color, block=None):
+    """Per-color compact ELL slices of a (masked) host CSR matrix.
 
-    def _setup_impl(self, A: SparseMatrix):
-        from amgx_tpu.ops.diagonal import scalarized
-
-        A = scalarized(A, "MULTICOLOR_DILU")
-        colors = color_matrix(A, self.scheme, self.deterministic)
-        self.num_colors = int(colors.max()) + 1
-
-        indptr = np.asarray(A.row_offsets)
-        indices = np.asarray(A.col_indices)
-        vals = np.asarray(A.values)
-        n = A.n_rows
-        row_ids = np.asarray(A.row_ids)
-
-        lower = colors[indices] < colors[row_ids]
-        upper = colors[indices] > colors[row_ids]
-
-        # E via W = A .* A^T on the intersected sparsity (host scipy)
-        import scipy.sparse as sps
-
-        Asp = sps.csr_matrix((vals, indices, indptr), shape=(n, n))
-        W = Asp.multiply(Asp.T).tocsr()  # w_ij = a_ij * a_ji
-        W.sort_indices()
-        E = np.array(np.asarray(A.diag), copy=True)
-        for c in range(1, self.num_colors):
-            rows_c = np.nonzero(colors == c)[0]
-            if rows_c.size == 0:
-                continue
-            with np.errstate(divide="ignore", invalid="ignore"):
-                einv = np.where(
-                    (E != 0) & (colors < c), 1.0 / E, 0.0
-                )
-            corr = W[rows_c] @ einv
-            E[rows_c] = np.asarray(A.diag)[rows_c] - corr
-        E = np.where(E == 0, 1.0, E)  # zero-pivot guard
-
-        A_L = SparseMatrix.from_csr(
-            indptr, indices, np.where(lower, vals, 0.0),
-            n_cols=A.n_cols, build_ell=A.has_ell,
+    Returns list of (cols[nc, w], vals[nc, w] or [nc, w, b, b]); colors
+    with no stored entries get width-1 zero slices so the traced sweep
+    structure is uniform.
+    """
+    out = []
+    for rows_c in rows_by_color:
+        sub = Asp[rows_c].tocsr()
+        lens = np.diff(sub.indptr)
+        w = max(int(lens.max()) if lens.size else 0, 1)
+        cols = np.zeros((len(rows_c), w), dtype=np.int32)
+        if block is None:
+            vals = np.zeros((len(rows_c), w), dtype=sub.data.dtype)
+        else:
+            vals = np.zeros(
+                (len(rows_c), w, block, block), dtype=sub.data.dtype
+            )
+        rid = np.repeat(np.arange(len(rows_c)), lens)
+        pos = np.arange(sub.indices.shape[0]) - sub.indptr[rid].astype(
+            np.int64
         )
-        A_U = SparseMatrix.from_csr(
-            indptr, indices, np.where(upper, vals, 0.0),
-            n_cols=A.n_cols, build_ell=A.has_ell,
-        )
-        einv = (1.0 / E).astype(vals.dtype)
-        self._params = (A, A_L, A_U, jnp.asarray(einv), jnp.asarray(colors))
+        cols[rid, pos] = sub.indices
+        vals[rid, pos] = sub.data
+        out.append((cols, vals))
+    return out
 
-    def _apply_M_inv(self, params, r):
-        A, A_L, A_U, einv, colors = params
-        ncol = self.num_colors
-        # forward: (E+L) y = r
-        y = jnp.zeros_like(r)
-        for c in range(ncol):
-            cand = (r - spmv(A_L, y)) * einv
-            y = jnp.where(colors == c, cand, y)
-        # backward: (E+U) z = E y  ->  z = y - Einv (U z)
-        z = y
-        for c in range(ncol - 1, -1, -1):
-            cand = y - einv * spmv(A_U, z)
-            z = jnp.where(colors == c, cand, z)
-        return z
+
+class _ColorSweepSmoother(Solver):
+    """Shared stationary-step shell for the per-color sweep smoothers:
+    subclasses provide _apply_M_inv(params, r)."""
 
     def make_residual_step(self):
         omega = self.relaxation_factor
@@ -120,8 +103,347 @@ class MulticolorDILUSolver(Solver):
         return apply
 
 
+@register_solver("MULTICOLOR_DILU")
+class MulticolorDILUSolver(_ColorSweepSmoother):
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.scheme = str(cfg.get("matrix_coloring_scheme", scope))
+        self.deterministic = bool(cfg.get("determinism_flag", scope))
+
+    def _setup_impl(self, A: SparseMatrix):
+        b = A.block_size
+        colors = color_matrix(A, self.scheme, self.deterministic)
+        self.num_colors = nc = int(colors.max()) + 1
+        rows_by_color = [np.nonzero(colors == c)[0] for c in range(nc)]
+        self._rows_by_color = rows_by_color
+
+        # copies: jax device buffers are read-only; scipy mutates
+        indptr = np.array(A.row_offsets)
+        indices = np.array(A.col_indices)
+        vals = np.array(A.values)
+        n = A.n_rows
+        row_ids = np.asarray(A.row_ids)
+        lower = colors[indices] < colors[row_ids]
+        upper = colors[indices] > colors[row_ids]
+        diag = np.asarray(A.diag)
+
+        # ---- E factors (block-native) -------------------------------
+        if b == 1:
+            Asp = sps.csr_matrix((vals, indices, indptr), shape=(n, n))
+            W = Asp.multiply(Asp.T).tocsr()  # w_ij = a_ij * a_ji
+            E = diag.astype(vals.dtype).copy()
+            for c in range(1, nc):
+                rows_c = rows_by_color[c]
+                if rows_c.size == 0:
+                    continue
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    einv = np.where(
+                        (E != 0) & (colors < c), 1.0 / E, 0.0
+                    )
+                E[rows_c] = diag[rows_c] - (W[rows_c] @ einv)
+            E = np.where(E == 0, 1.0, E)
+            einv_full = (1.0 / E).astype(vals.dtype)
+        else:
+            # block E: E_i = a_ii - sum_lower a_ij Einv_j a_ji
+            # map (i,j) -> slot of (j,i) if present
+            AT = sps.csr_matrix(
+                (np.arange(len(indices)) + 1, indices, indptr),
+                shape=(n, n),
+            ).T.tocsr()
+            AT.sort_indices()
+            trans_slot = np.full(len(indices), -1, dtype=np.int64)
+            # entries of AT are (j,i) slots laid out in the same (row,
+            # col) order as A's pattern iff A's pattern is symmetric;
+            # handle general patterns via searchsorted per row
+            for i in range(n):
+                s0, s1 = indptr[i], indptr[i + 1]
+                cols_i = indices[s0:s1]
+                t0, t1 = AT.indptr[i], AT.indptr[i + 1]
+                at_cols = AT.indices[t0:t1]
+                at_slot = AT.data[t0:t1] - 1
+                pos = np.searchsorted(at_cols, cols_i)
+                ok = (pos < at_cols.shape[0]) & (
+                    at_cols[np.minimum(pos, len(at_cols) - 1)] == cols_i
+                )
+                trans_slot[s0:s1][ok] = at_slot[pos[ok]]
+            Einv = np.zeros((n, b, b), dtype=vals.dtype)
+            E = diag.astype(vals.dtype).copy()
+            eye = np.eye(b, dtype=vals.dtype)
+            for c in range(nc):
+                rows_c = rows_by_color[c]
+                if rows_c.size == 0:
+                    continue
+                if c > 0:
+                    # correction: sum over lower entries with transpose
+                    for i in rows_c:
+                        acc = np.zeros((b, b), vals.dtype)
+                        for s in range(indptr[i], indptr[i + 1]):
+                            j = indices[s]
+                            ts = trans_slot[s]
+                            if colors[j] < c and ts >= 0:
+                                acc += vals[s] @ Einv[j] @ vals[ts]
+                        E[i] = diag[i] - acc
+                # invert (guarded)
+                blk = E[rows_c]
+                dets_ok = np.abs(np.linalg.det(blk)) > 1e-300
+                safe = np.where(dets_ok[:, None, None], blk, eye)
+                Einv[rows_c] = np.linalg.inv(safe)
+            einv_full = Einv
+
+        # ---- per-color ELL slices of L and U ------------------------
+        shape = (n, n)
+        if b == 1:
+            # independent index copies: eliminate_zeros() compacts
+            # indices/indptr in place and the two matrices must not
+            # share them
+            L = sps.csr_matrix(
+                (np.where(lower, vals, 0.0), indices.copy(),
+                 indptr.copy()), shape
+            )
+            U = sps.csr_matrix(
+                (np.where(upper, vals, 0.0), indices.copy(),
+                 indptr.copy()), shape
+            )
+        else:
+            zb = np.zeros_like(vals)
+            L = sps.bsr_matrix(
+                (np.where(lower[:, None, None], vals, zb), indices,
+                 indptr), shape=(n * b, n * b),
+            )
+            U = sps.bsr_matrix(
+                (np.where(upper[:, None, None], vals, zb), indices,
+                 indptr), shape=(n * b, n * b),
+            )
+        if b == 1:
+            L.eliminate_zeros()
+            U.eliminate_zeros()
+            Ls = _color_ell_slices(L.tocsr(), rows_by_color)
+            Us = _color_ell_slices(U.tocsr(), rows_by_color)
+        else:
+            Ls = _block_color_slices(
+                indptr, indices, np.where(lower[:, None, None], vals, 0),
+                rows_by_color, b,
+            )
+            Us = _block_color_slices(
+                indptr, indices, np.where(upper[:, None, None], vals, 0),
+                rows_by_color, b,
+            )
+
+        dev = jnp.asarray
+        # params[0] is the operator (base Solver convention)
+        self._params = (
+            A,
+            tuple((dev(c), dev(v)) for c, v in Ls),
+            tuple((dev(c), dev(v)) for c, v in Us),
+            tuple(dev(r) for r in rows_by_color),
+            dev(einv_full),
+        )
+        self._block = b
+
+    # ------------------------------------------------------------------
+
+    def _apply_M_inv(self, params, r):
+        _A, Ls, Us, rows, einv = params
+        b = self._block
+        ncol = len(rows)
+        if b == 1:
+            y = jnp.zeros_like(r)
+            for c in range(ncol):
+                Lc, Lv = Ls[c]
+                s = jnp.sum(Lv * y[Lc], axis=1)
+                y = y.at[rows[c]].set((r[rows[c]] - s) * einv[rows[c]])
+            z = y
+            for c in range(ncol - 1, -1, -1):
+                Uc, Uv = Us[c]
+                s = jnp.sum(Uv * z[Uc], axis=1)
+                z = z.at[rows[c]].set(y[rows[c]] - einv[rows[c]] * s)
+            return z
+        r2 = r.reshape(-1, b)
+        y = jnp.zeros_like(r2)
+        for c in range(ncol):
+            Lc, Lv = Ls[c]
+            s = jnp.einsum("nwij,nwj->ni", Lv, y[Lc])
+            rc = r2[rows[c]] - s
+            y = y.at[rows[c]].set(
+                jnp.einsum("nij,nj->ni", einv[rows[c]], rc)
+            )
+        z = y
+        for c in range(ncol - 1, -1, -1):
+            Uc, Uv = Us[c]
+            s = jnp.einsum("nwij,nwj->ni", Uv, z[Uc])
+            corr = jnp.einsum("nij,nj->ni", einv[rows[c]], s)
+            z = z.at[rows[c]].set(y[rows[c]] - corr)
+        return z.reshape(-1)
+
+
+
+def _block_color_slices(indptr, indices, vals, rows_by_color, b):
+    """Per-color ELL slices for block CSR (vals (nnz, b, b))."""
+    out = []
+    n = indptr.shape[0] - 1
+    lens_all = np.diff(indptr)
+    for rows_c in rows_by_color:
+        w = max(int(lens_all[rows_c].max()) if rows_c.size else 0, 1)
+        cols = np.zeros((len(rows_c), w), dtype=np.int32)
+        vv = np.zeros((len(rows_c), w, b, b), dtype=vals.dtype)
+        for li, i in enumerate(rows_c):
+            s0, s1 = indptr[i], indptr[i + 1]
+            cols[li, : s1 - s0] = indices[s0:s1]
+            vv[li, : s1 - s0] = vals[s0:s1]
+        out.append((cols, vv))
+    return out
+
+
 @register_solver("MULTICOLOR_ILU")
-class MulticolorILUSolver(MulticolorDILUSolver):
-    """ILU(0) approximation: the reference multicolor_ilu_solver.cu keeps
-    full L/U factors; DILU is its diagonal variant and a good stand-in
-    until the factorized version lands (ilu_sparsity_level=0 only)."""
+class MulticolorILUSolver(_ColorSweepSmoother):
+    """True multicolor ILU(k) (reference multicolor_ilu_solver.cu):
+    exact LU factors on the level-k fill pattern, factorized and
+    applied color-block-wise.  Scalar path; block matrices are
+    scalar-expanded with a warning (the reference specializes blocks —
+    native block ILU is a later milestone)."""
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.scheme = str(cfg.get("matrix_coloring_scheme", scope))
+        self.deterministic = bool(cfg.get("determinism_flag", scope))
+        self.fill_level = int(cfg.get("ilu_sparsity_level", scope))
+
+    def _setup_impl(self, A: SparseMatrix):
+        from amgx_tpu.ops.diagonal import scalarized
+
+        A = scalarized(A, "MULTICOLOR_ILU")
+        n = A.n_rows
+        Asp = sps.csr_matrix(
+            (np.array(A.values), np.array(A.col_indices),
+             np.array(A.row_offsets)),
+            shape=(n, n),
+        )
+
+        # level-k fill pattern (reference csr_sparsity for ILU1)
+        Sb = (Asp != 0).astype(np.int8).tocsr()
+        patt = Sb.copy()
+        for _ in range(max(self.fill_level, 0)):
+            patt = ((patt @ Sb + patt) != 0).astype(np.int8).tocsr()
+        patt.setdiag(1)
+        patt.sort_indices()
+
+        # color the PATTERN graph: same-color rows are independent in
+        # the fill pattern, which is what the factorization needs
+        patt_mat = SparseMatrix.from_csr(
+            patt.indptr, patt.indices,
+            patt.data.astype(np.asarray(A.values).dtype),
+            build_ell=False,
+        )
+        colors = color_matrix(patt_mat, self.scheme, self.deterministic)
+        self.num_colors = ncol = int(colors.max()) + 1
+        rows_by_color = [
+            np.nonzero(colors == c)[0] for c in range(ncol)
+        ]
+
+        # numeric factorization by color pairs (module docstring);
+        # fill slots materialize through the pattern-projected
+        # subtraction (sparse difference takes the union structure)
+        work = Asp.copy().tocsr()
+        work.sort_indices()
+        dtype = work.dtype
+        rows_store = [None] * ncol
+        u_store = [None] * ncol  # U-part only (cols with color >= c)
+        udiag = np.ones(n, dtype=dtype)
+        pattb = patt.astype(bool)
+        for ci, rows_c in enumerate(rows_by_color):
+            Rc = work[rows_c].tocsr()
+            maskc = pattb[rows_c]
+            for c2 in range(ci):
+                rows_c2 = rows_by_color[c2]
+                B = Rc[:, rows_c2].tocsr()
+                if B.nnz == 0:
+                    continue
+                inv = 1.0 / udiag[rows_c2]
+                Lb = B.multiply(inv[None, :]).tocsr()
+                # elimination uses ONLY the U-part of the factored
+                # rows: their L-values (columns of colors < c2) are
+                # factor entries, not residual matrix values
+                upd = (Lb @ u_store[c2]).multiply(maskc)
+                Rc = (Rc - upd).tocsr()
+                # put l_ik into the eliminated slots (cols of c2)
+                emb = sps.csr_matrix(
+                    (Lb.tocoo().data,
+                     (Lb.tocoo().row,
+                      rows_c2[Lb.tocoo().col])),
+                    shape=Rc.shape,
+                )
+                # columns of c2 in Rc are now ~0 (a_ik - l_ik u_kk);
+                # clear numerically and set l values
+                sel = np.zeros(n, dtype=bool)
+                sel[rows_c2] = True
+                coo = Rc.tocoo()
+                keep = ~sel[coo.col]
+                Rc = sps.csr_matrix(
+                    (coo.data[keep], (coo.row[keep], coo.col[keep])),
+                    shape=Rc.shape,
+                ) + emb
+                Rc = Rc.tocsr()
+            d = np.asarray(Rc[np.arange(len(rows_c)), rows_c]).ravel()
+            d = np.where(d == 0, 1.0, d)
+            udiag[rows_c] = d
+            rows_store[ci] = Rc
+            ucols = colors >= ci
+            coo_u = Rc.tocoo()
+            ukeep = ucols[coo_u.col]
+            u_store[ci] = sps.csr_matrix(
+                (coo_u.data[ukeep],
+                 (coo_u.row[ukeep], coo_u.col[ukeep])),
+                shape=Rc.shape,
+            )
+        # assemble factored matrix rows
+        full = sps.vstack(
+            [rows_store[c] for c in range(ncol)], format="csr"
+        )
+        order = np.concatenate(rows_by_color)
+        inv_order = np.argsort(order)
+        fact = full[inv_order].tocsr()
+
+        # split into unit-L (colors <) and U (colors >=) per-color ELL
+        coo = fact.tocoo()
+        lmask = colors[coo.col] < colors[coo.row]
+        umask = (colors[coo.col] > colors[coo.row]) & (
+            coo.col != coo.row
+        )
+        L = sps.csr_matrix(
+            (coo.data * lmask, (coo.row, coo.col)), shape=(n, n)
+        )
+        U = sps.csr_matrix(
+            (coo.data * umask, (coo.row, coo.col)), shape=(n, n)
+        )
+        L.eliminate_zeros()
+        U.eliminate_zeros()
+        Ls = _color_ell_slices(L.tocsr(), rows_by_color)
+        Us = _color_ell_slices(U.tocsr(), rows_by_color)
+
+        dev = jnp.asarray
+        # params[0] is the operator (base Solver convention)
+        self._params = (
+            A,
+            tuple((dev(c), dev(v)) for c, v in Ls),
+            tuple((dev(c), dev(v)) for c, v in Us),
+            tuple(dev(r) for r in rows_by_color),
+            dev((1.0 / udiag).astype(dtype)),
+        )
+
+    def _apply_M_inv(self, params, r):
+        _A, Ls, Us, rows, uinv = params
+        ncol = len(rows)
+        # forward: L y = r (unit diagonal)
+        y = jnp.zeros_like(r)
+        for c in range(ncol):
+            Lc, Lv = Ls[c]
+            s = jnp.sum(Lv * y[Lc], axis=1)
+            y = y.at[rows[c]].set(r[rows[c]] - s)
+        # backward: U z = y
+        z = jnp.zeros_like(r)
+        for c in range(ncol - 1, -1, -1):
+            Uc, Uv = Us[c]
+            s = jnp.sum(Uv * z[Uc], axis=1)
+            z = z.at[rows[c]].set((y[rows[c]] - s) * uinv[rows[c]])
+        return z
+

@@ -1,0 +1,245 @@
+"""RS/HMIS/CR selectors and true ILU(k)/block DILU tests
+(reference src/tests/: classical_pmis.cu, ilu_dilu_equivalence.cu,
+ilu1_coloringA.cu, smoother_block_poisson.cu)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import amgx_tpu
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.io.poisson import poisson_2d_5pt, poisson_3d_7pt, poisson_rhs
+from amgx_tpu.solvers import create_solver
+
+amgx_tpu.initialize()
+
+
+def _strength(Asp):
+    from amgx_tpu.amg.classical import strength_ahat
+
+    return strength_ahat(Asp, 0.25, 0.9)
+
+
+def _valid_splitting(S, cf):
+    """Every F point with strong connections has a C neighbor."""
+    Ssym = ((S + S.T) > 0).astype(np.int8).tocsr()
+    for i in np.nonzero(cf == 0)[0]:
+        nb = Ssym.indices[Ssym.indptr[i]: Ssym.indptr[i + 1]]
+        if nb.size and not cf[nb].any():
+            return False
+    return True
+
+
+@pytest.mark.parametrize("selector", ["RS", "HMIS", "CR"])
+def test_selector_valid_splitting(selector):
+    from amgx_tpu.amg.classical import cr_select, hmis_select, rs_select
+
+    Asp = poisson_2d_5pt(20).to_scipy()
+    S = _strength(Asp)
+    if selector == "RS":
+        cf = rs_select(S)
+    elif selector == "HMIS":
+        cf = hmis_select(S)
+    else:
+        cf = cr_select(S, Asp)
+    nc = int(cf.sum())
+    assert 0 < nc < Asp.shape[0]
+    if selector != "CR":  # CR picks C by relaxation, not adjacency
+        assert _valid_splitting(S, cf)
+
+
+def test_rs_red_black_on_2d_poisson():
+    """RS first pass on isotropic 2D Poisson yields the textbook ~50%
+    red-black coarsening (reference rs.cu behavior)."""
+    from amgx_tpu.amg.classical import rs_select
+
+    Asp = poisson_2d_5pt(16).to_scipy()
+    cf = rs_select(_strength(Asp))
+    frac = cf.sum() / Asp.shape[0]
+    assert 0.4 <= frac <= 0.6, frac
+
+
+@pytest.mark.parametrize("selector", ["RS", "HMIS", "CR"])
+def test_classical_amg_with_selector_converges(selector):
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "AMG", "algorithm": "CLASSICAL",'
+        f' "selector": "{selector}",'
+        ' "interpolator": "D1",'
+        ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+        ' "relaxation_factor": 0.8}, "presweeps": 2, "postsweeps": 2,'
+        ' "max_levels": 10, "min_coarse_rows": 16,'
+        ' "coarse_solver": "DENSE_LU_SOLVER", "cycle": "V",'
+        ' "max_iters": 50, "monitor_residual": 1,'
+        ' "convergence": "RELATIVE_INI", "tolerance": 1e-8}}'
+    )
+    A = poisson_2d_5pt(24)
+    b = poisson_rhs(A.n_rows)
+    s = create_solver(cfg, "default")
+    s.setup(A)
+    res = s.solve(b)
+    rel = float(
+        np.linalg.norm(b - A.to_scipy() @ np.asarray(res.x))
+        / np.linalg.norm(b)
+    )
+    assert rel < 1e-7, (selector, rel, int(res.iters))
+
+
+# ---------------------------------------------------------------------------
+# ILU(k) / DILU
+
+
+def _smoother(name, extra=""):
+    return AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        f' "solver": "{name}", "monitor_residual": 1,'
+        ' "tolerance": 1e-10, "max_iters": 60,'
+        ' "relaxation_factor": 1.0,'
+        f' "convergence": "RELATIVE_INI"{extra}}}}}'
+    )
+
+
+def test_ilu0_exact_on_pattern():
+    """(L U)_ij == a_ij on the sparsity pattern — the defining ILU(0)
+    property (reference ilu_dilu_equivalence.cu checks factors)."""
+    A = poisson_2d_5pt(8)
+    s = create_solver(_smoother("MULTICOLOR_ILU"), "default")
+    s.setup(A)
+    _A, Ls, Us, rows, uinv = s._params
+    n = A.n_rows
+    L = np.eye(n)
+    U = np.zeros((n, n))
+    for c, rc in enumerate(rows):
+        rc = np.asarray(rc)
+        Lc, Lv = np.asarray(Ls[c][0]), np.asarray(Ls[c][1])
+        Uc, Uv = np.asarray(Us[c][0]), np.asarray(Us[c][1])
+        for li, i in enumerate(rc):
+            for k in range(Lc.shape[1]):
+                if Lv[li, k] != 0:
+                    L[i, Lc[li, k]] += Lv[li, k]
+            U[i, i] = 1.0 / np.asarray(uinv)[i]
+            for k in range(Uc.shape[1]):
+                if Uv[li, k] != 0:
+                    U[i, Uc[li, k]] += Uv[li, k]
+    LU = L @ U
+    Ad = A.to_dense()
+    np.testing.assert_allclose(LU[Ad != 0], Ad[Ad != 0], atol=1e-12)
+
+
+def test_ilu1_beats_ilu0():
+    """Fill level 1 gives a strictly better preconditioner on Poisson."""
+    A = poisson_2d_5pt(24)
+    b = poisson_rhs(A.n_rows)
+    rels = {}
+    for lev in (0, 1):
+        s = create_solver(
+            _smoother(
+                "MULTICOLOR_ILU", f', "ilu_sparsity_level": {lev}'
+            ),
+            "default",
+        )
+        s.setup(A)
+        res = s.solve(b)
+        rels[lev] = float(np.max(np.asarray(res.final_norm)))
+    assert rels[1] < rels[0] * 0.5, rels
+
+
+def test_dilu_block_native():
+    """Block DILU runs on native b x b blocks (no scalar expansion)."""
+    sp = poisson_2d_5pt(10).to_scipy()
+    n = sp.shape[0]
+    blk = sps.kron(sp, sps.eye_array(2)) + 0.1 * sps.kron(
+        sps.eye_array(n), sps.csr_matrix(np.array([[0.0, 1], [1, 0]]))
+    )
+    A2 = SparseMatrix.from_scipy(blk.tocsr(), block_size=2)
+    s = create_solver(_smoother("MULTICOLOR_DILU"), "default")
+    s.setup(A2)
+    assert s._block == 2
+    b = np.ones(2 * n)
+    res = s.solve(b)
+    rel = float(
+        np.linalg.norm(b - blk @ np.asarray(res.x)) / np.linalg.norm(b)
+    )
+    assert rel < 1e-4, rel
+
+
+def test_dilu_linear_cost_structure():
+    """Each stored entry appears in exactly one per-color slice (the
+    O(nnz)-per-sweep contract; VERDICT r1 weak #7)."""
+    A = poisson_2d_5pt(16)
+    s = create_solver(_smoother("MULTICOLOR_DILU"), "default")
+    s.setup(A)
+    _A, Ls, Us, rows, _einv = s._params
+    stored = sum(
+        int((np.asarray(v) != 0).sum()) for _c, v in Ls
+    ) + sum(int((np.asarray(v) != 0).sum()) for _c, v in Us)
+    offdiag_nnz = A.nnz - A.n_rows
+    assert stored == offdiag_nnz, (stored, offdiag_nnz)
+
+
+def test_ilu_as_amg_smoother():
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "AMG", "algorithm": "CLASSICAL",'
+        ' "selector": "HMIS", "interpolator": "D1",'
+        ' "smoother": {"scope": "s", "solver": "MULTICOLOR_ILU",'
+        ' "relaxation_factor": 1.0}, "presweeps": 1, "postsweeps": 1,'
+        ' "max_levels": 8, "min_coarse_rows": 16,'
+        ' "coarse_solver": "DENSE_LU_SOLVER", "cycle": "V",'
+        ' "max_iters": 40, "monitor_residual": 1,'
+        ' "convergence": "RELATIVE_INI", "tolerance": 1e-8}}'
+    )
+    A = poisson_2d_5pt(20)
+    b = poisson_rhs(A.n_rows)
+    s = create_solver(cfg, "default")
+    s.setup(A)
+    res = s.solve(b)
+    rel = float(
+        np.linalg.norm(b - A.to_scipy() @ np.asarray(res.x))
+        / np.linalg.norm(b)
+    )
+    assert rel < 1e-7, (rel, int(res.iters))
+
+
+def test_ilu0_exact_on_pattern_multicolor():
+    """>=3-color pattern: elimination must use only the U-part of
+    factored rows (regression for the color-pair update bug)."""
+    rng = np.random.default_rng(5)
+    n = 40
+    # ring + chords: odd cycle -> not 2-colorable
+    rows, cols = [], []
+    for i in range(n):
+        for j in (i - 1, i + 1, i + 7):
+            rows.append(i)
+            cols.append(j % n)
+    m = sps.csr_matrix(
+        (np.full(len(rows), -1.0), (rows, cols)), shape=(n, n)
+    )
+    m = (m + m.T) * 0.5
+    m.setdiag(8.0)
+    m = m.tocsr()
+    A = SparseMatrix.from_scipy(m)
+    s = create_solver(_smoother("MULTICOLOR_ILU"), "default")
+    s.setup(A)
+    assert s.num_colors >= 3, s.num_colors
+    _A, Ls, Us, rows_, uinv = s._params
+    L = np.eye(n)
+    U = np.zeros((n, n))
+    for c, rc in enumerate(rows_):
+        rc = np.asarray(rc)
+        Lc, Lv = np.asarray(Ls[c][0]), np.asarray(Ls[c][1])
+        Uc, Uv = np.asarray(Us[c][0]), np.asarray(Us[c][1])
+        for li, i in enumerate(rc):
+            for k in range(Lc.shape[1]):
+                if Lv[li, k] != 0:
+                    L[i, Lc[li, k]] += Lv[li, k]
+            U[i, i] = 1.0 / np.asarray(uinv)[i]
+            for k in range(Uc.shape[1]):
+                if Uv[li, k] != 0:
+                    U[i, Uc[li, k]] += Uv[li, k]
+    Ad = np.asarray(m.todense())
+    # exact on the pattern slots, in the COLOR ordering sense: LU must
+    # reproduce A wherever the fill pattern has a slot
+    err = np.max(np.abs((L @ U - Ad)[Ad != 0]))
+    assert err < 1e-10, err
