@@ -41,7 +41,8 @@ class DistributedAMG:
 
     def __init__(self, Asp: sps.csr_matrix, mesh: Mesh, cfg=None,
                  scope: str = "default",
-                 consolidate_rows: int | None = None):
+                 consolidate_rows: int | None = None,
+                 owner=None, grid=None):
         from amgx_tpu.config.amg_config import AMGConfig
 
         self.mesh = mesh
@@ -67,6 +68,8 @@ class DistributedAMG:
             _CONSOLIDATE_ROWS if consolidate_rows is None
             else consolidate_rows
         )
+        self._owner = owner
+        self._grid = grid
         self._setup(Asp)
 
     # ------------------------------------------------------------------
@@ -89,6 +92,7 @@ class DistributedAMG:
 
         self.h: DistHierarchy = build_distributed_hierarchy(
             Asp, self.n_parts, self.cfg, self.scope,
+            grid=self._grid, owner=self._owner,
             consolidate_rows=self.consolidate_rows,
         )
         self.fine = self.h.levels[0].A

@@ -191,30 +191,18 @@ class MulticolorDILUSolver(_ColorSweepSmoother):
             einv_full = Einv
 
         # ---- per-color ELL slices of L and U ------------------------
-        shape = (n, n)
         if b == 1:
             # independent index copies: eliminate_zeros() compacts
             # indices/indptr in place and the two matrices must not
             # share them
             L = sps.csr_matrix(
                 (np.where(lower, vals, 0.0), indices.copy(),
-                 indptr.copy()), shape
+                 indptr.copy()), (n, n)
             )
             U = sps.csr_matrix(
                 (np.where(upper, vals, 0.0), indices.copy(),
-                 indptr.copy()), shape
+                 indptr.copy()), (n, n)
             )
-        else:
-            zb = np.zeros_like(vals)
-            L = sps.bsr_matrix(
-                (np.where(lower[:, None, None], vals, zb), indices,
-                 indptr), shape=(n * b, n * b),
-            )
-            U = sps.bsr_matrix(
-                (np.where(upper[:, None, None], vals, zb), indices,
-                 indptr), shape=(n * b, n * b),
-            )
-        if b == 1:
             L.eliminate_zeros()
             U.eliminate_zeros()
             Ls = _color_ell_slices(L.tocsr(), rows_by_color)

@@ -542,3 +542,362 @@ AMGX_RC AMGX_write_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
                        1);
   LEAVE_RET(rc);
 }
+
+/* ------------------------------------------------------------------ */
+/* distributed entry points (reference amgx_c.h:235-259,547-594)       */
+
+AMGX_RC AMGX_resources_create(AMGX_resources_handle *res,
+                              AMGX_config_handle cfg, void *comm,
+                              int device_num, const int *devices) {
+  (void)comm;
+  (void)devices;
+  ENTER();
+  PyObject *r = capi_call(
+      "resources_create",
+      Py_BuildValue("(KOi)", (unsigned long long)cfg, Py_None,
+                    device_num),
+      1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  *res = (uintptr_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  LEAVE_RET(PyErr_Occurred() ? rc_from_exception() : AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_distribution_create(AMGX_distribution_handle *dist,
+                                 AMGX_config_handle cfg) {
+  ENTER();
+  PyObject *r = capi_call("distribution_create",
+                          Py_BuildValue("(K)", (unsigned long long)cfg), 1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  *dist = (uintptr_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  LEAVE_RET(PyErr_Occurred() ? rc_from_exception() : AMGX_RC_OK);
+}
+
+static void dist_data_forget(uintptr_t dist);
+
+AMGX_RC AMGX_distribution_destroy(AMGX_distribution_handle dist) {
+  ENTER();
+  dist_data_forget(dist);
+  AMGX_RC rc = call_rc("distribution_destroy",
+                       Py_BuildValue("(K)", (unsigned long long)dist), 1);
+  LEAVE_RET(rc);
+}
+
+/* The partition-data length is not in the C signature (the reference
+ * gets the rank count from the MPI communicator); the shim records
+ * the raw pointer per distribution handle and copies the data at
+ * upload time, when n_global is known.  One slot per live handle;
+ * re-setting overwrites, destroy frees the slot. */
+static struct {
+  uintptr_t dist;
+  const void *data;
+  int info;
+} g_dist_data[256];
+static int g_dist_count = 0;
+
+static int dist_data_find(uintptr_t dist) {
+  for (int i = 0; i < g_dist_count; ++i)
+    if (g_dist_data[i].dist == dist) return i;
+  return -1;
+}
+
+static void dist_data_forget(uintptr_t dist) {
+  int i = dist_data_find(dist);
+  if (i >= 0) {
+    g_dist_data[i] = g_dist_data[g_dist_count - 1];
+    g_dist_count--;
+  }
+}
+
+AMGX_RC AMGX_distribution_set_partition_data(
+    AMGX_distribution_handle dist, AMGX_DIST_PARTITION_INFO info,
+    const void *partition_data) {
+  ENTER();
+  int i = dist_data_find(dist);
+  if (i < 0) {
+    if (g_dist_count >= 256) LEAVE_RET(AMGX_RC_INTERNAL);
+    i = g_dist_count++;
+  }
+  g_dist_data[i].dist = dist;
+  g_dist_data[i].data = partition_data; /* NULL resets to default */
+  g_dist_data[i].info = (int)info;
+  /* record the scheme on the Python handle now; data follows at
+   * upload time when sizes are known */
+  AMGX_RC rc = call_rc(
+      "distribution_set_partition_data",
+      Py_BuildValue("(KiO)", (unsigned long long)dist, (int)info,
+                    Py_None),
+      1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_distribution_set_32bit_colindices(
+    AMGX_distribution_handle dist, int use32bit) {
+  ENTER();
+  AMGX_RC rc = call_rc(
+      "distribution_set_32bit_colindices",
+      Py_BuildValue("(Ki)", (unsigned long long)dist, use32bit), 1);
+  LEAVE_RET(rc);
+}
+
+static AMGX_RC upload_global_impl(const char *pyfn, AMGX_matrix_handle mtx,
+                                  int n_global, int n, int nnz,
+                                  int block_dimx, int block_dimy,
+                                  const int *row_ptrs,
+                                  const void *col_indices_global,
+                                  const void *data, const void *diag_data,
+                                  int halo_depth, int rings,
+                                  const int *partition_vector,
+                                  size_t col_isz) {
+  int e = handle_entry(mtx);
+  if (e < 0) return AMGX_RC_BAD_PARAMETERS;
+  size_t msz = g_modes[e].mat_size;
+  size_t vsz = msz * (size_t)nnz * block_dimx * block_dimy;
+  size_t dsz = msz * (size_t)n * block_dimx * block_dimy;
+  PyObject *diag = diag_data
+                       ? PyBytes_FromStringAndSize((const char *)diag_data,
+                                                   (Py_ssize_t)dsz)
+                       : (Py_INCREF(Py_None), Py_None);
+  PyObject *pv =
+      partition_vector
+          ? PyBytes_FromStringAndSize((const char *)partition_vector,
+                                      (Py_ssize_t)(sizeof(int) *
+                                                   (size_t)n_global))
+          : (Py_INCREF(Py_None), Py_None);
+  AMGX_RC rc = call_rc(
+      pyfn,
+      Py_BuildValue(
+          "(Kiiiiiy#y#y#NiiN)", (unsigned long long)mtx, n_global, n, nnz,
+          block_dimx, block_dimy, (const char *)row_ptrs,
+          (Py_ssize_t)(sizeof(int) * (size_t)(n + 1)),
+          (const char *)col_indices_global,
+          (Py_ssize_t)(col_isz * (size_t)nnz), (const char *)data,
+          (Py_ssize_t)vsz, diag, halo_depth, rings, pv),
+      1);
+  if (rc == AMGX_RC_OK) g_modes[handle_entry(mtx)].block_size = block_dimx;
+  return rc;
+}
+
+AMGX_RC AMGX_matrix_upload_all_global(
+    AMGX_matrix_handle mtx, int n_global, int n, int nnz, int block_dimx,
+    int block_dimy, const int *row_ptrs, const void *col_indices_global,
+    const void *data, const void *diag_data, int allocated_halo_depth,
+    int num_import_rings, const int *partition_vector) {
+  ENTER();
+  AMGX_RC rc = upload_global_impl(
+      "matrix_upload_all_global", mtx, n_global, n, nnz, block_dimx,
+      block_dimy, row_ptrs, col_indices_global, data, diag_data,
+      allocated_halo_depth, num_import_rings, partition_vector,
+      sizeof(long long));
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_matrix_upload_all_global_32(
+    AMGX_matrix_handle mtx, int n_global, int n, int nnz, int block_dimx,
+    int block_dimy, const int *row_ptrs, const void *col_indices_global,
+    const void *data, const void *diag_data, int allocated_halo_depth,
+    int num_import_rings, const int *partition_vector) {
+  ENTER();
+  AMGX_RC rc = upload_global_impl(
+      "matrix_upload_all_global_32", mtx, n_global, n, nnz, block_dimx,
+      block_dimy, row_ptrs, col_indices_global, data, diag_data,
+      allocated_halo_depth, num_import_rings, partition_vector,
+      sizeof(int));
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_matrix_upload_distributed(
+    AMGX_matrix_handle mtx, int n_global, int n, int nnz, int block_dimx,
+    int block_dimy, const int *row_ptrs, const void *col_indices_global,
+    const void *data, const void *diag_data,
+    AMGX_distribution_handle distribution) {
+  ENTER();
+  /* resolve the deferred partition data now that sizes are known */
+  int use32 = 0;
+  {
+    PyObject *r = capi_call(
+        "distribution_uses_32bit",
+        Py_BuildValue("(K)", (unsigned long long)distribution), 1);
+    if (!r) LEAVE_RET(rc_from_exception());
+    use32 = PyObject_IsTrue(r);
+    Py_DECREF(r);
+  }
+  {
+    int i = dist_data_find(distribution);
+    if (i >= 0 && g_dist_data[i].data) {
+      int info = g_dist_data[i].info;
+      PyObject *blob;
+      if (info == AMGX_DIST_PARTITION_VECTOR) {
+        blob = PyBytes_FromStringAndSize(
+            (const char *)g_dist_data[i].data,
+            (Py_ssize_t)(sizeof(int) * (size_t)n_global));
+      } else {
+        /* offsets array: the C signature carries no length; scan for
+         * the terminal element == n_global (offsets are nondecreasing
+         * and end at n_global; element width matches the colindices
+         * width).  A malformed array that never reaches n_global
+         * within the 4096-rank cap is rejected. */
+        size_t w = use32 ? sizeof(int) : sizeof(long long);
+        const char *p = (const char *)g_dist_data[i].data;
+        size_t count = 1;
+        long long v = 0;
+        for (; count <= 4096; ++count) {
+          v = use32 ? (long long)((const int *)p)[count - 1]
+                    : ((const long long *)p)[count - 1];
+          if (v >= (long long)n_global) break;
+        }
+        if (v != (long long)n_global)
+          LEAVE_RET(AMGX_RC_BAD_PARAMETERS);
+        blob = PyBytes_FromStringAndSize(p, (Py_ssize_t)(w * count));
+      }
+      AMGX_RC rc0 = call_rc(
+          "distribution_set_partition_blob",
+          Py_BuildValue("(KiN)", (unsigned long long)distribution, info,
+                        blob),
+          1);
+      if (rc0 != AMGX_RC_OK) LEAVE_RET(rc0);
+    }
+  }
+  AMGX_RC rc;
+  {
+    int e = handle_entry(mtx);
+    if (e < 0) LEAVE_RET(AMGX_RC_BAD_PARAMETERS);
+    size_t msz = g_modes[e].mat_size;
+    size_t vsz = msz * (size_t)nnz * block_dimx * block_dimy;
+    size_t dsz = msz * (size_t)n * block_dimx * block_dimy;
+    size_t cisz = use32 ? sizeof(int) : sizeof(long long);
+    PyObject *diag =
+        diag_data ? PyBytes_FromStringAndSize((const char *)diag_data,
+                                              (Py_ssize_t)dsz)
+                  : (Py_INCREF(Py_None), Py_None);
+    rc = call_rc(
+        "matrix_upload_distributed",
+        Py_BuildValue(
+            "(Kiiiiiy#y#y#NK)", (unsigned long long)mtx, n_global, n, nnz,
+            block_dimx, block_dimy, (const char *)row_ptrs,
+            (Py_ssize_t)(sizeof(int) * (size_t)(n + 1)),
+            (const char *)col_indices_global,
+            (Py_ssize_t)(cisz * (size_t)nnz), (const char *)data,
+            (Py_ssize_t)vsz, diag, (unsigned long long)distribution),
+        1);
+    if (rc == AMGX_RC_OK)
+      g_modes[handle_entry(mtx)].block_size = block_dimx;
+  }
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_read_system_distributed(
+    AMGX_matrix_handle mtx, AMGX_vector_handle rhs, AMGX_vector_handle sol,
+    const char *filename, int allocated_halo_depth, int num_partitions,
+    const int *partition_sizes, int partition_vector_size,
+    const int *partition_vector) {
+  (void)partition_sizes;
+  ENTER();
+  PyObject *pv =
+      partition_vector
+          ? PyBytes_FromStringAndSize(
+                (const char *)partition_vector,
+                (Py_ssize_t)(sizeof(int) * (size_t)partition_vector_size))
+          : (Py_INCREF(Py_None), Py_None);
+  AMGX_RC rc = call_rc(
+      "read_system_distributed",
+      Py_BuildValue("(KKKsiiOiN)", (unsigned long long)mtx,
+                    (unsigned long long)rhs, (unsigned long long)sol,
+                    filename, allocated_halo_depth, num_partitions,
+                    Py_None, partition_vector_size, pv),
+      1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_write_system_distributed(
+    AMGX_matrix_handle mtx, AMGX_vector_handle rhs, AMGX_vector_handle sol,
+    const char *filename, int allocated_halo_depth, int num_partitions,
+    const int *partition_sizes, int partition_vector_size,
+    const int *partition_vector) {
+  (void)allocated_halo_depth;
+  (void)num_partitions;
+  (void)partition_sizes;
+  (void)partition_vector_size;
+  (void)partition_vector;
+  ENTER();
+  AMGX_RC rc = call_rc("write_system_distributed",
+                       Py_BuildValue("(KKKs)", (unsigned long long)mtx,
+                                     (unsigned long long)rhs,
+                                     (unsigned long long)sol, filename),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_generate_distributed_poisson_7pt(
+    AMGX_matrix_handle mtx, AMGX_vector_handle rhs, AMGX_vector_handle sol,
+    int allocated_halo_depth, int num_import_rings, int nx, int ny, int nz,
+    int px, int py, int pz) {
+  (void)allocated_halo_depth;
+  (void)num_import_rings;
+  ENTER();
+  AMGX_RC rc = call_rc(
+      "generate_distributed_poisson_7pt",
+      Py_BuildValue("(KKKiiiiii)", (unsigned long long)mtx,
+                    (unsigned long long)rhs, (unsigned long long)sol, nx,
+                    ny, nz, px, py, pz),
+      1);
+  LEAVE_RET(rc);
+}
+
+/* ------------------------------------------------------------------ */
+/* eigensolver (reference amgx_eig_c.h)                                */
+
+AMGX_RC AMGX_eigensolver_create(AMGX_eigensolver_handle *ret,
+                                AMGX_resources_handle rsc,
+                                const char *mode,
+                                AMGX_config_handle cfg) {
+  ENTER();
+  AMGX_RC rc = create_with_mode("eig_solver_create", rsc, mode, cfg, 1,
+                                ret);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_eigensolver_setup(AMGX_eigensolver_handle slv,
+                               AMGX_matrix_handle mtx) {
+  ENTER();
+  AMGX_RC rc = call_rc("eig_solver_setup",
+                       Py_BuildValue("(KK)", (unsigned long long)slv,
+                                     (unsigned long long)mtx),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_eigensolver_pagerank_setup(AMGX_eigensolver_handle slv,
+                                        AMGX_vector_handle a) {
+  ENTER();
+  AMGX_RC rc = call_rc("eig_solver_pagerank_setup",
+                       Py_BuildValue("(KK)", (unsigned long long)slv,
+                                     (unsigned long long)a),
+                       1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_eigensolver_solve(AMGX_eigensolver_handle slv,
+                               AMGX_vector_handle x) {
+  ENTER();
+  AMGX_RC rc = call_rc("eig_solver_solve",
+                       Py_BuildValue("(KK)", (unsigned long long)slv,
+                                     (unsigned long long)x),
+                       1);
+  if (rc == AMGX_RC_OK) {
+    /* reference semantics: x receives the leading eigenvector */
+    rc = call_rc("eig_solver_get_eigenvector",
+                 Py_BuildValue("(KiK)", (unsigned long long)slv, 0,
+                               (unsigned long long)x),
+                 1);
+  }
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_eigensolver_destroy(AMGX_eigensolver_handle slv) {
+  ENTER();
+  AMGX_RC rc = call_rc("eig_solver_destroy",
+                       Py_BuildValue("(K)", (unsigned long long)slv), 1);
+  LEAVE_RET(rc);
+}

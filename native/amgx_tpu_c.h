@@ -50,6 +50,13 @@ typedef uintptr_t AMGX_resources_handle;
 typedef uintptr_t AMGX_matrix_handle;
 typedef uintptr_t AMGX_vector_handle;
 typedef uintptr_t AMGX_solver_handle;
+typedef uintptr_t AMGX_distribution_handle;
+typedef uintptr_t AMGX_eigensolver_handle;
+
+typedef enum {
+  AMGX_DIST_PARTITION_VECTOR = 0,
+  AMGX_DIST_PARTITION_OFFSETS = 1
+} AMGX_DIST_PARTITION_INFO;
 
 /* Mode is passed as its name string ("dDDI", "dFFI", ...). */
 
@@ -113,6 +120,64 @@ AMGX_RC AMGX_read_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
                          AMGX_vector_handle sol, const char *filename);
 AMGX_RC AMGX_write_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
                           AMGX_vector_handle sol, const char *filename);
+
+/* ---- distributed entry points (reference amgx_c.h:235-259,547-594,
+ * 439-460, 510-522).  The comm argument of resources_create maps to
+ * the jax device mesh; device_num selects how many mesh devices
+ * distributed solves shard over. ---- */
+AMGX_RC AMGX_resources_create(AMGX_resources_handle *res,
+                              AMGX_config_handle cfg, void *comm,
+                              int device_num, const int *devices);
+AMGX_RC AMGX_distribution_create(AMGX_distribution_handle *dist,
+                                 AMGX_config_handle cfg);
+AMGX_RC AMGX_distribution_destroy(AMGX_distribution_handle dist);
+AMGX_RC AMGX_distribution_set_partition_data(
+    AMGX_distribution_handle dist, AMGX_DIST_PARTITION_INFO info,
+    const void *partition_data);
+AMGX_RC AMGX_distribution_set_32bit_colindices(
+    AMGX_distribution_handle dist, int use32bit);
+AMGX_RC AMGX_matrix_upload_all_global(
+    AMGX_matrix_handle mtx, int n_global, int n, int nnz, int block_dimx,
+    int block_dimy, const int *row_ptrs, const void *col_indices_global,
+    const void *data, const void *diag_data, int allocated_halo_depth,
+    int num_import_rings, const int *partition_vector);
+AMGX_RC AMGX_matrix_upload_all_global_32(
+    AMGX_matrix_handle mtx, int n_global, int n, int nnz, int block_dimx,
+    int block_dimy, const int *row_ptrs, const void *col_indices_global,
+    const void *data, const void *diag_data, int allocated_halo_depth,
+    int num_import_rings, const int *partition_vector);
+AMGX_RC AMGX_matrix_upload_distributed(
+    AMGX_matrix_handle mtx, int n_global, int n, int nnz, int block_dimx,
+    int block_dimy, const int *row_ptrs, const void *col_indices_global,
+    const void *data, const void *diag_data,
+    AMGX_distribution_handle distribution);
+AMGX_RC AMGX_read_system_distributed(
+    AMGX_matrix_handle mtx, AMGX_vector_handle rhs, AMGX_vector_handle sol,
+    const char *filename, int allocated_halo_depth, int num_partitions,
+    const int *partition_sizes, int partition_vector_size,
+    const int *partition_vector);
+AMGX_RC AMGX_write_system_distributed(
+    AMGX_matrix_handle mtx, AMGX_vector_handle rhs, AMGX_vector_handle sol,
+    const char *filename, int allocated_halo_depth, int num_partitions,
+    const int *partition_sizes, int partition_vector_size,
+    const int *partition_vector);
+AMGX_RC AMGX_generate_distributed_poisson_7pt(
+    AMGX_matrix_handle mtx, AMGX_vector_handle rhs, AMGX_vector_handle sol,
+    int allocated_halo_depth, int num_import_rings, int nx, int ny, int nz,
+    int px, int py, int pz);
+
+/* ---- eigensolver (reference amgx_eig_c.h) ---- */
+AMGX_RC AMGX_eigensolver_create(AMGX_eigensolver_handle *ret,
+                                AMGX_resources_handle rsc,
+                                const char *mode,
+                                AMGX_config_handle cfg);
+AMGX_RC AMGX_eigensolver_setup(AMGX_eigensolver_handle slv,
+                               AMGX_matrix_handle mtx);
+AMGX_RC AMGX_eigensolver_pagerank_setup(AMGX_eigensolver_handle slv,
+                                        AMGX_vector_handle a);
+AMGX_RC AMGX_eigensolver_solve(AMGX_eigensolver_handle slv,
+                               AMGX_vector_handle x);
+AMGX_RC AMGX_eigensolver_destroy(AMGX_eigensolver_handle slv);
 
 #ifdef __cplusplus
 }

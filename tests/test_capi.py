@@ -191,3 +191,99 @@ def test_eig_solver_api():
     with pytest.raises(capi.AMGXError):
         capi.eig_solver_get_eigenvector(es, 99, v)
     capi.eig_solver_destroy(es)
+
+
+# ---------------------------------------------------------------------------
+# distributed C API (reference amgx_c.h:235-259,547-594; VERDICT r1 #9)
+
+
+def test_capi_upload_all_global_and_solve():
+    from amgx_tpu.api import capi
+    from amgx_tpu.io.poisson import poisson_3d_7pt
+
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "max_iters": 80, "tolerance": 1e-8,'
+        ' "monitor_residual": 1, "preconditioner": {"scope": "amg",'
+        ' "solver": "AMG", "algorithm": "AGGREGATION",'
+        ' "selector": "SIZE_2", "smoother": {"scope": "j",'
+        ' "solver": "BLOCK_JACOBI", "relaxation_factor": 0.8},'
+        ' "max_iters": 1, "cycle": "V",'
+        ' "coarse_solver": "DENSE_LU_SOLVER"}}}'
+    )
+    res = capi.resources_create(cfg, None, 8)
+    sp = poisson_3d_7pt(12).to_scipy().tocsr()
+    n = sp.shape[0]
+    A = capi.matrix_create(res, "dDDI")
+    pv = (np.arange(n) * 8 // n).astype(np.int32)
+    capi.matrix_upload_all_global(
+        A, n, n, sp.nnz, 1, 1, sp.indptr, sp.indices.astype(np.int64),
+        sp.data, None, 1, 1, pv,
+    )
+    b = capi.vector_create(res, "dDDI")
+    x = capi.vector_create(res, "dDDI")
+    capi.vector_upload(b, n, 1, np.ones(n))
+    capi.vector_set_zero(x, n, 1)
+    slv = capi.solver_create(res, "dDDI", cfg)
+    capi.solver_setup(slv, A)
+    capi.solver_solve_with_0_initial_guess(slv, b, x)
+    assert capi.solver_get_status(slv) == capi.SOLVE_SUCCESS
+    xs = capi.vector_download(x)
+    rel = np.linalg.norm(np.ones(n) - sp @ xs) / np.sqrt(n)
+    assert rel < 1e-7
+
+
+def test_capi_upload_distributed_offsets():
+    from amgx_tpu.api import capi
+    from amgx_tpu.io.poisson import poisson_2d_5pt
+
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "max_iters": 80, "tolerance": 1e-8,'
+        ' "monitor_residual": 1}}'
+    )
+    res = capi.resources_create(cfg, None, 4)
+    sp = poisson_2d_5pt(16).to_scipy().tocsr()
+    n = sp.shape[0]
+    d = capi.distribution_create(cfg)
+    offs = np.linspace(0, n, 5).astype(np.int64)
+    capi.distribution_set_partition_data(
+        d, capi.AMGX_DIST_PARTITION_OFFSETS, offs
+    )
+    A = capi.matrix_create(res, "dDDI")
+    capi.matrix_upload_distributed(
+        A, n, n, sp.nnz, 1, 1, sp.indptr, sp.indices.astype(np.int64),
+        sp.data, None, d,
+    )
+    m = capi._get(A, capi._Matrix)
+    assert m.owner is not None
+    assert int(m.owner.max()) == 3
+    b = capi.vector_create(res, "dDDI")
+    x = capi.vector_create(res, "dDDI")
+    capi.vector_upload(b, n, 1, np.ones(n))
+    capi.vector_set_zero(x, n, 1)
+    slv = capi.solver_create(res, "dDDI", cfg)
+    capi.solver_setup(slv, A)
+    capi.solver_solve_with_0_initial_guess(slv, b, x)
+    assert capi.solver_get_status(slv) == capi.SOLVE_SUCCESS
+
+
+def test_capi_generate_distributed_poisson_grid():
+    from amgx_tpu.api import capi
+
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "max_iters": 60, "tolerance": 1e-8,'
+        ' "monitor_residual": 1}}'
+    )
+    res = capi.resources_create(cfg, None, 8)
+    A = capi.matrix_create(res, "dDDI")
+    b = capi.vector_create(res, "dDDI")
+    x = capi.vector_create(res, "dDDI")
+    capi.generate_distributed_poisson_7pt(A, b, x, 6, 6, 6, 2, 2, 2)
+    m = capi._get(A, capi._Matrix)
+    assert m.owner is not None and m.grid == (12, 12, 12)
+    slv = capi.solver_create(res, "dDDI", cfg)
+    capi.solver_setup(slv, A)
+    capi.solver_solve_with_0_initial_guess(slv, b, x)
+    assert capi.solver_get_status(slv) == capi.SOLVE_SUCCESS
