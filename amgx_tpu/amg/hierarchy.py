@@ -17,8 +17,9 @@ unrolled program size.
 
 from __future__ import annotations
 
-import numpy as np
+import jax
 import jax.numpy as jnp
+import numpy as np
 import scipy.sparse as sps
 
 from amgx_tpu.core.matrix import SparseMatrix
@@ -214,19 +215,28 @@ class AMGSolver(Solver):
         def cycle(params, b, x, lvl_id=0):
             level_params, coarse_params = params
             A, P, R, smp = level_params[lvl_id]
+            # named scopes tag the emitted HLO so device traces break the
+            # cycle down per level/phase (NVTX-range analogue, SURVEY
+            # §5.1; reference fixed_cycle.cu levelProfile tics)
             if lvl_id == n_levels - 1:
-                if coarse_apply is not None:
-                    # error-correction form is exact for direct solvers and
-                    # safe for nonzero x (reference launchCoarseSolver)
-                    return x + coarse_apply(coarse_params, b - spmv(A, x))
-                return smooth_fns[lvl_id](
-                    smp, b, x, self.coarsest_sweeps
-                )
+                with jax.named_scope("amg_coarse_solve"):
+                    if coarse_apply is not None:
+                        # error-correction form is exact for direct
+                        # solvers and safe for nonzero x (reference
+                        # launchCoarseSolver)
+                        return x + coarse_apply(
+                            coarse_params, b - spmv(A, x)
+                        )
+                    return smooth_fns[lvl_id](
+                        smp, b, x, self.coarsest_sweeps
+                    )
             pre, post = self._level_sweeps(lvl_id)
             if pre > 0:
-                x = smooth_fns[lvl_id](smp, b, x, pre)
-            r = b - spmv(A, x)
-            bc = spmv(R, r)
+                with jax.named_scope(f"amg_l{lvl_id}_presmooth"):
+                    x = smooth_fns[lvl_id](smp, b, x, pre)
+            with jax.named_scope(f"amg_l{lvl_id}_restrict"):
+                r = b - spmv(A, x)
+                bc = spmv(R, r)
             xc = jnp.zeros(
                 (R.n_rows * R.block_size,), dtype=b.dtype
             )
@@ -243,9 +253,11 @@ class AMGSolver(Solver):
                 xc = _kcycle_solve(params, bc, lvl_id + 1)
             else:
                 xc = cycle(params, bc, xc, lvl_id + 1)
-            x = x + spmv(P, xc)
+            with jax.named_scope(f"amg_l{lvl_id}_prolong"):
+                x = x + spmv(P, xc)
             if post > 0:
-                x = smooth_fns[lvl_id](smp, b, x, post)
+                with jax.named_scope(f"amg_l{lvl_id}_postsmooth"):
+                    x = smooth_fns[lvl_id](smp, b, x, post)
             return x
 
         def _kcycle_solve(params, b, lvl_id):

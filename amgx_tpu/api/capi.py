@@ -55,6 +55,23 @@ class AMGXError(Exception):
         self.rc = rc
 
 
+def _traced(fn):
+    """Profiler span per C-API entry (reference: nvtxRange on every
+    AMGX_* call, amgx_c.cu:2747 / amgx_timer.h:32-43)."""
+    import functools
+
+    from amgx_tpu.core.profiling import trace_range
+
+    name = "AMGX_" + fn.__name__
+
+    @functools.wraps(fn)
+    def wrap(*a, **k):
+        with trace_range(name):
+            return fn(*a, **k)
+
+    return wrap
+
+
 _lock = threading.Lock()
 _next_handle = [1]
 _objects: Dict[int, object] = {}
@@ -380,6 +397,7 @@ def _as_array(buf, dtype, count):
     return a.reshape(-1)[:count] if count >= 0 else a.reshape(-1)
 
 
+@_traced
 def matrix_upload_all(
     mtx_h: int,
     n: int,
@@ -474,6 +492,7 @@ def _upload_global(
     return RC_OK
 
 
+@_traced
 def matrix_upload_all_global(
     mtx_h: int,
     n_global: int,
@@ -527,6 +546,7 @@ def matrix_upload_all_global_32(
     )
 
 
+@_traced
 def matrix_upload_distributed(
     mtx_h: int,
     n_global: int,
@@ -569,6 +589,7 @@ def matrix_upload_distributed(
     )
 
 
+@_traced
 def matrix_replace_coefficients(mtx_h, n, nnz, data, diag_data=None):
     m = _get(mtx_h, _Matrix)
     if m.A is None:
@@ -618,6 +639,7 @@ def vector_create(res_h: int, mode: str = "dDDI") -> int:
     return _new(_Vector(_get(res_h, _Resources), m))
 
 
+@_traced
 def vector_upload(vec_h: int, n: int, block_dim: int, data):
     v = _get(vec_h, _Vector)
     v.data = np.array(
@@ -642,6 +664,7 @@ def vector_set_random(vec_h: int, n: int):
     return RC_OK
 
 
+@_traced
 def vector_download(vec_h: int) -> np.ndarray:
     v = _get(vec_h, _Vector)
     if v.data is None:
@@ -779,6 +802,7 @@ class _DistSolver:
         )
 
 
+@_traced
 def solver_setup(slv_h: int, mtx_h: int):
     from amgx_tpu.solvers.registry import create_solver
 
@@ -818,10 +842,12 @@ def _solve_impl(s, rhs_h, sol_h, zero_guess):
     return RC_OK
 
 
+@_traced
 def solver_solve(slv_h: int, rhs_h: int, sol_h: int):
     return _solve_impl(_get(slv_h, _SolverHandle), rhs_h, sol_h, False)
 
 
+@_traced
 def solver_solve_with_0_initial_guess(slv_h: int, rhs_h: int, sol_h: int):
     return _solve_impl(_get(slv_h, _SolverHandle), rhs_h, sol_h, True)
 
@@ -850,6 +876,7 @@ def solver_get_iteration_residual(slv_h: int, it: int, idx: int = 0):
     return float(hist[it, idx])
 
 
+@_traced
 def solver_resetup(slv_h: int, mtx_h: int):
     """Refresh the solver for a matrix whose VALUES changed but whose
     structure is intact (reference AMGX_solver_resetup, amgx_c.h:604-607;
@@ -890,6 +917,7 @@ def eig_solver_create(res_h: int, mode: str, cfg_h: int) -> int:
     )
 
 
+@_traced
 def eig_solver_setup(slv_h: int, mtx_h: int):
     from amgx_tpu.eigensolvers import create_eigensolver
 
@@ -916,6 +944,7 @@ def eig_solver_pagerank_setup(slv_h: int, vec_h: int):
     return RC_OK
 
 
+@_traced
 def eig_solver_solve(slv_h: int, x0_h: int = 0):
     s = _get(slv_h, _EigSolverHandle)
     if s.solver is None:
@@ -964,6 +993,7 @@ def eig_solver_destroy(slv_h: int):
 # IO (amgx_c.h:424-529)
 
 
+@_traced
 def read_system(mtx_h: int, rhs_h: int, sol_h: int, filename: str):
     from amgx_tpu.io.matrix_market import MatrixIOError
     from amgx_tpu.io.matrix_market import read_system as _read
@@ -998,6 +1028,7 @@ def read_system(mtx_h: int, rhs_h: int, sol_h: int, filename: str):
     return RC_OK
 
 
+@_traced
 def write_system(mtx_h: int, rhs_h: int, sol_h: int, filename: str):
     from amgx_tpu.io.matrix_market import write_system as _write
 

@@ -376,3 +376,39 @@ def test_geo_aggregation_semicoarsens_anisotropic():
     # nodes differing in y do not
     assert agg[0] == agg[7]
     assert agg[0] != agg[nx]
+
+
+def test_profiling_hooks():
+    """Per-level phase timers + named HLO scopes (reference
+    amgx_timer.h:32-60 nvtxRange/levelProfile; SURVEY §5.1)."""
+    import jax
+
+    from amgx_tpu.core.profiling import profile_cycle, trace_range
+
+    A = poisson_3d_7pt(8)
+    b = poisson_rhs(A.n_rows)
+    cfg = AMGConfig.from_string(
+        AMG_STANDALONE % ("AGGREGATION", "SIZE_2", "V")
+    )
+    s = create_solver(cfg, "default")
+    s.setup(A)
+    prof = profile_cycle(s, b)
+    keys = set(prof.times)
+    assert any(k.endswith("/smooth_pre") for k in keys)
+    assert any(k.endswith("/restrict") for k in keys)
+    assert any(k.endswith("/prolong") for k in keys)
+    assert "coarse/solve" in keys or "coarse/smooth" in keys
+    assert all(v >= 0 for v in prof.times.values())
+    # the traced cycle carries named scopes into the HLO metadata
+    cyc = s.make_cycle()
+    params = s.apply_params()
+    import jax.numpy as jnp
+
+    hlo = jax.jit(cyc).lower(
+        params, jnp.asarray(b), jnp.zeros_like(jnp.asarray(b))
+    ).as_text(debug_info=True)
+    assert "amg_l0_restrict" in hlo
+    assert "amg_coarse_solve" in hlo
+    # API-level trace spans are usable as context managers
+    with trace_range("AMGX_test_span"):
+        pass
