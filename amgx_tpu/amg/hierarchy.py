@@ -23,6 +23,7 @@ import numpy as np
 import scipy.sparse as sps
 
 from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.core.profiling import named_scope
 from amgx_tpu.ops.blas import dot
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
@@ -219,7 +220,7 @@ class AMGSolver(Solver):
             # cycle down per level/phase (NVTX-range analogue, SURVEY
             # §5.1; reference fixed_cycle.cu levelProfile tics)
             if lvl_id == n_levels - 1:
-                with jax.named_scope("amg_coarse_solve"):
+                with named_scope("amg_coarse_solve"):
                     if coarse_apply is not None:
                         # error-correction form is exact for direct
                         # solvers and safe for nonzero x (reference
@@ -232,9 +233,9 @@ class AMGSolver(Solver):
                     )
             pre, post = self._level_sweeps(lvl_id)
             if pre > 0:
-                with jax.named_scope(f"amg_l{lvl_id}_presmooth"):
+                with named_scope(f"amg_l{lvl_id}_presmooth"):
                     x = smooth_fns[lvl_id](smp, b, x, pre)
-            with jax.named_scope(f"amg_l{lvl_id}_restrict"):
+            with named_scope(f"amg_l{lvl_id}_restrict"):
                 r = b - spmv(A, x)
                 bc = spmv(R, r)
             xc = jnp.zeros(
@@ -253,10 +254,10 @@ class AMGSolver(Solver):
                 xc = _kcycle_solve(params, bc, lvl_id + 1)
             else:
                 xc = cycle(params, bc, xc, lvl_id + 1)
-            with jax.named_scope(f"amg_l{lvl_id}_prolong"):
+            with named_scope(f"amg_l{lvl_id}_prolong"):
                 x = x + spmv(P, xc)
             if post > 0:
-                with jax.named_scope(f"amg_l{lvl_id}_postsmooth"):
+                with named_scope(f"amg_l{lvl_id}_postsmooth"):
                     x = smooth_fns[lvl_id](smp, b, x, post)
             return x
 
@@ -269,11 +270,13 @@ class AMGSolver(Solver):
             flexible = cycle_type == "CGF"
             x = jnp.zeros((A.n_rows * A.block_size,), b.dtype)
             r = b
-            z = cycle(params, r, jnp.zeros_like(r), lvl_id)
+            with named_scope(f"amg_l{lvl_id}_kcycle_precond"):
+                z = cycle(params, r, jnp.zeros_like(r), lvl_id)
             p = z
             rho = dot(r, z)
             for j in range(self.cycle_iters):
-                q = spmv(A, p)
+                with named_scope(f"amg_l{lvl_id}_kcycle_spmv"):
+                    q = spmv(A, p)
                 pq = dot(p, q)
                 alpha = jnp.where(pq != 0, rho / pq, 0.0)
                 x = x + alpha * p
@@ -296,19 +299,28 @@ class AMGSolver(Solver):
             level_params, coarse_params = params
             A, P, R, smp = level_params[lvl_id]
             if lvl_id == n_levels - 1:
-                if coarse_apply is not None:
-                    return x + coarse_apply(coarse_params, b - spmv(A, x))
-                return smooth_fns[lvl_id](smp, b, x, self.coarsest_sweeps)
+                with named_scope("amg_coarse_solve"):
+                    if coarse_apply is not None:
+                        return x + coarse_apply(
+                            coarse_params, b - spmv(A, x)
+                        )
+                    return smooth_fns[lvl_id](
+                        smp, b, x, self.coarsest_sweeps
+                    )
             pre, post = self._level_sweeps(lvl_id)
             if pre > 0:
-                x = smooth_fns[lvl_id](smp, b, x, pre)
-            r = b - spmv(A, x)
-            bc = spmv(R, r)
+                with named_scope(f"amg_l{lvl_id}_presmooth"):
+                    x = smooth_fns[lvl_id](smp, b, x, pre)
+            with named_scope(f"amg_l{lvl_id}_restrict"):
+                r = b - spmv(A, x)
+                bc = spmv(R, r)
             xc = jnp.zeros((R.n_rows * R.block_size,), dtype=b.dtype)
             xc = _v_cycle(params, bc, xc, lvl_id + 1)
-            x = x + spmv(P, xc)
+            with named_scope(f"amg_l{lvl_id}_prolong"):
+                x = x + spmv(P, xc)
             if post > 0:
-                x = smooth_fns[lvl_id](smp, b, x, post)
+                with named_scope(f"amg_l{lvl_id}_postsmooth"):
+                    x = smooth_fns[lvl_id](smp, b, x, post)
             return x
 
         return cycle
