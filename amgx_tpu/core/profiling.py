@@ -74,7 +74,6 @@ def profile_cycle(amg, b, reps: int = 3) -> LevelProfile:
     are 'level{i}/{smooth_pre,residual,restrict,prolong,smooth_post}'
     and 'coarse/solve'.
     """
-    import numpy as _np
     import jax.numpy as jnp
 
     from amgx_tpu.ops.spmv import spmv

@@ -1,0 +1,133 @@
+"""Float-float (double-single) arithmetic for 1e-8-at-scale solves.
+
+TPU has no f64 ALU; the reference's mixed-mode intent (dDFI: f64
+vectors over an f32 matrix, basic_types.h:92-117) is realized here
+with error-free transformations: a value is an unevaluated pair
+``hi + lo`` of f32 with |lo| <= ulp(hi)/2, giving ~49 effective
+mantissa bits.  Knuth two-sum and Dekker/Veltkamp two-prod need no
+FMA, so everything lowers to plain VPU adds/muls — the residual pass
+stays bandwidth-bound (same HBM bytes as f32, ~7x the flops, which a
+TPU has to spare on elementwise code).
+
+Used by :class:`amgx_tpu.solvers.refinement.IterativeRefinementSolver`:
+x is carried as a pair, the DIA residual is accumulated in ff, and an
+f32 inner solver supplies corrections — the standard iterative-
+refinement route to rtol 1e-8 on >=16M-DOF systems where plain f32
+stagnates near 1e-5 (BENCHMARKS.md round 1; VERDICT r1 weak #4).
+"""
+
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+_SPLITTER = 4097.0  # 2^12 + 1 for f32 (Veltkamp)
+
+# XLA's algebraic simplifier cancels the compensation terms of
+# error-free transformations when the whole sequence is fused into one
+# program (e.g. rewriting (a+b)-a -> b), silently degrading ff back to
+# plain f32.  optimization_barrier pins the rounded intermediates so
+# the EFT identities are computed as written; it moves no data.
+
+
+def two_sum(a, b):
+    """s + e == a + b exactly (Knuth)."""
+    s = lax.optimization_barrier(a + b)
+    bb = lax.optimization_barrier(s - a)
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def _split(a):
+    # pre-scale huge inputs so the 4097*a product cannot overflow
+    # (|a| >= f32_max/4097 would make c = inf -> NaN hi)
+    big = jnp.abs(a) > 1e34
+    a2 = jnp.where(big, a * jnp.asarray(2.0**-16, a.dtype), a)
+    c = lax.optimization_barrier(_SPLITTER * a2)
+    hi = lax.optimization_barrier(c - (c - a2))
+    lo = a2 - hi
+    up = jnp.asarray(2.0**16, a.dtype)
+    return (
+        jnp.where(big, hi * up, hi),
+        jnp.where(big, lo * up, lo),
+    )
+
+
+def two_prod(a, b):
+    """p + e == a * b exactly (Dekker, no FMA)."""
+    p = lax.optimization_barrier(a * b)
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+def ff(hi, lo=None):
+    """Pair constructor (lo defaults to 0)."""
+    return (hi, jnp.zeros_like(hi) if lo is None else lo)
+
+
+def renorm(hi, lo):
+    s, e = two_sum(hi, lo)
+    return s, e
+
+
+def ff_add(x, y):
+    """(hi,lo) + (hi,lo)."""
+    s, e = two_sum(x[0], y[0])
+    e = e + (x[1] + y[1])
+    return renorm(s, e)
+
+
+def ff_add_f(x, a):
+    """(hi,lo) + f32."""
+    s, e = two_sum(x[0], a)
+    return renorm(s, e + x[1])
+
+
+def ff_neg(x):
+    return (-x[0], -x[1])
+
+
+def ff_to_f(x):
+    return x[0] + x[1]
+
+
+def ff_residual_dia(A, b_ff, x_ff):
+    """r = b - A x with ff accumulation for DIA matrices.
+
+    A is a SparseMatrix with dia structure (f32 values); b_ff/x_ff are
+    pairs.  Error per element is O(eps^2 * w * |A||x|) — resolves
+    residuals at rtol 1e-12-ish, far below the 1e-8 target.
+    """
+    n = A.n_rows
+    offs = A.dia_offsets
+    pneg = max(0, -min(offs))
+    ppos = max(0, max(offs))
+    xh = jnp.pad(x_ff[0], (pneg, ppos))
+    xl = jnp.pad(x_ff[1], (pneg, ppos))
+    hi, lo = b_ff[0], b_ff[1]
+    import jax.lax as lax
+
+    for k, off in enumerate(offs):
+        sh = lax.slice(xh, (off + pneg,), (off + pneg + n,))
+        sl = lax.slice(xl, (off + pneg,), (off + pneg + n,))
+        d = A.dia_vals[k]
+        p, pe = two_prod(d, sh)
+        # subtract the exact product and the low-order terms
+        hi, e = two_sum(hi, -p)
+        lo = lo + e - pe - d * sl
+    return renorm(hi, lo)
+
+
+def ff_residual(A, b_ff, x_ff):
+    """r = b - A x as an ff pair; DIA matrices get full ff
+    accumulation, other formats accumulate the dominant terms only
+    (x_lo contribution exact, per-product errors dropped)."""
+    from amgx_tpu.ops.spmv import spmv
+
+    if A.has_dia and A.block_size == 1:
+        return ff_residual_dia(A, b_ff, x_ff)
+    hi, e = two_sum(b_ff[0], -spmv(A, x_ff[0]))
+    lo = b_ff[1] + e - spmv(A, x_ff[1])
+    return renorm(hi, lo)

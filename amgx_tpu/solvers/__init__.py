@@ -32,6 +32,7 @@ from amgx_tpu.solvers import (  # noqa: F401
     kaczmarz,
     krylov,
     polynomial,
+    refinement,
 )
 
 __all__ = [

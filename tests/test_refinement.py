@@ -1,0 +1,100 @@
+"""Float-float arithmetic + iterative refinement tests (the TPU
+1e-8-at-scale story; reference dDFI mixed-mode intent
+basic_types.h:92-117, VERDICT r1 weak #4)."""
+
+import numpy as np
+import pytest
+
+import amgx_tpu
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.io.poisson import poisson_3d_7pt, poisson_rhs
+from amgx_tpu.solvers import create_solver
+
+amgx_tpu.initialize()
+
+
+def test_two_sum_two_prod_exact():
+    import jax.numpy as jnp
+
+    from amgx_tpu.ops.ff import two_prod, two_sum
+
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.standard_normal(1000), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(1000) * 1e-4, jnp.float32)
+    s, e = two_sum(a, b)
+    exact = np.asarray(a, np.float64) + np.asarray(b, np.float64)
+    got = np.asarray(s, np.float64) + np.asarray(e, np.float64)
+    np.testing.assert_array_equal(got, exact)
+    p, pe = two_prod(a, b)
+    exactp = np.asarray(a, np.float64) * np.asarray(b, np.float64)
+    gotp = np.asarray(p, np.float64) + np.asarray(pe, np.float64)
+    np.testing.assert_allclose(gotp, exactp, rtol=1e-14)
+
+
+def test_ff_residual_dia_accuracy():
+    """ff residual resolves what plain f32 cannot."""
+    import jax.numpy as jnp
+
+    from amgx_tpu.ops.ff import ff, ff_residual
+    from amgx_tpu.ops.spmv import spmv
+
+    A = poisson_3d_7pt(16, dtype=np.float32)
+    n = A.n_rows
+    Asp = A.to_scipy().astype(np.float64)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(n).astype(np.float32)
+    b = (Asp @ np.asarray(x, np.float64)).astype(np.float32)
+    # true residual of the f32-rounded data, computed in f64
+    r64 = np.asarray(b, np.float64) - Asp @ np.asarray(x, np.float64)
+    rh, rl = ff_residual(A, ff(jnp.asarray(b)), ff(jnp.asarray(x)))
+    r_ff = np.asarray(rh, np.float64) + np.asarray(rl, np.float64)
+    r_f32 = np.asarray(
+        jnp.asarray(b) - spmv(A, jnp.asarray(x)), np.float64
+    )
+    err_ff = np.linalg.norm(r_ff - r64)
+    err_f32 = np.linalg.norm(r_f32 - r64)
+    assert err_ff < err_f32 / 50, (err_ff, err_f32)
+
+
+def test_iterative_refinement_beats_f32_stagnation():
+    """f32-only device arithmetic reaches true rtol < 2e-8 where plain
+    f32 PCG-AMG self-reports success at a drifted residual."""
+    A = poisson_3d_7pt(32, dtype=np.float32)
+    n = A.n_rows
+    b = poisson_rhs(n, dtype=np.float32)
+    b64 = np.asarray(b, np.float64)
+    Asp64 = A.to_scipy().astype(np.float64)
+
+    inner = (
+        '"preconditioner": {"scope": "inner", "solver": "PCG",'
+        ' "max_iters": 60, "tolerance": 1e-4, "monitor_residual": 1,'
+        ' "convergence": "RELATIVE_INI",'
+        ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+        ' "algorithm": "AGGREGATION", "selector": "SIZE_8",'
+        ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+        ' "relaxation_factor": 0.8}, "max_iters": 1, "cycle": "V",'
+        ' "min_coarse_rows": 64, "coarse_solver": "DENSE_LU_SOLVER"}}'
+    )
+    cfg = AMGConfig.from_string(
+        '{"config_version":2,"solver":{"scope":"main",'
+        '"solver":"ITERATIVE_REFINEMENT","max_iters":12,'
+        '"tolerance":1e-8,"monitor_residual":1,' + inner + "}}"
+    )
+    s = create_solver(cfg, "default")
+    s.setup(A)
+    res = s.solve(b)
+    assert res.x.dtype == np.float64  # pair combined on host
+    rel = np.linalg.norm(
+        b64 - Asp64 @ np.asarray(res.x)
+    ) / np.linalg.norm(b64)
+    assert rel < 2e-8, rel
+    assert int(res.iters) <= 5
+
+
+def test_refinement_requires_inner_solver():
+    cfg = AMGConfig.from_string(
+        '{"config_version":2,"solver":{"scope":"main",'
+        '"solver":"ITERATIVE_REFINEMENT"}}'
+    )
+    with pytest.raises(Exception):
+        create_solver(cfg, "default")
