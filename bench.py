@@ -1,17 +1,21 @@
-"""Benchmark: SpMV GFLOPS/chip on the 3D Poisson-7pt operator
-(BASELINE.json "metric": SpMV GFLOPS/chip).
+"""Benchmark: SpMV GFLOPS/chip + roofline accounting + solve record.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Diagnostics go to stderr.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The headline metric stays spmv_gflops_per_chip (BASELINE.json
+"metric"); extra keys give the bytes-moved model (achieved fraction of
+HBM bandwidth), an honest unstructured (gather-path) SpMV number, and
+one full AMG-PCG solve (setup/solve/per-iteration — the amgx_capi
+output contract, BASELINE.md:13).  Diagnostics go to stderr.
 
-Methodology: dependent SpMV chains x_{k+1} = 0.125*A x_k + x_0 (bounded,
-no reductions) of two lengths; GFLOPS from the MARGINAL per-iteration
-cost so fixed dispatch/tunnel overhead (~170 ms on the axon remote
-backend) does not contaminate the kernel number.
+Methodology: dependent SpMV chains x_{k+1} = 0.125*A x_k + x_0 of two
+lengths; the MARGINAL per-iteration cost removes fixed dispatch/tunnel
+overhead (~170 ms on the axon remote backend, whose block_until_ready
+is advisory — hence jax.device_get round-trips on fresh inputs).
 
 vs_baseline: ratio against a nominal A100 CSR-SpMV throughput of 200
 GFLOPS fp32 (memory-bound estimate at ~2 TB/s HBM, ~8 bytes/nnz,
-cuSPARSE-class; the reference publishes no in-repo numbers, BASELINE.md).
+cuSPARSE-class; the reference publishes no in-repo numbers,
+BASELINE.md).
 """
 
 import json
@@ -22,10 +26,28 @@ import numpy as np
 
 A100_SPMV_GFLOPS_F32 = 200.0
 
+# HBM bandwidth by TPU generation (GB/s): roofline denominator.
+_HBM_GBPS = {
+    "v5e": 819.0,
+    "v5litepod": 819.0,
+    "v5p": 2765.0,
+    "v4": 1228.0,
+    "v6e": 1640.0,
+}
+_DEFAULT_HBM_GBPS = 819.0  # the axon tunnel slice is v5e-class
+
+
+def _hbm_bandwidth(dev) -> float:
+    kind = getattr(dev, "device_kind", "") or ""
+    k = kind.lower().replace(" ", "")
+    for key, bw in _HBM_GBPS.items():
+        if key in k:
+            return bw * 1e9
+    return _DEFAULT_HBM_GBPS * 1e9
+
 
 def _chain(iters):
     import jax
-    import jax.numpy as jnp
 
     from amgx_tpu.ops.spmv import spmv
 
@@ -54,31 +76,13 @@ def _time_chain(fn, A, n, rng, reps=3):
     return best
 
 
-def main():
-    import jax
-
-    from amgx_tpu.io.poisson import poisson_3d_7pt
-
-    dev = jax.devices()[0]
-    n_side = 96 if dev.platform != "cpu" else 48
-    A = poisson_3d_7pt(n_side, dtype=np.float32)
+def _marginal_spmv_seconds(A, rng, label):
+    """Marginal per-SpMV seconds with artifact retries (tunnel caching
+    can report near-zero marginals; floor = 2 bytes/nnz at 2 TB/s)."""
     n, nnz = A.n_rows, A.nnz
-    print(
-        f"bench: device={dev}, poisson {n_side}^3 f32, "
-        f"format={'DIA' if A.has_dia else ('ELL' if A.has_ell else 'CSR')}",
-        file=sys.stderr,
-    )
-
-    rng = np.random.default_rng(0)
     n1, n2 = 20, 120
-    # physical floor: ~2 bytes/nnz at 2 TB/s — generous enough for any
-    # real chip (a v5p DIA SpMV still moves >=4 bytes/nnz), but orders of
-    # magnitude above the axon tunnel's async-caching artifacts (which
-    # report near-zero marginals).  Retry on artifacts; fall back to the
-    # overhead-inclusive bound validated across attempts.
     floor = 2.0 * nnz / 2e12
-    chain1, chain2 = _chain(n1), _chain(n2)  # compile once
-    per_iter = None
+    chain1, chain2 = _chain(n1), _chain(n2)
     t2_samples = []
     for attempt in range(5):
         t1 = _time_chain(chain1, A, n, rng)
@@ -86,20 +90,141 @@ def main():
         t2_samples.append(t2)
         cand = (t2 - t1) / (n2 - n1)
         print(
-            f"bench[{attempt}]: chains {n1}:{t1*1e3:.1f}ms "
+            f"bench[{label}][{attempt}]: chains {n1}:{t1*1e3:.1f}ms "
             f"{n2}:{t2*1e3:.1f}ms -> {cand*1e3:.3f} ms/SpMV",
             file=sys.stderr,
         )
         if cand >= floor:
-            per_iter = cand
-            break
-    if per_iter is None:
-        # conservative, overhead-inclusive; median across attempts so a
-        # single artifacted sample cannot set the number
-        per_iter = max(float(np.median(t2_samples)) / n2, floor)
-        print("bench: marginal timing unstable; using total-time bound",
-              file=sys.stderr)
+            return cand
+    print(
+        f"bench[{label}]: marginal timing unstable; total-time bound",
+        file=sys.stderr,
+    )
+    return max(float(np.median(t2_samples)) / n2, floor)
+
+
+def _dia_bytes(A):
+    """HBM bytes one DIA SpMV must move: the diagonal value array once,
+    x read once, y written once (f32)."""
+    nd = len(A.dia_offsets)
+    return 4.0 * A.n_rows * (nd + 2)
+
+
+def _ell_bytes(A):
+    """ELL/gather lower-bound bytes: padded values + column ids + x + y
+    (gather traffic counted once — the honest lower bound; random
+    access can re-fetch lines many times)."""
+    if A.ell_cols is not None:
+        w = A.ell_cols.shape[1]
+        return 4.0 * A.n_rows * (2 * w + 2)
+    return 8.0 * A.nnz + 8.0 * A.n_rows
+
+
+def _solve_record(n_side):
+    """One full AMG-PCG solve: setup/solve/per-iter wall (the
+    amgx_capi output contract)."""
+    import jax
+
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.io.poisson import poisson_3d_7pt, poisson_rhs
+    from amgx_tpu.solvers import create_solver
+
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "max_iters": 100, "tolerance": 1e-6,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+        ' "algorithm": "AGGREGATION", "selector": "SIZE_8",'
+        ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+        ' "relaxation_factor": 0.8, "monitor_residual": 0},'
+        ' "presweeps": 1, "postsweeps": 1, "max_iters": 1,'
+        ' "min_coarse_rows": 512, "max_levels": 20,'
+        ' "coarse_solver": "DENSE_LU_SOLVER", "cycle": "V",'
+        ' "monitor_residual": 0}}}'
+    )
+    A = poisson_3d_7pt(n_side, dtype=np.float32)
+    b = poisson_rhs(A.n_rows, dtype=np.float32)
+    t0 = time.perf_counter()
+    s = create_solver(cfg, "default")
+    s.setup(A)
+    setup_s = time.perf_counter() - t0
+    res = s.solve(b)  # warm-up (compile)
+    t0 = time.perf_counter()
+    res = s.solve(b)
+    jax.device_get(res.x)
+    solve_s = time.perf_counter() - t0
+    iters = int(res.iters)
+    fmts = [
+        "DIA" if l.A.has_dia else
+        ("dense" if l.A.has_dense else ("ELL" if l.A.has_ell else "CSR"))
+        for l in s.precond.levels
+    ] if hasattr(s, "precond") else []
+    return {
+        "problem": f"poisson7_{n_side}^3_f32",
+        "config": "PCG+AMG(SIZE_8,V,Jacobi)",
+        "setup_s": round(setup_s, 4),
+        "solve_s": round(solve_s, 4),
+        "iterations": iters,
+        "per_iteration_s": round(solve_s / max(iters, 1), 5),
+        "level_formats": fmts,
+    }
+
+
+def main():
+    import amgx_tpu
+
+    amgx_tpu.initialize()  # honors a JAX_PLATFORMS env pin
+    import jax
+
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.io.poisson import poisson_3d_7pt
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    n_side = 96 if on_tpu else 32
+    hbm = _hbm_bandwidth(dev)
+    rng = np.random.default_rng(0)
+
+    # ---- structured (DIA) SpMV + roofline --------------------------
+    A = poisson_3d_7pt(n_side, dtype=np.float32)
+    n, nnz = A.n_rows, A.nnz
+    print(
+        f"bench: device={dev} ({getattr(dev, 'device_kind', '?')}), "
+        f"poisson {n_side}^3 f32, "
+        f"format={'DIA' if A.has_dia else 'other'}, "
+        f"hbm_model={hbm/1e9:.0f} GB/s",
+        file=sys.stderr,
+    )
+    per_iter = _marginal_spmv_seconds(A, rng, "dia")
     gflops = 2.0 * nnz / per_iter / 1e9
+    dia_bw = _dia_bytes(A) / per_iter
+    dia_frac = dia_bw / hbm
+
+    # ---- unstructured (gather-path) SpMV ---------------------------
+    # randomly permuted Poisson: same spectrum/nnz, zero banded
+    # structure -> ELL/Pallas path (build_ell picks it up)
+    sp = poisson_3d_7pt(
+        48 if on_tpu else 24, dtype=np.float32
+    ).to_scipy().tocsr()
+    pn = sp.shape[0]
+    p2 = rng.permutation(pn)
+    spu = sp[p2][:, p2].tocsr()
+    Au = SparseMatrix.from_scipy(spu)
+    fmt_u = (
+        "DIA" if Au.has_dia else
+        ("dense" if Au.has_dense else
+         ("ELL+pallas" if Au.ell_tcols is not None else
+          ("ELL" if Au.has_ell else "CSR")))
+    )
+    print(f"bench: unstructured format={fmt_u}", file=sys.stderr)
+    per_iter_u = _marginal_spmv_seconds(Au, rng, "unstructured")
+    gflops_u = 2.0 * Au.nnz / per_iter_u / 1e9
+    ell_bw = _ell_bytes(Au) / per_iter_u
+
+    # ---- one full solve --------------------------------------------
+    solve_rec = _solve_record(128 if on_tpu else 24)
+    print(f"bench: solve {solve_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -107,6 +232,13 @@ def main():
                 "value": round(gflops, 2),
                 "unit": "GFLOPS",
                 "vs_baseline": round(gflops / A100_SPMV_GFLOPS_F32, 3),
+                "dia_bytes_per_s": round(dia_bw / 1e9, 1),
+                "dia_fraction_of_hbm": round(dia_frac, 3),
+                "hbm_model_gbps": round(hbm / 1e9, 0),
+                "unstructured_gflops": round(gflops_u, 2),
+                "unstructured_format": fmt_u,
+                "unstructured_bytes_per_s_lb": round(ell_bw / 1e9, 1),
+                "solve": solve_rec,
             }
         )
     )

@@ -1,8 +1,11 @@
 #!/usr/bin/env bash
 # CI entry point (reference ci/test.sh runs amgx_tests_launcher).
-# Runs the full suite on the 8-device virtual CPU mesh, then the bench
-# smoke on whatever backend is available.
+# Runs the full suite on the 8-device virtual CPU mesh (including the
+# slow 62-config acceptance sweep), refreshes the acceptance table,
+# then the bench smoke on whatever backend is available.
 set -e
 cd "$(dirname "$0")/.."
 python -m pytest tests/ -q
+python -m pytest tests/ -q -m slow
+python ci/acceptance.py
 python bench.py
