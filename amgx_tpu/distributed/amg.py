@@ -158,7 +158,8 @@ class DistributedAMG:
 
         def smooth(l, lp, r_l, z, sweeps):
             sh = lp[0]
-            dinv = jnp.where(sh[2] != 0, 1.0 / sh[2], 1.0)
+            d = sh["diag"]
+            dinv = jnp.where(d != 0, 1.0 / d, 1.0)
             om = jnp.asarray(omega, r_l.dtype)
             for i in range(sweeps):
                 rr = r_l if (i == 0 and z is None) else (

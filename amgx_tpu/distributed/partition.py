@@ -63,6 +63,13 @@ class DistributedMatrix:
     halo_src_pos: Optional[np.ndarray] = None  # [N, max_halo] int32
     max_send: int = 0
     max_halo: int = 0
+    # interior/boundary split (latency hiding, reference
+    # multiply.cu:95-110): interior rows reference no halo columns, so
+    # their partial product depends only on x_loc and overlaps with the
+    # in-flight halo exchange.  Row masks only — the SpMV applies them
+    # to the shared ELL arrays (no second operator copy, no scatter).
+    int_mask: Optional[np.ndarray] = None  # [N, rows] bool
+    own_mask: Optional[np.ndarray] = None  # [N, rows] bool (non-pad)
     # row ownership: owner[i] = part owning global row i;
     # local_of[i] = its local slot — identity layout for contiguous
     # partitions (owner = i // rows_per_part).
@@ -219,7 +226,8 @@ def localize_columns(indptr, gcols, vals, owner, local_of, p, rows_pp):
 
 
 def finalize_partition(
-    parts, owner, local_of, counts, n, n_parts, proc_grid=None
+    parts, owner, local_of, counts, n, n_parts, proc_grid=None,
+    split=True,
 ):
     """Build the exchange plan + stacked device arrays from per-shard
     localized CSRs (the output of localize_columns)."""
@@ -315,6 +323,16 @@ def finalize_partition(
         dmask = cols == row_ids
         diag[p, row_ids[dmask]] = vals[dmask]
 
+    # ---- interior/boundary split masks (latency hiding) -------------
+    # rows whose every stored column is local (< rows_pp) are interior
+    int_mask = own_mask = None
+    if split:
+        is_bnd = (ell_cols >= rows_pp).any(axis=2)  # [N, rows]
+        own_mask = np.zeros((n_parts, rows_pp), dtype=bool)
+        for p in range(n_parts):
+            own_mask[p, : counts[p]] = True
+        int_mask = own_mask & ~is_bnd
+
     return DistributedMatrix(
         n_global=n,
         n_parts=n_parts,
@@ -322,6 +340,8 @@ def finalize_partition(
         ell_cols=ell_cols,
         ell_vals=ell_vals,
         diag=diag,
+        int_mask=int_mask,
+        own_mask=own_mask,
         perms=None if dm is None else dm["perms"],
         send_idx_d=None if dm is None else dm["send_idx_d"],
         halo_dir=None if dm is None else dm["halo_dir"],

@@ -22,51 +22,80 @@ from amgx_tpu.distributed.partition import DistributedMatrix
 
 
 def _shard_params(A: DistributedMatrix):
-    """Traced per-shard arrays, stacked on the shard axis: the local ELL
-    operator plus halo-exchange maps."""
-    base = (
-        jnp.asarray(A.ell_cols),
-        jnp.asarray(A.ell_vals),
-        jnp.asarray(A.diag),
-    )
+    """Traced per-shard arrays, stacked on the shard axis: the local
+    operator (interior/boundary split when built) plus halo-exchange
+    maps, as a dict pytree."""
+    out = {
+        "diag": jnp.asarray(A.diag),
+        "ell": (jnp.asarray(A.ell_cols), jnp.asarray(A.ell_vals)),
+    }
+    if A.int_mask is not None:
+        out["split"] = (
+            jnp.asarray(A.int_mask),
+            jnp.asarray(A.own_mask),
+        )
     if A.uses_ppermute:
-        ex = (
+        out["ex"] = (
             tuple(jnp.asarray(s) for s in A.send_idx_d),
             jnp.asarray(A.halo_dir),
             jnp.asarray(A.halo_pos),
         )
     else:
-        ex = (
+        out["ex"] = (
             jnp.asarray(A.send_idx),
             jnp.asarray(A.halo_src_part),
             jnp.asarray(A.halo_src_pos),
         )
-    return base + ex
+    return out
 
 
 def exchange_halo(A: DistributedMatrix, shard, x_loc, axis):
     """halo values for x (reference exchange_halo_v2).  Runs inside
-    shard_map; `shard` is the _shard_params tuple with the leading
+    shard_map; `shard` is the _shard_params dict with the leading
     shard axis dropped."""
     if A.uses_ppermute:
-        send_idx_d, halo_dir, halo_pos = shard[3], shard[4], shard[5]
+        send_idx_d, halo_dir, halo_pos = shard["ex"]
         halo = jnp.zeros((halo_pos.shape[0],), x_loc.dtype)
         for d, perm in enumerate(A.perms):
             buf = x_loc[send_idx_d[d]]
             recv = jax.lax.ppermute(buf, axis, perm=list(perm))
             halo = jnp.where(halo_dir == d, recv[halo_pos], halo)
         return halo
-    send_idx, hsp, hpos = shard[3], shard[4], shard[5]
+    send_idx, hsp, hpos = shard["ex"]
     send = x_loc[send_idx]  # B2L gather
     pool = jax.lax.all_gather(send, axis)  # [N, max_send]
     return pool[hsp, hpos]
 
 
 def make_local_spmv(A: DistributedMatrix, axis):
-    """Shard-local y = (A x)_loc with halo exchange over `axis`."""
+    """Shard-local y = (A x)_loc with halo exchange over `axis`.
+
+    Latency hiding (reference multiply.cu:95-110
+    exchange_halo_split_gather -> interior -> boundary): the interior
+    partial product reads only x_loc, so it carries no data dependence
+    on the permute results — XLA's latency-hiding scheduler overlaps
+    it with the in-flight exchange."""
 
     def spmv(shard, x_loc):
-        ell_cols, ell_vals = shard[0], shard[1]
+        ell_cols, ell_vals = shard["ell"]
+        if "split" in shard:
+            int_mask, own_mask = shard["split"]
+            halo = exchange_halo(A, shard, x_loc, axis)
+            # interior pass: columns clamped into the local range (the
+            # clamp only touches boundary rows, which the mask zeroes)
+            # — no dependence on the permute results, so it overlaps
+            nloc = x_loc.shape[0]
+            lc = jnp.minimum(ell_cols, nloc - 1)
+            yi = jnp.where(
+                int_mask, jnp.sum(ell_vals * x_loc[lc], axis=-1), 0
+            )
+            xf = jnp.concatenate([x_loc, halo])
+            yb = jnp.where(
+                own_mask & ~int_mask,
+                jnp.sum(ell_vals * xf[ell_cols], axis=-1),
+                0,
+            )
+            return yi + yb
         halo = exchange_halo(A, shard, x_loc, axis)
         xf = jnp.concatenate([x_loc, halo])
         return jnp.sum(ell_vals * xf[ell_cols], axis=1)
@@ -85,7 +114,7 @@ def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
     local_spmv = make_local_spmv(A, axis)
 
     def local_solve(sh, b_loc):
-        diag = sh[2]
+        diag = sh["diag"]
         dinv = jnp.where(diag != 0, 1.0 / diag, 1.0)
         x = jnp.zeros_like(b_loc)
         r = b_loc  # x0 = 0
