@@ -1030,14 +1030,23 @@ def read_system(mtx_h: int, rhs_h: int, sol_h: int, filename: str):
 
 @_traced
 def write_system(mtx_h: int, rhs_h: int, sol_h: int, filename: str):
-    from amgx_tpu.io.matrix_market import write_system as _write
+    """Writes MatrixMarket+%%AMGX text, or the reference's
+    %%NVAMGBinary format when the filename ends in '.bin'
+    (matrix_io.cu:286-334); read_system auto-detects either."""
+    from amgx_tpu.io.matrix_market import (
+        write_system as _write,
+        write_system_binary as _write_bin,
+    )
 
     m = _get(mtx_h, _Matrix)
     if m.A is None:
         raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
     rhs = _objects.get(rhs_h).data if rhs_h in _objects else None
     sol = _objects.get(sol_h).data if sol_h in _objects else None
-    _write(filename, m.A, rhs=rhs, sol=sol)
+    if filename.endswith(".bin"):
+        _write_bin(filename, m.A, rhs=rhs, sol=sol)
+    else:
+        _write(filename, m.A, rhs=rhs, sol=sol)
     return RC_OK
 
 

@@ -48,13 +48,124 @@ def _tokens_to_floats(body_lines):
     return np.array(blob.split(), dtype=np.float64)
 
 
+_NVAMG_BIN_HEADER = b"%%NVAMGBinary\n"
+
+
+def _read_system_binary(path):
+    """%%NVAMGBinary reader (reference matrix_io.cu:286-334 writer
+    layout): header + 9 uint32 system flags, then CSR int32 offsets and
+    columns and f64 values (external diagonal appended), then optional
+    f64 rhs/solution."""
+    def _take(f, dtype, count, what):
+        a = np.fromfile(f, dtype, count)
+        if a.shape[0] != count:
+            raise MatrixIOError(
+                f"truncated %%NVAMGBinary file: {what} "
+                f"({a.shape[0]}/{count} read)"
+            )
+        return a
+
+    with open(path, "rb") as f:
+        hdr = f.read(len(_NVAMG_BIN_HEADER))
+        if hdr != _NVAMG_BIN_HEADER:
+            raise MatrixIOError("not a %%NVAMGBinary file")
+        flags = _take(f, np.uint32, 9, "system flags")
+        (is_mtx, is_rhs, is_soln, mfmt, has_diag, bdx, bdy, n, nnz) = (
+            int(v) for v in flags
+        )
+        if not is_mtx:
+            raise MatrixIOError("binary file carries no matrix")
+        if mfmt != 0:
+            raise MatrixIOError(
+                f"unsupported binary matrix format {mfmt} "
+                "(CSR real only, matching the reference writer)"
+            )
+        bsz = bdx * bdy
+        row_offsets = _take(f, np.int32, n + 1, "row offsets")
+        cols = _take(f, np.int32, nnz, "column indices")
+        nval = bsz * (nnz + (n if has_diag else 0))
+        vals = _take(f, np.float64, nval, "values")
+        # vector lengths follow the reference writer's checks
+        # (matrix_io.cu:363,381: rhs n*block_dimy, solution n*block_dimx)
+        rhs = (
+            _take(f, np.float64, n * bdy, "rhs") if is_rhs else None
+        )
+        sol = (
+            _take(f, np.float64, n * bdx, "solution")
+            if is_soln
+            else None
+        )
+    rows = np.repeat(
+        np.arange(n, dtype=np.int64), np.diff(row_offsets)
+    )
+    cols = cols.astype(np.int64)
+    vals = vals.reshape(-1, bsz) if bsz > 1 else vals
+    if has_diag:
+        # trailing n diagonal blocks follow the nnz entry values
+        drows = np.arange(n, dtype=np.int64)
+        rows = np.concatenate([rows, drows])
+        cols = np.concatenate([cols, drows])
+    A = dict(
+        rows=rows,
+        cols=cols,
+        vals=vals,
+        n_rows=n,
+        n_cols=n,
+        block_dims=(bdx, bdy),
+    )
+    return A, rhs, sol
+
+
+def write_system_binary(path, A: SparseMatrix, rhs=None, sol=None):
+    """%%NVAMGBinary writer (reference matrix_io.cu:286-334).  Real
+    CSR only — the format encodes f64 values."""
+    b = A.block_size
+    if np.iscomplexobj(np.asarray(A.values)) or any(
+        v is not None and np.iscomplexobj(np.asarray(v))
+        for v in (rhs, sol)
+    ):
+        raise MatrixIOError(
+            "%%NVAMGBinary encodes real values only; write complex "
+            "systems as MatrixMarket text"
+        )
+    data = np.asarray(A.values, np.float64)
+    flags = np.array(
+        [
+            1,
+            int(rhs is not None),
+            int(sol is not None),
+            0,  # CSR
+            0,  # no external diagonal (entries carry it)
+            b,
+            b,
+            A.n_rows,
+            A.nnz,
+        ],
+        dtype=np.uint32,
+    )
+    with open(path, "wb") as f:
+        f.write(_NVAMG_BIN_HEADER)
+        flags.tofile(f)
+        np.asarray(A.row_offsets, np.int32).tofile(f)
+        np.asarray(A.col_indices, np.int32).tofile(f)
+        data.reshape(-1).tofile(f)
+        if rhs is not None:
+            np.asarray(rhs, np.float64).reshape(-1).tofile(f)
+        if sol is not None:
+            np.asarray(sol, np.float64).reshape(-1).tofile(f)
+
+
 def read_system(path):
     """Read matrix (+ optional external diagonal / rhs / solution).
 
     Returns (A_dict, rhs, sol) where A_dict has keys rows, cols, vals,
     n_rows, n_cols, block_dims.  Complex fields keep full complex values
-    everywhere (entries, diagonal, rhs, solution).
+    everywhere (entries, diagonal, rhs, solution).  %%NVAMGBinary files
+    are auto-detected.
     """
+    with open(path, "rb") as fb:
+        if fb.read(len(_NVAMG_BIN_HEADER)) == _NVAMG_BIN_HEADER:
+            return _read_system_binary(path)
     with open(path) as f:
         lines = f.read().splitlines()
     field, sym, flags, i = _parse_header(lines)

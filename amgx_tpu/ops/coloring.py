@@ -3,15 +3,22 @@
 The reference ships ten coloring schemes (core.cu:669-678) because CUDA
 smoother kernels launch one kernel per color.  On TPU the same structure
 drives masked color-sweeps, so what matters is (a) a valid distance-1
-coloring, (b) determinism, (c) few colors.  We implement:
+coloring, (b) determinism, (c) few colors, and (d) for downwind-aware
+smoothing, a color order that follows the flow.  Implemented:
 
-  * GREEDY / SERIAL_GREEDY_BFS: deterministic natural-order greedy
-    (host-side, scipy graph) — the determinism_flag path.
-  * MIN_MAX: hash-based parallel-style MIS coloring (deterministic given
-    the hash), matching the reference default's structure.
-
-All other reference scheme names alias onto these two (they differ only
-in GPU-kernel trade-offs that do not exist here).
+  * GREEDY / SERIAL_GREEDY_BFS / GREEDY_RECOLOR: deterministic
+    natural-order greedy — the determinism_flag path.
+  * MIN_MAX / PARALLEL_GREEDY / MULTI_HASH / ROUND_ROBIN: hash-based
+    parallel-style MIS coloring (min_max.cu structure).
+  * MIN_MAX_2RING / GREEDY_MIN_MAX_2RING: the same algorithms on the
+    distance-2 (squared) graph — same-color rows are then independent
+    in A^2, which ILU(1)-class factorizations need.
+  * LOCALLY_DOWNWIND: greedy coloring in downwind topological order
+    (locally_downwind.cu semantics: the directed graph of dominant
+    couplings |a_ij| > |a_ji| orders the sweep along the flow; greedy
+    on that order keeps the coloring valid).
+  * UNIFORM: index mod (bandwidth+1) — the reference's cheap scheme,
+    valid for banded matrices, greedy fallback otherwise.
 """
 
 from __future__ import annotations
@@ -19,10 +26,12 @@ from __future__ import annotations
 import numpy as np
 
 
-def greedy_coloring(indptr, indices, n) -> np.ndarray:
-    """Natural-order greedy distance-1 coloring; deterministic."""
+def greedy_coloring(indptr, indices, n, order=None) -> np.ndarray:
+    """Greedy distance-1 coloring in the given vertex order
+    (natural order by default); deterministic."""
     colors = np.full(n, -1, dtype=np.int32)
-    for i in range(n):
+    seq = range(n) if order is None else order
+    for i in seq:
         neigh = indices[indptr[i] : indptr[i + 1]]
         used = set(colors[neigh[neigh < n]].tolist())
         c = 0
@@ -30,6 +39,53 @@ def greedy_coloring(indptr, indices, n) -> np.ndarray:
             c += 1
         colors[i] = c
     return colors
+
+
+def _two_ring_graph(indptr, indices, n):
+    """Pattern of A + A^2 (distance-2 adjacency) as CSR arrays."""
+    import scipy.sparse as sps
+
+    # int64 counts: path counts through common neighbors can exceed
+    # small-int ranges and a wrapped-to-zero count would silently drop
+    # a distance-2 edge
+    S = sps.csr_matrix(
+        (np.ones(len(indices), dtype=np.int64), indices.copy(),
+         indptr.copy()), shape=(n, max(int(indices.max()) + 1, n)),
+    )[:, :n]
+    S2 = ((S + S @ S) != 0).astype(np.int8).tocsr()
+    S2.setdiag(0)
+    S2.eliminate_zeros()
+    return S2.indptr, S2.indices
+
+
+def downwind_order(indptr, indices, vals, n) -> np.ndarray:
+    """Topological-ish vertex order along the flow: a dominant entry
+    |a_ij| > |a_ji| means j is UPSTREAM of i (upwind discretizations
+    couple strongly to the upstream neighbor), so i's level exceeds
+    j's and upstream vertices are ordered first (cycles broken by the
+    bounded fixpoint + index tie-break)."""
+    import scipy.sparse as sps
+
+    row_ids = np.repeat(np.arange(n), np.diff(indptr))
+    off = indices != row_ids
+    r, c, v = row_ids[off], indices[off], np.abs(vals[off])
+    Aabs = sps.csr_matrix((v, (r, c)), shape=(n, n))
+    At = Aabs.T.tocsr()
+    # a dominant |a_ij| > |a_ji| means j is UPSTREAM of i (upwind
+    # discretizations couple strongly to the upstream neighbor), so the
+    # level propagates from column to row
+    coo = Aabs.tocoo()
+    back = np.asarray(At[coo.row, coo.col]).ravel()
+    down = coo.data > back
+    dr, dc = coo.row[down], coo.col[down]
+    level = np.zeros(n, dtype=np.int64)
+    for _ in range(64):  # bounded fixpoint (cycles cap the sweep)
+        new = level.copy()
+        np.maximum.at(new, dr, level[dc] + 1)
+        if (new == level).all():
+            break
+        level = new
+    return np.lexsort((np.arange(n), level))
 
 
 def min_max_coloring(indptr, indices, n, max_rounds=64, seed=0) -> np.ndarray:
@@ -83,17 +139,20 @@ def _compact_colors(colors):
 
 _SCHEME_ALIASES = {
     "MIN_MAX": "MIN_MAX",
-    "MIN_MAX_2RING": "MIN_MAX",
-    "GREEDY_MIN_MAX_2RING": "MIN_MAX",
+    "MIN_MAX_2RING": "MIN_MAX_2RING",
+    "GREEDY_MIN_MAX_2RING": "GREEDY_2RING",
     "PARALLEL_GREEDY": "MIN_MAX",
     "ROUND_ROBIN": "MIN_MAX",
     "MULTI_HASH": "MIN_MAX",
-    "UNIFORM": "MIN_MAX",
+    "UNIFORM": "UNIFORM",
     "SERIAL_GREEDY_BFS": "GREEDY",
     "GREEDY_RECOLOR": "GREEDY",
-    "LOCALLY_DOWNWIND": "GREEDY",
+    "LOCALLY_DOWNWIND": "LOCALLY_DOWNWIND",
     "GREEDY": "GREEDY",
 }
+
+# UNIFORM is only used when the banded period stays this small
+_UNIFORM_MAX_COLORS = 64
 
 
 def color_matrix(A, scheme="MIN_MAX", deterministic=False) -> np.ndarray:
@@ -102,6 +161,29 @@ def color_matrix(A, scheme="MIN_MAX", deterministic=False) -> np.ndarray:
     indices = np.asarray(A.col_indices)
     n = A.n_rows
     algo = _SCHEME_ALIASES.get(scheme.upper(), "MIN_MAX")
+    if algo in ("MIN_MAX_2RING", "GREEDY_2RING"):
+        ip2, ix2 = _two_ring_graph(indptr, indices, n)
+        if deterministic or algo == "GREEDY_2RING":
+            return greedy_coloring(ip2, ix2, n)
+        return min_max_coloring(ip2, ix2, n)
+    if algo == "LOCALLY_DOWNWIND":
+        vals = np.asarray(A.values)
+        if vals.ndim > 1:  # block matrix: use block Frobenius weight
+            vals = np.sqrt((np.abs(vals) ** 2).sum(axis=(1, 2)))
+        order = downwind_order(indptr, indices, vals, n)
+        return greedy_coloring(indptr, indices, n, order=order)
+    if algo == "UNIFORM":
+        row_ids = np.repeat(np.arange(n), np.diff(indptr))
+        off = indices != row_ids
+        if off.any():
+            period = int(np.abs(indices[off] - row_ids[off]).max()) + 1
+        else:
+            period = 1
+        if period <= _UNIFORM_MAX_COLORS:
+            return (np.arange(n, dtype=np.int32) % period).astype(
+                np.int32
+            )
+        return greedy_coloring(indptr, indices, n)
     if deterministic or algo == "GREEDY":
         return greedy_coloring(indptr, indices, n)
     return min_max_coloring(indptr, indices, n)

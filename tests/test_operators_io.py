@@ -115,3 +115,63 @@ def test_capi_solver_resetup():
     rel = np.linalg.norm(np.ones(100) - 2.0 * sp @ sol) / 10.0
     assert rel < 1e-7
     capi.finalize()
+
+
+def test_nvamg_binary_roundtrip(tmp_path):
+    """%%NVAMGBinary write -> read roundtrip (reference
+    matrix_io.cu:286-334; SURVEY §5.4)."""
+    from amgx_tpu.io.matrix_market import (
+        read_system,
+        write_system_binary,
+    )
+    from amgx_tpu.io.poisson import poisson_2d_5pt, poisson_rhs
+
+    A = poisson_2d_5pt(10)
+    b = poisson_rhs(A.n_rows)
+    x = np.linspace(0, 1, A.n_rows)
+    p = str(tmp_path / "sys.bin")
+    write_system_binary(p, A, rhs=b, sol=x)
+    with open(p, "rb") as f:
+        assert f.read(14) == b"%%NVAMGBinary\n"
+    d, rhs, sol = read_system(p)
+    from amgx_tpu.core.matrix import SparseMatrix
+
+    A2 = SparseMatrix.from_coo(
+        d["rows"], d["cols"], d["vals"],
+        n_rows=d["n_rows"], n_cols=d["n_cols"],
+    )
+    np.testing.assert_allclose(A2.to_dense(), A.to_dense())
+    np.testing.assert_allclose(rhs, b)
+    np.testing.assert_allclose(sol, x)
+
+
+def test_nvamg_binary_capi_roundtrip(tmp_path):
+    from amgx_tpu.api import capi
+    from amgx_tpu.io.poisson import poisson_2d_5pt
+
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG"}}'
+    )
+    res = capi.resources_create_simple(cfg)
+    A = capi.matrix_create(res, "dDDI")
+    sp = poisson_2d_5pt(8).to_scipy().tocsr()
+    n = sp.shape[0]
+    capi.matrix_upload_all(
+        A, n, sp.nnz, 1, 1, sp.indptr, sp.indices, sp.data, None
+    )
+    b = capi.vector_create(res, "dDDI")
+    capi.vector_upload(b, n, 1, np.arange(n, dtype=np.float64))
+    p = str(tmp_path / "capi_sys.bin")
+    capi.write_system(A, b, 0, p)
+    A2 = capi.matrix_create(res, "dDDI")
+    b2 = capi.vector_create(res, "dDDI")
+    x2 = capi.vector_create(res, "dDDI")
+    capi.read_system(A2, b2, x2, p)
+    m2 = capi._get(A2, capi._Matrix)
+    np.testing.assert_allclose(
+        np.asarray(m2.A.to_dense()), np.asarray(sp.todense())
+    )
+    np.testing.assert_allclose(
+        capi.vector_download(b2), np.arange(n, dtype=np.float64)
+    )
