@@ -247,19 +247,10 @@ def axis_strengths(Asp: sps.csr_matrix, nx: int, ny: int, nz: int):
     return out
 
 
-def geo_aggregate(
-    nx: int, ny: int, nz: int, passes: int, strengths=None
-) -> np.ndarray:
-    """Blocked lexicographic aggregation on an (nx, ny, nz) grid.
-
-    Each pass halves one axis: the one with the largest remaining
-    coupling-strength-to-block ratio (``strengths`` from
-    :func:`axis_strengths`; unit strengths when absent).  Isotropic
-    stencils get the reference selector block shapes (SIZE_2 -> 2x1x1,
-    SIZE_4 -> 2x2x1, SIZE_8 -> 2x2x2 on a cube); anisotropic stencils
-    semicoarsen along the strong axis.  Coarse aggregates are numbered
-    lexicographically on the coarse grid, so bandedness is preserved.
-    """
+def geo_block_shape(nx, ny, nz, passes, strengths=None):
+    """Block shape (bx, by, bz) the geometric aggregation uses: each
+    pass halves the axis with the largest remaining strength-to-block
+    ratio (semicoarsening on anisotropic stencils)."""
     dims = [nx, ny, nz]
     block = [1, 1, 1]
     s = list(strengths) if strengths is not None else [1.0, 1.0, 1.0]
@@ -276,6 +267,24 @@ def geo_aggregate(
         if ratios[axis] <= 0.0:
             break
         block[axis] *= 2
+    return tuple(block)
+
+
+def geo_aggregate(
+    nx: int, ny: int, nz: int, passes: int, strengths=None
+) -> np.ndarray:
+    """Blocked lexicographic aggregation on an (nx, ny, nz) grid.
+
+    Each pass halves one axis: the one with the largest remaining
+    coupling-strength-to-block ratio (``strengths`` from
+    :func:`axis_strengths`; unit strengths when absent).  Isotropic
+    stencils get the reference selector block shapes (SIZE_2 -> 2x1x1,
+    SIZE_4 -> 2x2x1, SIZE_8 -> 2x2x2 on a cube); anisotropic stencils
+    semicoarsen along the strong axis.  Coarse aggregates are numbered
+    lexicographically on the coarse grid, so bandedness is preserved.
+    """
+    dims = [nx, ny, nz]
+    block = list(geo_block_shape(nx, ny, nz, passes, strengths))
     cdims = [-(-dims[a] // block[a]) for a in range(3)]
     i = np.arange(nx * ny * nz, dtype=np.int64)
     ix = i % nx
@@ -289,11 +298,15 @@ def geo_aggregate(
     return agg.astype(np.int32)
 
 
-def select_aggregates(Asp, cfg, scope) -> np.ndarray:
+def select_aggregates(Asp, cfg, scope):
     """The selector decision shared by the serial and distributed
     setup paths: geometric blocks when the matrix is stencil-structured
     (and structured_aggregation allows it, or selector is GEO),
-    matching-based aggregation otherwise."""
+    matching-based aggregation otherwise.
+
+    Returns (agg, geo_info): geo_info is (grid, block) when the
+    geometric path was taken (enables the dense-reduction Galerkin in
+    geo_galerkin_dia), else None."""
     selector = str(cfg.get("selector", scope)).upper()
     passes = SELECTOR_PASSES.get(selector, 1)
     if passes is None:
@@ -304,26 +317,164 @@ def select_aggregates(Asp, cfg, scope) -> np.ndarray:
             infer_grid(offs, Asp.shape[0]) if offs is not None else None
         )
         if grid is not None:
-            return geo_aggregate(
-                *grid, passes, strengths=axis_strengths(Asp, *grid)
+            strengths = axis_strengths(Asp, *grid)
+            block = geo_block_shape(*grid, passes, strengths)
+            return (
+                geo_aggregate(*grid, passes, strengths=strengths),
+                (grid, block),
             )
     formula = int(cfg.get("weight_formula", scope))
     merge = bool(cfg.get("merge_singletons", scope))
-    return aggregate(Asp, passes, formula, merge)
+    return aggregate(Asp, passes, formula, merge), None
+
+
+# above this row count the dense-reduction Galerkin replaces the
+# sparse product (memory: no A@P intermediate)
+_GEO_RAP_MIN_ROWS = 4_000_000
+
+
+def _decompose_offset(off, nx, ny, nz, reach=3):
+    """Linear DIA offset -> (dx, dy, dz) stencil displacement with
+    |d*| <= reach, or None when absent or AMBIGUOUS (thin grids make
+    several displacements share a linear offset; guessing would build a
+    wrong coarse operator, so the caller must fall back)."""
+    found = []
+    for dz in range(-reach, reach + 1):
+        rem_z = off - dz * nx * ny
+        for dy in range(-reach, reach + 1):
+            dx = rem_z - dy * nx
+            if -reach <= dx <= reach:
+                found.append((dx, dy, dz))
+    if len(found) != 1:
+        return None
+    return found[0]
+
+
+def geo_galerkin_dia(Asp, grid, block):
+    """Galerkin product R A P for piecewise-constant GEO aggregation on
+    a stencil matrix — computed as dense reshape-reductions over the
+    DIA diagonals, no sparse-sparse products (the reference's SpGEMM
+    hash kernels, csr_multiply_detail.cu, exist exactly because RAP is
+    the setup bottleneck; for geometric blocks on a grid the product
+    collapses to windowed diagonal sums).
+
+    Returns the coarse operator as scipy CSR, or None when the
+    decomposition does not apply (caller falls back to sparse RAP).
+
+    Math: with P binary over (bx,by,bz) blocks, Ac[P,Q] =
+    sum_{i in P, j in Q} A[i,j]; a fine entry on displacement
+    (dx,dy,dz) at intra-block position (u,v,w) lands on the coarse
+    displacement ((u+dx)//bx, (v+dy)//by, (w+dz)//bz).
+    """
+    nx, ny, nz = grid
+    bx, by, bz = block
+    if nx % bx or ny % by or nz % bz:
+        return None  # ragged blocks: fall back
+    cx, cy, cz = nx // bx, ny // by, nz // bz
+    n = nx * ny * nz
+    coo = Asp.tocoo()
+    d_all = coo.col.astype(np.int64) - coo.row.astype(np.int64)
+    offs_arr = np.unique(d_all)
+    reach = max(bx, by, bz)
+    dec = {}
+    for off in offs_arr:
+        d = _decompose_offset(int(off), nx, ny, nz, reach)
+        if d is None:
+            return None
+        dec[int(off)] = d
+
+    # all dense diagonals in ONE pass over the entries (CSR has no
+    # duplicates, so plain fancy assignment suffices)
+    k_all = np.searchsorted(offs_arr, d_all)
+    dia = np.zeros((offs_arr.shape[0], n), dtype=Asp.dtype)
+    dia[k_all, coo.row] = coo.data
+
+    # wrap detection: a genuine (dx,dy,dz) entry only exists at rows
+    # whose displaced position stays in-grid.  Periodic/wrap diagonals
+    # (e.g. +-(nx-1)) carry nonzeros at out-of-window rows — their
+    # geometric attribution would be wrong, so bail to sparse RAP.
+    fz, fy, fx = np.meshgrid(
+        np.arange(nz), np.arange(ny), np.arange(nx), indexing="ij"
+    )
+    for ki, off in enumerate(offs_arr):
+        dx, dy, dz = dec[int(off)]
+        valid = (
+            (fx + dx >= 0) & (fx + dx < nx)
+            & (fy + dy >= 0) & (fy + dy < ny)
+            & (fz + dz >= 0) & (fz + dz < nz)
+        )
+        if np.any(dia[ki].reshape(nz, ny, nx)[~valid] != 0):
+            return None
+
+    coarse = {}
+    for ki, off in enumerate(offs_arr):
+        dx, dy, dz = dec[int(off)]
+        V = dia[ki].reshape(cz, bz, cy, by, cx, bx)
+        for w in range(bz):
+            DZ = (w + dz) // bz
+            for v in range(by):
+                DY = (v + dy) // by
+                for u in range(bx):
+                    DX = (u + dx) // bx
+                    acc = coarse.setdefault(
+                        (DX, DY, DZ),
+                        np.zeros((cz, cy, cx), dtype=Asp.dtype),
+                    )
+                    acc += V[:, w, :, v, :, u]
+
+    nc = cx * cy * cz
+    Z, Y, X = np.meshgrid(
+        np.arange(cz), np.arange(cy), np.arange(cx), indexing="ij"
+    )
+    r_full = X + cx * (Y + cy * Z)
+    rows_l, cols_l, vals_l = [], [], []
+    for (DX, DY, DZ), acc in coarse.items():
+        # valid coarse rows: the displaced coarse cell stays in-grid
+        ok = (
+            (X + DX >= 0) & (X + DX < cx)
+            & (Y + DY >= 0) & (Y + DY < cy)
+            & (Z + DZ >= 0) & (Z + DZ < cz)
+        )
+        c_off = DX + cx * (DY + cy * DZ)
+        r = r_full[ok].ravel()
+        rows_l.append(r)
+        cols_l.append(r + c_off)
+        vals_l.append(acc[ok].ravel())
+    Ac = sps.csr_matrix(
+        (
+            np.concatenate(vals_l),
+            (np.concatenate(rows_l), np.concatenate(cols_l)),
+        ),
+        shape=(nc, nc),
+    )
+    Ac.sum_duplicates()
+    Ac.eliminate_zeros()
+    Ac.sort_indices()
+    return Ac
 
 
 def build_aggregation_level(Asp, cfg, scope):
     """Returns (P, R, A_coarse) scipy matrices for one aggregation level
     (reference aggregation_amg_level.cu:238-371 R/P from aggregate map +
-    coarseAGenerator computeAOperator)."""
-    agg = select_aggregates(Asp, cfg, scope)
+    coarseAGenerator computeAOperator).  Geometric aggregations compute
+    the Galerkin product via dense diagonal reductions
+    (geo_galerkin_dia) instead of sparse-sparse products."""
+    agg, geo_info = select_aggregates(Asp, cfg, scope)
     n = Asp.shape[0]
     nc = int(agg.max()) + 1
     P = sps.csr_matrix(
         (np.ones(n, dtype=Asp.dtype), (np.arange(n), agg)), shape=(n, nc)
     )
     R = P.T.tocsr()
-    Ac = (R @ Asp @ P).tocsr()
-    Ac.sum_duplicates()
-    Ac.sort_indices()
+    Ac = None
+    # the dense-reduction Galerkin avoids the A@P sparse intermediate
+    # (which peaks at ~8x the fine operator's memory); worth it above
+    # this size, below it scipy's product is faster on host
+    if geo_info is not None and n >= _GEO_RAP_MIN_ROWS:
+        Ac = geo_galerkin_dia(Asp, *geo_info)
+    if Ac is None:
+        Ac = (R @ Asp @ P).tocsr()
+        Ac.sum_duplicates()
+        Ac.eliminate_zeros()  # structural parity with the geo path
+        Ac.sort_indices()
     return P, R, Ac

@@ -83,7 +83,7 @@ def _local_aggregate(A_pp: sps.csr_matrix, cfg, scope) -> np.ndarray:
     decision as the serial path (shared helper)."""
     from amgx_tpu.amg.aggregation import select_aggregates
 
-    return select_aggregates(A_pp, cfg, scope)
+    return select_aggregates(A_pp, cfg, scope)[0]
 
 
 class _ShardedLevelCSR:
