@@ -429,11 +429,17 @@ def matrix_upload_all(
         allv = np.concatenate(
             [vals.reshape(nnz, -1), dg.reshape(n, -1)]
         )
+        n_cols = max(n, int(ci.max()) + 1 if ci.size else n)
         m.A = SparseMatrix.from_coo(
-            rows, cols, allv, n_rows=n, n_cols=n, block_size=b
+            rows, cols, allv, n_rows=n, n_cols=n_cols, block_size=b
         )
     else:
-        m.A = SparseMatrix.from_csr(rp, ci, vals, block_size=b)
+        # locally-indexed distributed uploads carry halo columns past n
+        # (reference upload_all on a renumbered local matrix)
+        n_cols = max(n, int(ci.max()) + 1 if ci.size else n)
+        m.A = SparseMatrix.from_csr(
+            rp, ci, vals, n_cols=n_cols, block_size=b
+        )
     return RC_OK
 
 
@@ -1048,6 +1054,193 @@ def write_system(mtx_h: int, rhs_h: int, sol_h: int, filename: str):
     else:
         _write(filename, m.A, rhs=rhs, sol=sol)
     return RC_OK
+
+
+def matrix_comm_from_maps_one_ring(
+    mtx_h: int,
+    allocated_halo_depth: int,
+    num_neighbors: int,
+    neighbors,
+    send_sizes,
+    send_maps,
+    recv_sizes,
+    recv_maps,
+):
+    """Reference AMGX_matrix_comm_from_maps_one_ring (amgx_c.h:276-284):
+    attach user-supplied one-ring comm maps to a locally-indexed matrix.
+
+    The maps are validated (local indices in range, recv totals match
+    the matrix's halo column span) and stored; on a single process the
+    partitioner-derived exchange plan is authoritative for solves, so
+    this entry is the upload-side parity point for host codes that
+    manage their own partitioning.
+    """
+    m = _get(mtx_h, _Matrix)
+    if m.A is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+    neighbors = _as_array(neighbors, np.int32, num_neighbors)
+    send_sizes = _as_array(send_sizes, np.int32, num_neighbors)
+    recv_sizes = _as_array(recv_sizes, np.int32, num_neighbors)
+    n = m.A.n_rows
+    smaps, rmaps = [], []
+    for i in range(num_neighbors):
+        sm = _as_array(send_maps[i], np.int32, int(send_sizes[i]))
+        if sm.size and (sm.min() < 0 or sm.max() >= n):
+            raise AMGXError(
+                RC_BAD_PARAMETERS,
+                f"send map {i} references non-owned local rows",
+            )
+        rm = _as_array(recv_maps[i], np.int32, int(recv_sizes[i]))
+        if rm.size and (rm.min() < n or rm.max() >= m.A.n_cols):
+            raise AMGXError(
+                RC_BAD_PARAMETERS,
+                f"recv map {i} must reference halo slots in "
+                f"[{n}, {m.A.n_cols})",
+            )
+        smaps.append(sm)
+        rmaps.append(rm)
+    halo_span = m.A.n_cols - n
+    all_recv = (
+        np.concatenate(rmaps) if rmaps else np.array([], np.int32)
+    )
+    if (
+        np.unique(all_recv).size != halo_span
+        or all_recv.size != halo_span
+    ):
+        raise AMGXError(
+            RC_BAD_PARAMETERS,
+            f"recv maps must cover each of the {halo_span} halo slots "
+            "exactly once",
+        )
+    m.comm_maps = dict(
+        neighbors=neighbors, send_maps=smaps, recv_maps=rmaps,
+        rings=allocated_halo_depth,
+    )
+    return RC_OK
+
+
+def read_system_maps_one_ring(
+    rsc_h: int,
+    mode: str,
+    filename: str,
+    allocated_halo_depth: int = 1,
+    num_partitions: int = 1,
+    partition_sizes=None,
+    partition_vector_size: int = 0,
+    partition_vector=None,
+    part: int = 0,
+):
+    """Reference AMGX_read_system_maps_one_ring (amgx_c.h:452-488): read
+    a global system, partition it, and return partition ``part``'s
+    local CSR (owned-first renumbering, used halo slots appended in
+    global order) plus the one-ring comm maps — the single-process
+    multi-partition simulation the reference tests use
+    (generated_matrix_distributed_io.cu).
+
+    Map orientation matches the reference: ``send_maps[j]`` holds THIS
+    partition's owned local rows that neighbor j needs;
+    ``recv_maps[j]`` holds this partition's halo slots filled from
+    neighbor j.  Both sides order a pair's traffic by global row id,
+    so partner maps line up.
+
+    Returns a dict: n, nnz, block_dimx/y, row_ptrs, col_indices, data,
+    rhs, sol, num_neighbors, neighbors, send_sizes, send_maps,
+    recv_sizes, recv_maps.
+    """
+    import scipy.sparse as sps
+
+    from amgx_tpu.distributed.partition import local_numbering
+    from amgx_tpu.io.matrix_market import MatrixIOError, read_system
+
+    if not (0 <= part < num_partitions):
+        raise AMGXError(RC_BAD_PARAMETERS, f"bad partition id {part}")
+    try:
+        sysd, rhs, sol = read_system(filename)
+    except FileNotFoundError as e:
+        raise AMGXError(RC_IO_ERROR, str(e)) from None
+    except MatrixIOError as e:
+        raise AMGXError(RC_IO_ERROR, str(e)) from None
+    bdx, bdy = sysd["block_dims"]
+    if bdx != 1 or bdy != 1:
+        raise AMGXError(
+            RC_NOT_IMPLEMENTED,
+            "read_system_maps_one_ring: scalar systems only for now",
+        )
+    n_g = sysd["n_rows"]
+    if partition_vector is not None:
+        owner = _as_array(partition_vector, np.int32, n_g)
+        if owner.min() < 0 or owner.max() >= num_partitions:
+            raise AMGXError(
+                RC_BAD_PARAMETERS,
+                "partition vector entries outside [0, num_partitions)",
+            )
+    else:
+        rows_pp = -(-n_g // num_partitions)
+        owner = np.minimum(
+            np.arange(n_g, dtype=np.int64) // rows_pp,
+            num_partitions - 1,
+        ).astype(np.int32)
+    sp = sps.csr_matrix(
+        (sysd["vals"], (sysd["rows"], sysd["cols"])), shape=(n_g, n_g)
+    )
+    local_of, counts, part_rows = local_numbering(owner, num_partitions)
+    gids = part_rows[part]
+    n_loc = int(counts[part])
+
+    loc = sp[gids].tocsr()
+    is_owned = owner[loc.indices] == part
+    used_halo_g = np.unique(loc.indices[~is_owned])  # global ids, sorted
+    ci = np.empty(loc.indices.shape, dtype=np.int32)
+    ci[is_owned] = local_of[loc.indices[is_owned]]
+    if used_halo_g.size:
+        ci[~is_owned] = (
+            n_loc + np.searchsorted(used_halo_g, loc.indices[~is_owned])
+        ).astype(np.int32)
+
+    # cross-partition traffic, both directions, ordered by global id
+    coo = sp.tocoo()
+    src, dst = owner[coo.col], owner[coo.row]
+    cross = src != dst
+    csrc, cdst, cgid = src[cross], dst[cross], coo.col[cross]
+    nbrs, send_maps, recv_maps = [], [], []
+    for q in range(num_partitions):
+        if q == part:
+            continue
+        # p -> q: p-owned columns referenced by q's rows
+        send_g = np.unique(cgid[(csrc == part) & (cdst == q)])
+        # q -> p: q-owned halo entries of p
+        recv_g = np.unique(cgid[(csrc == q) & (cdst == part)])
+        if send_g.size == 0 and recv_g.size == 0:
+            continue
+        nbrs.append(q)
+        send_maps.append(local_of[send_g].astype(np.int32))
+        recv_maps.append(
+            (
+                n_loc + np.searchsorted(used_halo_g, recv_g)
+            ).astype(np.int32)
+        )
+    rhs_loc = sol_loc = None
+    if rhs is not None:
+        rhs_loc = np.asarray(rhs)[gids]
+    if sol is not None:
+        sol_loc = np.asarray(sol)[gids]
+    return dict(
+        n=n_loc,
+        nnz=int(loc.nnz),
+        block_dimx=bdx,
+        block_dimy=bdy,
+        row_ptrs=loc.indptr.astype(np.int32),
+        col_indices=ci,
+        data=loc.data,
+        rhs=rhs_loc,
+        sol=sol_loc,
+        num_neighbors=len(nbrs),
+        neighbors=np.asarray(nbrs, np.int32),
+        send_sizes=np.asarray([len(a) for a in send_maps], np.int32),
+        send_maps=send_maps,
+        recv_sizes=np.asarray([len(a) for a in recv_maps], np.int32),
+        recv_maps=recv_maps,
+    )
 
 
 def write_parameters_description(filename: str):

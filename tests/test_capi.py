@@ -287,3 +287,98 @@ def test_capi_generate_distributed_poisson_grid():
     capi.solver_setup(slv, A)
     capi.solver_solve_with_0_initial_guess(slv, b, x)
     assert capi.solver_get_status(slv) == capi.SOLVE_SUCCESS
+
+
+def test_read_system_maps_one_ring(tmp_path):
+    """Reference AMGX_read_system_maps_one_ring: per-partition local
+    CSR + one-ring comm maps; reassembling every partition's owned
+    rows through PARTNER send maps must reproduce the global system
+    (the generated_matrix_distributed_io.cu union test)."""
+    from amgx_tpu.api import capi
+    from amgx_tpu.io.matrix_market import write_system
+    from amgx_tpu.io.poisson import poisson_2d_5pt, poisson_rhs
+
+    A = poisson_2d_5pt(12)
+    sp = A.to_scipy().tocsr()
+    b = poisson_rhs(A.n_rows)
+    path = str(tmp_path / "sys.mtx")
+    write_system(path, A, rhs=b)
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG"}}'
+    )
+    res = capi.resources_create_simple(cfg)
+    n_parts = 4
+    n_g = sp.shape[0]
+    pv = (np.arange(n_g) * n_parts // n_g).astype(np.int32)
+
+    parts = [
+        capi.read_system_maps_one_ring(
+            res, "dDDI", path, 1, n_parts,
+            partition_vector=pv, part=p,
+        )
+        for p in range(n_parts)
+    ]
+    gids_of = [np.nonzero(pv == p)[0] for p in range(n_parts)]
+    recon = np.zeros((n_g, n_g))
+    for p, d in enumerate(parts):
+        gids = gids_of[p]
+        assert d["n"] == len(gids)
+        nn = d["n"] + sum(len(r) for r in d["recv_maps"])
+        l2g = np.full(nn, -1, dtype=np.int64)
+        l2g[: d["n"]] = gids
+        # p's recv slots from q pair with q's send map toward p
+        for j, q in enumerate(d["neighbors"]):
+            dq = parts[q]
+            jq = list(dq["neighbors"]).index(p)
+            send_from_q = dq["send_maps"][jq]  # q-local owned rows
+            assert len(send_from_q) == len(d["recv_maps"][j])
+            l2g[d["recv_maps"][j]] = gids_of[q][send_from_q]
+        assert (l2g >= 0).all()
+        rp, ci, dv = d["row_ptrs"], d["col_indices"], d["data"]
+        for i in range(d["n"]):
+            for k in range(rp[i], rp[i + 1]):
+                recon[gids[i], l2g[ci[k]]] += dv[k]
+        np.testing.assert_allclose(d["rhs"], b[gids])
+    np.testing.assert_allclose(recon, np.asarray(sp.todense()))
+
+
+def test_matrix_comm_from_maps_one_ring_validation():
+    from amgx_tpu.api import capi
+    from amgx_tpu.io.poisson import poisson_2d_5pt
+
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG"}}'
+    )
+    res = capi.resources_create_simple(cfg)
+    A_h = capi.matrix_create(res, "dDDI")
+    # a local matrix with 2 halo columns appended (cols n..n+1)
+    import scipy.sparse as sps
+
+    n = 16
+    sp = poisson_2d_5pt(4).to_scipy().tolil()
+    ext = sps.lil_matrix((n, n + 2))
+    ext[:, :n] = sp
+    ext[0, n] = -1.0
+    ext[3, n + 1] = -1.0
+    ext = ext.tocsr()
+    capi.matrix_upload_all(
+        A_h, n, ext.nnz, 1, 1, ext.indptr, ext.indices, ext.data, None
+    )
+    m = capi._get(A_h, capi._Matrix)
+    assert m.A.n_cols == n + 2
+    rc = capi.matrix_comm_from_maps_one_ring(
+        A_h, 1, 1, [1], [2], [np.array([0, 3], np.int32)],
+        [2], [np.array([n, n + 1], np.int32)],
+    )
+    assert rc == capi.RC_OK
+    assert m.comm_maps["neighbors"][0] == 1
+    # invalid: recv map referencing owned slots
+    import pytest as _pytest
+
+    with _pytest.raises(capi.AMGXError):
+        capi.matrix_comm_from_maps_one_ring(
+            A_h, 1, 1, [1], [2], [np.array([0, 3], np.int32)],
+            [2], [np.array([0, 1], np.int32)],
+        )
