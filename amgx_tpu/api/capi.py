@@ -1243,6 +1243,61 @@ def read_system_maps_one_ring(
     )
 
 
+def read_system_maps_one_ring_flat(
+    rsc_h: int,
+    mode: str,
+    filename: str,
+    allocated_halo_depth: int,
+    num_partitions: int,
+    partition_vector=None,
+    part: int = 0,
+):
+    """Native-shim form of read_system_maps_one_ring: a flat tuple of
+    contiguous arrays (maps concatenated; the C side rebuilds the
+    per-neighbor pointers from the size arrays)."""
+    try:
+        md = mode_from_name(mode)
+    except ValueError as e:
+        raise AMGXError(RC_BAD_MODE, str(e)) from None
+    d = read_system_maps_one_ring(
+        rsc_h, mode, filename, allocated_halo_depth, num_partitions,
+        partition_vector=partition_vector, part=part,
+    )
+    send_cat = (
+        np.concatenate(d["send_maps"])
+        if d["send_maps"]
+        else np.array([], np.int32)
+    ).astype(np.int32)
+    recv_cat = (
+        np.concatenate(d["recv_maps"])
+        if d["recv_maps"]
+        else np.array([], np.int32)
+    ).astype(np.int32)
+    rhs = d["rhs"]
+    sol = d["sol"]
+    return (
+        d["n"],
+        d["nnz"],
+        d["block_dimx"],
+        d["block_dimy"],
+        np.ascontiguousarray(d["row_ptrs"], np.int32).tobytes(),
+        np.ascontiguousarray(d["col_indices"], np.int32).tobytes(),
+        np.ascontiguousarray(d["data"], md.mat_dtype).tobytes(),
+        None
+        if rhs is None
+        else np.ascontiguousarray(rhs, md.vec_dtype).tobytes(),
+        None
+        if sol is None
+        else np.ascontiguousarray(sol, md.vec_dtype).tobytes(),
+        int(d["num_neighbors"]),
+        np.ascontiguousarray(d["neighbors"], np.int32).tobytes(),
+        np.ascontiguousarray(d["send_sizes"], np.int32).tobytes(),
+        send_cat.tobytes(),
+        np.ascontiguousarray(d["recv_sizes"], np.int32).tobytes(),
+        recv_cat.tobytes(),
+    )
+
+
 def write_parameters_description(filename: str):
     from amgx_tpu.config.params import write_parameters_description as _w
 

@@ -103,6 +103,29 @@ int main(void) {
   free(v0);
 
   CHECK(AMGX_eigensolver_destroy(eig));
+
+  /* ---- one-ring maps surface (reference amgx_c.h:452-501) ---- */
+  CHECK(AMGX_write_system(M, 0, 0, "/tmp/amgx_maps_demo.mtx"));
+  {
+    int n, nnz, bdx, bdy, num_nb;
+    int *rp, *ci, *nbrs, *ssz, *rsz;
+    int **smaps, **rmaps;
+    void *dv, *dd, *rh, *so;
+    int pvec[6 * 6 * 6];
+    for (int i = 0; i < 6 * 6 * 6; ++i) pvec[i] = i * 4 / (6 * 6 * 6);
+    CHECK(AMGX_read_system_maps_one_ring(
+        &n, &nnz, &bdx, &bdy, &rp, &ci, &dv, &dd, &rh, &so, &num_nb,
+        &nbrs, &ssz, &smaps, &rsz, &rmaps, ersrc, "dDDI",
+        "/tmp/amgx_maps_demo.mtx", 1, 4, NULL, 6 * 6 * 6, pvec));
+    printf("one-ring maps: n=%d nnz=%d neighbors=%d"
+           " (send %d, recv %d to/from nb %d)\n",
+           n, nnz, num_nb, num_nb ? ssz[0] : 0, num_nb ? rsz[0] : 0,
+           num_nb ? nbrs[0] : -1);
+    if (n <= 0 || num_nb <= 0) return 3;
+    CHECK(AMGX_free_system_maps_one_ring(rp, ci, dv, dd, rh, so, num_nb,
+                                         nbrs, ssz, smaps, rsz, rmaps));
+  }
+
   CHECK(AMGX_matrix_destroy(M));
   CHECK(AMGX_vector_destroy(ev));
   CHECK(AMGX_config_destroy(ecfg));

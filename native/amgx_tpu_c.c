@@ -17,6 +17,7 @@
 #include <Python.h>
 #include <string.h>
 #include <stdio.h>
+#include <stdlib.h>
 #include <dlfcn.h>
 #include <libgen.h>
 
@@ -900,4 +901,144 @@ AMGX_RC AMGX_eigensolver_destroy(AMGX_eigensolver_handle slv) {
   AMGX_RC rc = call_rc("eig_solver_destroy",
                        Py_BuildValue("(K)", (unsigned long long)slv), 1);
   LEAVE_RET(rc);
+}
+
+/* ------------------------------------------------------------------ */
+/* one-ring comm maps (reference amgx_c.h:276-284,452-501)             */
+
+AMGX_RC AMGX_matrix_comm_from_maps_one_ring(
+    AMGX_matrix_handle mtx, int allocated_halo_depth, int num_neighbors,
+    const int *neighbors, const int *send_sizes, const int **send_maps,
+    const int *recv_sizes, const int **recv_maps) {
+  ENTER();
+  PyObject *nbrs = PyBytes_FromStringAndSize(
+      (const char *)neighbors,
+      (Py_ssize_t)(sizeof(int) * (size_t)num_neighbors));
+  PyObject *ssz = PyBytes_FromStringAndSize(
+      (const char *)send_sizes,
+      (Py_ssize_t)(sizeof(int) * (size_t)num_neighbors));
+  PyObject *rsz = PyBytes_FromStringAndSize(
+      (const char *)recv_sizes,
+      (Py_ssize_t)(sizeof(int) * (size_t)num_neighbors));
+  PyObject *smaps = PyList_New(num_neighbors);
+  PyObject *rmaps = PyList_New(num_neighbors);
+  for (int i = 0; i < num_neighbors; ++i) {
+    PyList_SetItem(
+        smaps, i,
+        PyBytes_FromStringAndSize(
+            (const char *)send_maps[i],
+            (Py_ssize_t)(sizeof(int) * (size_t)send_sizes[i])));
+    PyList_SetItem(
+        rmaps, i,
+        PyBytes_FromStringAndSize(
+            (const char *)recv_maps[i],
+            (Py_ssize_t)(sizeof(int) * (size_t)recv_sizes[i])));
+  }
+  AMGX_RC rc = call_rc(
+      "matrix_comm_from_maps_one_ring",
+      Py_BuildValue("(KiiNNNNN)", (unsigned long long)mtx,
+                    allocated_halo_depth, num_neighbors, nbrs, ssz,
+                    smaps, rsz, rmaps),
+      1);
+  LEAVE_RET(rc);
+}
+
+static void *dup_bytes(PyObject *o, size_t *len_out) {
+  if (o == Py_None) {
+    if (len_out) *len_out = 0;
+    return NULL;
+  }
+  Py_ssize_t len = PyBytes_Size(o);
+  void *p = malloc((size_t)len > 0 ? (size_t)len : 1);
+  if (p) memcpy(p, PyBytes_AsString(o), (size_t)len);
+  if (len_out) *len_out = (size_t)len;
+  return p;
+}
+
+AMGX_RC AMGX_read_system_maps_one_ring(
+    int *n, int *nnz, int *block_dimx, int *block_dimy, int **row_ptrs,
+    int **col_indices, void **data, void **diag_data, void **rhs,
+    void **sol, int *num_neighbors, int **neighbors, int **send_sizes,
+    int ***send_maps, int **recv_sizes, int ***recv_maps,
+    AMGX_resources_handle rsc, const char *mode, const char *filename,
+    int allocated_halo_depth, int num_partitions,
+    const int *partition_sizes, int partition_vector_size,
+    const int *partition_vector) {
+  (void)partition_sizes;
+  ENTER();
+  PyObject *pv =
+      partition_vector
+          ? PyBytes_FromStringAndSize(
+                (const char *)partition_vector,
+                (Py_ssize_t)(sizeof(int) * (size_t)partition_vector_size))
+          : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = capi_call(
+      "read_system_maps_one_ring_flat",
+      Py_BuildValue("(KssiiNi)", (unsigned long long)rsc, mode, filename,
+                    allocated_halo_depth, num_partitions, pv, 0),
+      1);
+  if (!r) LEAVE_RET(rc_from_exception());
+  PyObject *rp_o, *ci_o, *dv_o, *rhs_o, *sol_o, *nb_o, *ss_o, *sm_o,
+      *rs_o, *rm_o;
+  int nn;
+  if (!PyArg_ParseTuple(r, "iiiiOOOOOiOOOOO", n, nnz, block_dimx,
+                        block_dimy, &rp_o, &ci_o, &dv_o, &rhs_o, &sol_o,
+                        &nn, &nb_o, &ss_o, &sm_o, &rs_o, &rm_o)) {
+    Py_DECREF(r);
+    LEAVE_RET(rc_from_exception());
+  }
+  *num_neighbors = nn;
+  *row_ptrs = (int *)dup_bytes(rp_o, NULL);
+  *col_indices = (int *)dup_bytes(ci_o, NULL);
+  *data = dup_bytes(dv_o, NULL);
+  if (diag_data) *diag_data = NULL;
+  if (rhs) *rhs = dup_bytes(rhs_o, NULL);
+  if (sol) *sol = dup_bytes(sol_o, NULL);
+  *neighbors = (int *)dup_bytes(nb_o, NULL);
+  *send_sizes = (int *)dup_bytes(ss_o, NULL);
+  *recv_sizes = (int *)dup_bytes(rs_o, NULL);
+  int *scat = (int *)dup_bytes(sm_o, NULL);
+  int *rcat = (int *)dup_bytes(rm_o, NULL);
+  *send_maps = (int **)malloc(sizeof(int *) * (size_t)(nn > 0 ? nn : 1));
+  *recv_maps = (int **)malloc(sizeof(int *) * (size_t)(nn > 0 ? nn : 1));
+  size_t so = 0, ro = 0;
+  for (int i = 0; i < nn; ++i) {
+    (*send_maps)[i] = scat + so;
+    (*recv_maps)[i] = rcat + ro;
+    so += (size_t)(*send_sizes)[i];
+    ro += (size_t)(*recv_sizes)[i];
+  }
+  /* neighbor 0's pointer owns the concatenated block (freed there) */
+  if (nn == 0) {
+    free(scat);
+    free(rcat);
+    (*send_maps)[0] = NULL;
+    (*recv_maps)[0] = NULL;
+  }
+  Py_DECREF(r);
+  LEAVE_RET(AMGX_RC_OK);
+}
+
+AMGX_RC AMGX_free_system_maps_one_ring(
+    int *row_ptrs, int *col_indices, void *data, void *diag_data,
+    void *rhs, void *sol, int num_neighbors, int *neighbors,
+    int *send_sizes, int **send_maps, int *recv_sizes, int **recv_maps) {
+  free(row_ptrs);
+  free(col_indices);
+  free(data);
+  free(diag_data);
+  free(rhs);
+  free(sol);
+  if (send_maps) {
+    if (num_neighbors > 0) free(send_maps[0]);
+    free(send_maps);
+  }
+  if (recv_maps) {
+    if (num_neighbors > 0) free(recv_maps[0]);
+    free(recv_maps);
+  }
+  free(neighbors);
+  free(send_sizes);
+  free(recv_sizes);
+  return AMGX_RC_OK;
 }

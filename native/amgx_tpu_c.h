@@ -166,6 +166,27 @@ AMGX_RC AMGX_generate_distributed_poisson_7pt(
     int allocated_halo_depth, int num_import_rings, int nx, int ny, int nz,
     int px, int py, int pz);
 
+/* ---- one-ring comm maps (reference amgx_c.h:276-284,452-501).
+ * read_system_maps_one_ring allocates every out array with malloc;
+ * release them with AMGX_free_system_maps_one_ring. ---- */
+AMGX_RC AMGX_matrix_comm_from_maps_one_ring(
+    AMGX_matrix_handle mtx, int allocated_halo_depth, int num_neighbors,
+    const int *neighbors, const int *send_sizes, const int **send_maps,
+    const int *recv_sizes, const int **recv_maps);
+AMGX_RC AMGX_read_system_maps_one_ring(
+    int *n, int *nnz, int *block_dimx, int *block_dimy, int **row_ptrs,
+    int **col_indices, void **data, void **diag_data, void **rhs,
+    void **sol, int *num_neighbors, int **neighbors, int **send_sizes,
+    int ***send_maps, int **recv_sizes, int ***recv_maps,
+    AMGX_resources_handle rsc, const char *mode, const char *filename,
+    int allocated_halo_depth, int num_partitions,
+    const int *partition_sizes, int partition_vector_size,
+    const int *partition_vector);
+AMGX_RC AMGX_free_system_maps_one_ring(
+    int *row_ptrs, int *col_indices, void *data, void *diag_data,
+    void *rhs, void *sol, int num_neighbors, int *neighbors,
+    int *send_sizes, int **send_maps, int *recv_sizes, int **recv_maps);
+
 /* ---- eigensolver (reference amgx_eig_c.h) ---- */
 AMGX_RC AMGX_eigensolver_create(AMGX_eigensolver_handle *ret,
                                 AMGX_resources_handle rsc,
