@@ -1001,6 +1001,25 @@ AMGX_RC AMGX_read_system_maps_one_ring(
   int *rcat = (int *)dup_bytes(rm_o, NULL);
   *send_maps = (int **)malloc(sizeof(int *) * (size_t)(nn > 0 ? nn : 1));
   *recv_maps = (int **)malloc(sizeof(int *) * (size_t)(nn > 0 ? nn : 1));
+  if (!*row_ptrs || !*col_indices || !*data || !*neighbors ||
+      !*send_sizes || !*recv_sizes || !scat || !rcat || !*send_maps ||
+      !*recv_maps || (rhs && rhs_o != Py_None && !*rhs) ||
+      (sol && sol_o != Py_None && !*sol)) {
+    free(*row_ptrs);
+    free(*col_indices);
+    free(*data);
+    if (rhs) free(*rhs);
+    if (sol) free(*sol);
+    free(*neighbors);
+    free(*send_sizes);
+    free(*recv_sizes);
+    free(scat);
+    free(rcat);
+    free(*send_maps);
+    free(*recv_maps);
+    Py_DECREF(r);
+    LEAVE_RET(AMGX_RC_NO_MEMORY);
+  }
   size_t so = 0, ro = 0;
   for (int i = 0; i < nn; ++i) {
     (*send_maps)[i] = scat + so;
