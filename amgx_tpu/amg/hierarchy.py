@@ -34,6 +34,11 @@ from amgx_tpu.solvers.registry import (
 )
 
 
+# gamma-cycle branch-depth cap shared by the serial and distributed
+# cycles
+W_MAX_BRANCH_LEVELS = 6
+
+
 class AMGLevel:
     """One hierarchy level (reference AMG_Level, amg_level.h:50)."""
 
@@ -189,8 +194,9 @@ class AMGSolver(Solver):
     # coarse visits into the XLA program.  Branch only on the top levels
     # (truncated gamma-cycle) to bound trace size; below that the walk
     # degenerates to V, where the extra visits are numerically negligible
-    # (coarse solves are near-exact there anyway).
-    _W_MAX_BRANCH_LEVELS = 6
+    # (coarse solves are near-exact there anyway).  Shared with the
+    # distributed cycle (distributed/amg.py).
+    _W_MAX_BRANCH_LEVELS = W_MAX_BRANCH_LEVELS
 
     def _level_sweeps(self, lvl_id):
         pre, post = self.presweeps, self.postsweeps

@@ -88,6 +88,16 @@ class DistributedAMG:
         self.postsweeps = max(
             int(self.cfg.get("postsweeps", self.scope)), 0
         )
+        self.cycle_type = str(
+            self.cfg.get("cycle", self.scope)
+        ).upper()
+        if self.cycle_type in ("CG", "CGF"):
+            import warnings
+
+            warnings.warn(
+                f"distributed cycle {self.cycle_type}: K-cycles are "
+                "not sharded yet, running V"
+            )
         self._solve_cache = {}
 
         self.h: DistHierarchy = build_distributed_hierarchy(
@@ -176,7 +186,7 @@ class DistributedAMG:
         pool_msk_flat = msk.reshape(-1)
         ng = self.h.tail_matrix.shape[0]
 
-        def descend(l, lps, tail_params, r_l):
+        def descend(l, lps, tail_params, r_l, branching=True):
             lp = lps[l]
             if l == len(levels) - 1:
                 # consolidation bridge: gather -> replicated tail cycle
@@ -195,7 +205,26 @@ class DistributedAMG:
             rr = r_l - spmvs[l](sh, z)
             Pc, Pv, Rc, Rv = lp[1], lp[2], lp[3], lp[4]
             rc = jnp.sum(Rv * rr[Rc], axis=1)
-            ec = descend(l + 1, lps, tail_params, rc)
+            ec = descend(l + 1, lps, tail_params, rc, branching)
+            # W/F cycles revisit the coarse level (reference
+            # fixed_cycle.cu gamma-cycles); branch only on the top
+            # levels to bound the unrolled trace, like the serial
+            # hierarchy's _W_MAX_BRANCH_LEVELS.  F's second visit is a
+            # plain V walk.
+            from amgx_tpu.amg.hierarchy import W_MAX_BRANCH_LEVELS
+
+            branch = (
+                branching
+                and self.cycle_type in ("W", "F")
+                and l < min(len(levels) - 2, W_MAX_BRANCH_LEVELS)
+            )
+            if branch:
+                zc_lp = lps[l + 1]
+                rc2 = rc - spmvs[l + 1](zc_lp[0], ec)
+                ec = ec + descend(
+                    l + 1, lps, tail_params, rc2,
+                    branching=(self.cycle_type == "W"),
+                )
             z = z + jnp.sum(Pv * ec[Pc], axis=1)
             z = smooth(l, lp, r_l, z, post)
             return z

@@ -208,3 +208,41 @@ def test_non_split_spmv_path():
     x = np.random.default_rng(2).standard_normal(n)
     y = dist_spmv_replicated_check(D, x, mesh1d(4))
     np.testing.assert_allclose(y, Asp @ x, rtol=1e-10)
+
+
+@pytest.mark.parametrize("cycle", ["V", "W", "F"])
+def test_distributed_cycles(cycle):
+    """W/F gamma-cycles on the sharded hierarchy (reference
+    fixed_cycle.cu); W must converge at least as fast as V."""
+    from amgx_tpu.config.amg_config import AMGConfig
+
+    cfg = AMGConfig.from_string(_cycle_cfg(cycle))
+    Asp = poisson_3d_7pt(12).to_scipy()
+    b = poisson_rhs(Asp.shape[0])
+    s = DistributedAMG(
+        Asp, mesh1d(8), cfg=cfg, scope="amg", consolidate_rows=128
+    )
+    assert s.cycle_type == cycle
+    x, it, _ = s.solve(b, max_iters=60, tol=1e-8)
+    rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
+    assert rel < 1e-7, (cycle, rel)
+    if cycle == "W":
+        sv = DistributedAMG(
+            Asp, mesh1d(8),
+            cfg=AMGConfig.from_string(_cycle_cfg("V")),
+            scope="amg", consolidate_rows=128,
+        )
+        _, itv, _ = sv.solve(b, max_iters=60, tol=1e-8)
+        assert it <= itv + 1, (it, itv)
+
+
+def _cycle_cfg(cycle):
+    return (
+        '{"config_version": 2, "solver": {"scope": "amg",'
+        ' "solver": "AMG", "algorithm": "AGGREGATION",'
+        ' "selector": "SIZE_2", "smoother": {"scope": "j",'
+        ' "solver": "BLOCK_JACOBI", "relaxation_factor": 0.8},'
+        ' "presweeps": 1, "postsweeps": 1, "max_iters": 1,'
+        f' "cycle": "{cycle}",'
+        ' "coarse_solver": "DENSE_LU_SOLVER"}}'
+    )
