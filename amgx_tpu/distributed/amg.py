@@ -91,13 +91,7 @@ class DistributedAMG:
         self.cycle_type = str(
             self.cfg.get("cycle", self.scope)
         ).upper()
-        if self.cycle_type in ("CG", "CGF"):
-            import warnings
-
-            warnings.warn(
-                f"distributed cycle {self.cycle_type}: K-cycles are "
-                "not sharded yet, running V"
-            )
+        self.cycle_iters = int(self.cfg.get("cycle_iters", self.scope))
         self._solve_cache = {}
 
         self.h: DistHierarchy = build_distributed_hierarchy(
@@ -205,29 +199,65 @@ class DistributedAMG:
             rr = r_l - spmvs[l](sh, z)
             Pc, Pv, Rc, Rv = lp[1], lp[2], lp[3], lp[4]
             rc = jnp.sum(Rv * rr[Rc], axis=1)
-            ec = descend(l + 1, lps, tail_params, rc, branching)
-            # W/F cycles revisit the coarse level (reference
-            # fixed_cycle.cu gamma-cycles); branch only on the top
-            # levels to bound the unrolled trace, like the serial
-            # hierarchy's _W_MAX_BRANCH_LEVELS.  F's second visit is a
-            # plain V walk.
+            # gamma/K-cycles visit the coarse level more than once
+            # (reference fixed_cycle.cu / cg_[flex_]cycle.cu); branch
+            # only on the top levels to bound the unrolled trace, like
+            # the serial hierarchy's W_MAX_BRANCH_LEVELS.  F's second
+            # visit is a plain V walk.
             from amgx_tpu.amg.hierarchy import W_MAX_BRANCH_LEVELS
 
             branch = (
                 branching
-                and self.cycle_type in ("W", "F")
+                and self.cycle_type in ("W", "F", "CG", "CGF")
                 and l < min(len(levels) - 2, W_MAX_BRANCH_LEVELS)
             )
-            if branch:
-                zc_lp = lps[l + 1]
-                rc2 = rc - spmvs[l + 1](zc_lp[0], ec)
-                ec = ec + descend(
-                    l + 1, lps, tail_params, rc2,
-                    branching=(self.cycle_type == "W"),
-                )
+            if branch and self.cycle_type in ("CG", "CGF"):
+                ec = kcycle(l + 1, lps, tail_params, rc)
+            else:
+                ec = descend(l + 1, lps, tail_params, rc, branching)
+                if branch:
+                    zc_lp = lps[l + 1]
+                    rc2 = rc - spmvs[l + 1](zc_lp[0], ec)
+                    ec = ec + descend(
+                        l + 1, lps, tail_params, rc2,
+                        branching=(self.cycle_type == "W"),
+                    )
             z = z + jnp.sum(Pv * ec[Pc], axis=1)
             z = smooth(l, lp, r_l, z, post)
             return z
+
+        def kcycle(l, lps, tail_params, b_c):
+            """K-cycle coarse solve (reference cg_[flex_]cycle.cu,
+            Notay): cycle_iters (F)CG iterations on the sharded coarse
+            system, preconditioned by the non-branching cycle; dots
+            are psum'd over the mesh axis."""
+            sh = lps[l][0]
+            flexible = self.cycle_type == "CGF"
+            x = jnp.zeros_like(b_c)
+            r = b_c
+            z = descend(l, lps, tail_params, r, branching=False)
+            p = z
+            rho = _pdot(r, z, axis)
+            for j in range(max(self.cycle_iters, 1)):
+                q = spmvs[l](sh, p)
+                pq = _pdot(p, q, axis)
+                alpha = jnp.where(pq != 0, rho / pq, 0.0)
+                x = x + alpha * p
+                r_new = r - alpha * q
+                if j + 1 == max(self.cycle_iters, 1):
+                    break
+                z = descend(
+                    l, lps, tail_params, r_new, branching=False
+                )
+                rho_new = _pdot(r_new, z, axis)
+                denom = jnp.where(rho != 0, rho, 1.0)
+                if flexible:
+                    beta = _pdot(z, r_new - r, axis) / denom
+                else:
+                    beta = rho_new / denom
+                p = z + beta * p
+                r, rho = r_new, rho_new
+            return x
 
         def cycle(lps, tail_params, r0):
             return descend(0, lps, tail_params, r0)

@@ -210,7 +210,7 @@ def test_non_split_spmv_path():
     np.testing.assert_allclose(y, Asp @ x, rtol=1e-10)
 
 
-@pytest.mark.parametrize("cycle", ["V", "W", "F"])
+@pytest.mark.parametrize("cycle", ["V", "W", "F", "CG", "CGF"])
 def test_distributed_cycles(cycle):
     """W/F gamma-cycles on the sharded hierarchy (reference
     fixed_cycle.cu); W must converge at least as fast as V."""
