@@ -83,6 +83,7 @@ class DistributedAMG:
                 f"distributed smoother {sname}: using damped Jacobi "
                 "(colored smoothers on sharded levels TBD)"
             )
+        self.l1_jacobi = sname == "JACOBI_L1"
         self.omega = float(self.cfg.get("relaxation_factor", sscope))
         self.presweeps = max(int(self.cfg.get("presweeps", self.scope)), 0)
         self.postsweeps = max(
@@ -163,6 +164,12 @@ class DistributedAMG:
         def smooth(l, lp, r_l, z, sweeps):
             sh = lp[0]
             d = sh["diag"]
+            if self.l1_jacobi:
+                # L1 diagonal: a_ii + sum_{j!=i} |a_ij| (reference
+                # jacobi_l1_solver.cu) — computed from the shard's ELL
+                # values, one cheap reduction per sweep set
+                av = jnp.sum(jnp.abs(sh["ell"][1]), axis=-1)
+                d = d + (av - jnp.abs(d))
             dinv = jnp.where(d != 0, 1.0 / d, 1.0)
             om = jnp.asarray(omega, r_l.dtype)
             for i in range(sweeps):

@@ -246,3 +246,27 @@ def _cycle_cfg(cycle):
         f' "cycle": "{cycle}",'
         ' "coarse_solver": "DENSE_LU_SOLVER"}}'
     )
+
+
+def test_distributed_l1_jacobi_smoother():
+    """JACOBI_L1 on sharded levels uses the L1 diagonal (reference
+    jacobi_l1_solver.cu), not plain Jacobi."""
+    from amgx_tpu.config.amg_config import AMGConfig
+
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "amg",'
+        ' "solver": "AMG", "algorithm": "AGGREGATION",'
+        ' "selector": "SIZE_2", "smoother": {"scope": "l1",'
+        ' "solver": "JACOBI_L1"}, "presweeps": 2, "postsweeps": 2,'
+        ' "max_iters": 1, "cycle": "V",'
+        ' "coarse_solver": "DENSE_LU_SOLVER"}}'
+    )
+    Asp = poisson_3d_7pt(12).to_scipy()
+    b = poisson_rhs(Asp.shape[0])
+    s = DistributedAMG(
+        Asp, mesh1d(8), cfg=cfg, scope="amg", consolidate_rows=256
+    )
+    assert s.l1_jacobi
+    x, it, _ = s.solve(b, max_iters=80, tol=1e-8)
+    rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
+    assert rel < 1e-7, rel
