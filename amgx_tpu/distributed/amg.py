@@ -64,10 +64,17 @@ class DistributedAMG:
 
         self.cfg = cfg
         self.scope = scope
-        self.consolidate_rows = (
-            _CONSOLIDATE_ROWS if consolidate_rows is None
-            else consolidate_rows
-        )
+        if consolidate_rows is None:
+            # reference matrix_consolidation_lower_threshold semantics:
+            # levels whose AVERAGE rows/shard drop below the threshold
+            # consolidate; 0 keeps the built-in default global cap
+            lower = int(
+                cfg.get("matrix_consolidation_lower_threshold", scope)
+            )
+            consolidate_rows = (
+                lower * self.n_parts if lower > 0 else _CONSOLIDATE_ROWS
+            )
+        self.consolidate_rows = consolidate_rows
         self._owner = owner
         self._grid = grid
         self._setup(Asp)
