@@ -488,3 +488,29 @@ def test_geo_galerkin_rejects_wrap_and_ambiguity():
 
     # thin grid: offset +1 on a (2,2,N) grid is ambiguous within reach 2
     assert _decompose_offset(1, 2, 2, 100, 2) is None
+
+
+def test_geo_rap_dispatch_above_threshold(monkeypatch):
+    """build_aggregation_level routes through the dense-reduction
+    Galerkin above _GEO_RAP_MIN_ROWS and the hierarchy it feeds stays
+    correct."""
+    import amgx_tpu.amg.aggregation as agg
+
+    monkeypatch.setattr(agg, "_GEO_RAP_MIN_ROWS", 1000)
+    calls = []
+    real = agg.geo_galerkin_dia
+
+    def spy(Asp, grid, block):
+        out = real(Asp, grid, block)
+        calls.append((Asp.shape[0], out is not None))
+        return out
+
+    monkeypatch.setattr(agg, "geo_galerkin_dia", spy)
+    A = poisson_3d_7pt(16)
+    b = poisson_rhs(A.n_rows)
+    s, res = _solve(
+        AMG_STANDALONE % ("AGGREGATION", "SIZE_8", "V"), A, b
+    )
+    assert int(res.status) == SUCCESS
+    # fine level (4096 rows) went through the geo product
+    assert any(n >= 1000 and ok for n, ok in calls), calls

@@ -270,3 +270,28 @@ def test_distributed_l1_jacobi_smoother():
     x, it, _ = s.solve(b, max_iters=80, tol=1e-8)
     rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
     assert rel < 1e-7, rel
+
+
+def test_distributed_setup_deterministic():
+    """Two hierarchy builds from the same input produce identical
+    structures and values (reference determinism tests, SURVEY §5.2)."""
+    from amgx_tpu.config.amg_config import AMGConfig
+
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "amg",'
+        ' "solver": "AMG", "selector": "SIZE_2",'
+        ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI"}}}'
+    )
+    Asp = poisson_3d_7pt(10).to_scipy()
+    h1 = build_distributed_hierarchy(
+        Asp, 4, cfg, "amg", consolidate_rows=64
+    )
+    h2 = build_distributed_hierarchy(
+        Asp, 4, cfg, "amg", consolidate_rows=64
+    )
+    assert len(h1.levels) == len(h2.levels)
+    for a, b in zip(h1.levels, h2.levels):
+        np.testing.assert_array_equal(a.A.ell_cols, b.A.ell_cols)
+        np.testing.assert_array_equal(a.A.ell_vals, b.A.ell_vals)
+        np.testing.assert_array_equal(a.A.owner, b.A.owner)
+    assert (h1.tail_matrix != h2.tail_matrix).nnz == 0
