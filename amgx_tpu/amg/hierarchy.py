@@ -80,6 +80,12 @@ class AMGSolver(Solver):
         self.dense_lu_max_rows = int(g("dense_lu_max_rows"))
         self.print_grid_stats = bool(g("print_grid_stats"))
         self.intensive_smoothing = bool(g("intensive_smoothing"))
+        # coarse-level locality renumbering: internal to the hierarchy
+        # (folded into P/R), but matrix_reordering=NONE opts out so
+        # reference level orderings stay reproducible.  Read from config,
+        # not self.reordering — make_nested neutralizes only the
+        # solve-boundary permutation.
+        self.coarse_reorder = str(g("matrix_reordering")).upper()
         if self.intensive_smoothing:
             self.presweeps = max(self.presweeps, 4)
             self.postsweeps = max(self.postsweeps, 4)
@@ -148,6 +154,12 @@ class AMGSolver(Solver):
             if nc >= n or nc == 0:  # coarsening stalled
                 break
             dtype = lvl.A.values.dtype
+            if self.coarse_reorder != "NONE":
+                # coarse numbering is internal: renumber gather-bound
+                # Galerkin operators for column locality (windowed kernel)
+                from amgx_tpu.ops.reorder import reorder_coarse_level
+
+                P, R, Ac = reorder_coarse_level(P, R, Ac, dtype)
             lvl.P = SparseMatrix.from_scipy(P.astype(dtype))
             lvl.R = SparseMatrix.from_scipy(R.astype(dtype))
             Ac = Ac.astype(dtype)

@@ -202,6 +202,11 @@ register("convergence_analysis", I, 0, "")
 register("scaling", S, "NONE", "",
          ("NONE", "BINORMALIZATION", "NBINORMALIZATION",
           "DIAGONAL_SYMMETRIC"))
+register("matrix_reordering", S, "AUTO",
+         "bandwidth-reducing unknown renumbering at solver setup "
+         "(TPU: unlocks the windowed gather SpMV kernel). AUTO adopts "
+         "the RCM ordering only when it yields a faster matrix format",
+         ("NONE", "RCM", "AUTO"))
 
 # --- eigensolvers (src/eigensolvers registrations) -------------------------
 register("eig_solver", S, "POWER_ITERATION", "eigensolver algorithm")

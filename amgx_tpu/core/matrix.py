@@ -103,10 +103,12 @@ class SparseMatrix:
     diag: jnp.ndarray
     ell_cols: Optional[jnp.ndarray]
     ell_vals: Optional[jnp.ndarray]
-    # Tiled ELL arrays (ops.pallas_spmv.tile_ell layout) for the Pallas
-    # lane-gather SpMV kernel; built on TPU backends only.
-    ell_tcols: Optional[jnp.ndarray] = None
-    ell_tvals: Optional[jnp.ndarray] = None
+    # Windowed tiled ELL (ops.pallas_well layout) for the Pallas
+    # lane-gather SpMV kernel: per-row-tile column windows with local
+    # ids; built on TPU backends when column locality permits.
+    ell_wcols: Optional[jnp.ndarray] = None
+    ell_wvals: Optional[jnp.ndarray] = None
+    ell_wbase: Optional[jnp.ndarray] = None
     # DIA structure: dia_vals[k, i] = A[i, i + dia_offsets[k]] (0 outside)
     dia_vals: Optional[jnp.ndarray] = None
     # dense copy for small unstructured matrices (SpMV = MXU matmul)
@@ -116,6 +118,9 @@ class SparseMatrix:
     n_cols: int = _static_field(default=0)
     block_size: int = _static_field(default=1)
     dia_offsets: Any = _static_field(default=None)  # tuple[int] | None
+    # windowed-ELL column-window width in lanes (static); None = no
+    # windowed arrays
+    ell_wwidth: Any = _static_field(default=None)
     # Static view windows: {ViewType: (row_offset, num_rows)}; populated by the
     # distributed manager.  Single-device matrices map every view to (0, n).
     views: Any = _static_field(default=None)
@@ -176,11 +181,13 @@ class SparseMatrix:
         if self.has_ell:
             ell_vals = _scatter_ell_vals(self, values)
             new = dataclasses.replace(new, ell_vals=ell_vals)
-            if self.ell_tvals is not None:
-                from amgx_tpu.ops.pallas_spmv import tile_ell_jnp
+            if self.ell_wvals is not None:
+                # the windowed layout stores values in plain tiled
+                # order (only columns are localized)
+                from amgx_tpu.ops.pallas_well import tile_ell_jnp
 
                 new = dataclasses.replace(
-                    new, ell_tvals=tile_ell_jnp(ell_vals)
+                    new, ell_wvals=tile_ell_jnp(ell_vals)
                 )
         if self.has_dia:
             new = dataclasses.replace(
@@ -198,8 +205,8 @@ class SparseMatrix:
         )
         if self.has_ell:
             rep["ell_vals"] = self.ell_vals.astype(dtype)
-            if self.ell_tvals is not None:
-                rep["ell_tvals"] = self.ell_tvals.astype(dtype)
+            if self.ell_wvals is not None:
+                rep["ell_wvals"] = self.ell_wvals.astype(dtype)
         if self.has_dia:
             rep["dia_vals"] = self.dia_vals.astype(dtype)
         if self.has_dense:
@@ -262,7 +269,8 @@ class SparseMatrix:
             np.add.at(dense, (row_ids, col_indices), values)
 
         ell_cols = ell_vals = None
-        ell_tcols = ell_tvals = None
+        ell_wcols = ell_wvals = ell_wbase = None
+        ell_wwidth = None
         if (
             build_ell
             and n_rows > 0
@@ -277,9 +285,17 @@ class SparseMatrix:
                     row_offsets, col_indices, values, n_rows, w, b
                 )
                 if b == 1 and w > 0 and _want_tiled_ell(values.dtype):
-                    from amgx_tpu.ops.pallas_spmv import tile_ell
+                    # Windowed tiling needs column locality; matrices
+                    # without it (and huge-bandwidth ones) ride the XLA
+                    # gather path.  AMG setup renumbers coarse unknowns
+                    # (RCM) so Galerkin operators qualify.
+                    from amgx_tpu.ops.pallas_well import build_windowed_ell
 
-                    ell_tcols, ell_tvals = tile_ell(ell_cols, ell_vals)
+                    built = build_windowed_ell(
+                        row_offsets, ell_cols, ell_vals
+                    )
+                    if built is not None:
+                        ell_wcols, ell_wvals, ell_wbase, ell_wwidth = built
 
         dev = jnp.asarray
         return SparseMatrix(
@@ -290,8 +306,10 @@ class SparseMatrix:
             diag=dev(diag),
             ell_cols=None if ell_cols is None else dev(ell_cols),
             ell_vals=None if ell_vals is None else dev(ell_vals),
-            ell_tcols=None if ell_tcols is None else dev(ell_tcols),
-            ell_tvals=None if ell_tvals is None else dev(ell_tvals),
+            ell_wcols=None if ell_wcols is None else dev(ell_wcols),
+            ell_wvals=None if ell_wvals is None else dev(ell_wvals),
+            ell_wbase=None if ell_wbase is None else dev(ell_wbase),
+            ell_wwidth=ell_wwidth,
             dia_vals=None if dia_vals is None else dev(dia_vals),
             dense=None if dense is None else dev(dense),
             n_rows=int(n_rows),
@@ -410,14 +428,21 @@ def _build_ell_np(row_offsets, col_indices, values, n_rows, w, b):
     return ell_cols, ell_vals
 
 
+def dia_gate(num_diags: int, n: int, nnz: int) -> bool:
+    """Single source of truth for DIA structure acceptance: few distinct
+    diagonals with acceptable padding.  Shared with ops.reorder's
+    would-build prediction."""
+    return (
+        num_diags <= _DIA_MAX_DIAGS
+        and num_diags * n <= _DIA_MAX_OVERHEAD * max(nnz, 1)
+    )
+
+
 def _try_build_dia_np(row_offsets, col_indices, values, row_ids, n):
     """DIA structure if few distinct diagonals with acceptable padding."""
     offs = col_indices.astype(np.int64) - row_ids.astype(np.int64)
     uniq = np.unique(offs)
-    if uniq.shape[0] > _DIA_MAX_DIAGS:
-        return None, None
-    nnz = col_indices.shape[0]
-    if uniq.shape[0] * n > _DIA_MAX_OVERHEAD * nnz:
+    if not dia_gate(uniq.shape[0], n, col_indices.shape[0]):
         return None, None
     dia_vals = np.zeros((uniq.shape[0], n), dtype=values.dtype)
     k = np.searchsorted(uniq, offs)

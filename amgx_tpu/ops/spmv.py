@@ -46,22 +46,31 @@ def spmv(A: SparseMatrix, x: jnp.ndarray, n_rows: int | None = None):
 
 def _spmv_scalar(A, x):
     if A.has_dia:
+        if A.values.dtype in (jnp.float32, jnp.bfloat16):
+            from amgx_tpu.ops.pallas_dia import (
+                dia_kernel_eligible,
+                pallas_dia_spmv,
+                pallas_dia_supported,
+            )
+
+            if dia_kernel_eligible(A) and pallas_dia_supported():
+                return pallas_dia_spmv(A, x)
         return _spmv_dia(A, x)
     if A.has_dense:
         # small unstructured matrices: one MXU matmul beats TPU gathers
         return A.dense @ x
     if A.has_ell:
-        if A.ell_tcols is not None and A.values.dtype in (
+        if A.ell_wcols is not None and A.values.dtype in (
             jnp.float32,
             jnp.bfloat16,
         ):
-            from amgx_tpu.ops.pallas_spmv import (
-                pallas_ell_spmv,
-                pallas_spmv_supported,
+            from amgx_tpu.ops.pallas_well import (
+                pallas_well_spmv,
+                pallas_well_supported,
             )
 
-            if pallas_spmv_supported():
-                return pallas_ell_spmv(A, x)
+            if pallas_well_supported():
+                return pallas_well_spmv(A, x)
         xg = x[A.ell_cols]  # (n, w)
         return jnp.sum(A.ell_vals * xg, axis=1)
     contrib = A.values * x[A.col_indices]

@@ -84,6 +84,9 @@ class Solver:
         self.rel_div_tolerance = float(g("rel_div_tolerance"))
         self.alt_rel_tolerance = float(g("alt_rel_tolerance"))
         self.scaling = str(g("scaling"))
+        # overwritten to NONE by make_nested: only the outermost solve()
+        # boundary may renumber unknowns
+        self.reordering = str(g("matrix_reordering"))
         self._conv_check = make_convergence_check(
             self.conv_type, self.tolerance, self.alt_rel_tolerance
         )
@@ -307,6 +310,7 @@ class Solver:
     def setup(self, A: SparseMatrix):
         t0 = time.perf_counter()
         self._scale_vecs = None
+        self._reorder = None
         if self.scaling.upper() not in ("", "NONE"):
             # scale the system at setup (reference Scaler::setup hook,
             # solver.cu:667-676): work on As = Dr A Dc
@@ -323,6 +327,17 @@ class Solver:
             )
             self._scale_vecs = (jnp.asarray(r.astype(sp.dtype)),
                                 jnp.asarray(c.astype(sp.dtype)))
+        reorder_mode = self.reordering
+        if reorder_mode.upper() != "NONE":
+            # RCM renumbering at the solve boundary (same hook as the
+            # scaler): unlocks the windowed gather kernel on TPU
+            from amgx_tpu.ops.reorder import maybe_reorder
+
+            A2, perm = maybe_reorder(A, reorder_mode)
+            if perm is not None:
+                iperm = np.argsort(perm)
+                self._reorder = (jnp.asarray(perm), jnp.asarray(iperm))
+                A = A2
         self.A = A
         self._setup_impl(A)
         self._jit_cache.clear()
@@ -344,6 +359,10 @@ class Solver:
             r_s, c_s = self._scale_vecs
             b = r_s * b
             x0 = x0 / jnp.where(c_s != 0, c_s, 1.0)
+        if self._reorder is not None:
+            perm, _ = self._reorder
+            b = b[perm]
+            x0 = x0[perm]
         key = (b.shape, b.dtype.name)
         fn = self._jit_cache.get(key)
         if fn is None:
@@ -351,6 +370,8 @@ class Solver:
             self._jit_cache[key] = fn
         t0 = time.perf_counter()
         res = fn(self.apply_params(), b, x0)
+        if self._reorder is not None:
+            res = dataclasses.replace(res, x=res.x[self._reorder[1]])
         if self._scale_vecs is not None:
             res = dataclasses.replace(res, x=self._scale_vecs[1] * res.x)
         res.x.block_until_ready()

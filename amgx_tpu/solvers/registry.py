@@ -53,8 +53,12 @@ def create_solver(cfg, scope: str = "default", param: str = "solver"):
 
 def make_nested(solver):
     """Mark a solver as nested (preconditioner / smoother / coarse / inner
-    eigensolver solver).  Nested solvers never re-scale: the outer solver
-    already works on the scaled operator (reference 'scaled' guard,
-    solver.cu:452-467).  Single enforcement point for the invariant."""
+    eigensolver solver).  Nested solvers never re-scale (the outer solver
+    already works on the scaled operator — reference 'scaled' guard,
+    solver.cu:452-467) and never re-order: their make_apply/make_smooth
+    pure functions receive vectors in the OUTER ordering, which only the
+    outer solve() boundary permutes.  Single enforcement point for both
+    invariants."""
     solver.scaling = "NONE"
+    solver.reordering = "NONE"
     return solver

@@ -1,0 +1,119 @@
+"""Windowed ELL Pallas kernel tests (interpret mode on CPU).
+
+Reference parity: cuSPARSE bsrmv (amgx_cusparse.cu:49-102) for
+unstructured matrices with column locality — the hot gather-bound case
+is AMG coarse Galerkin operators, which setup renumbers for locality.
+Sizes sit above the dense-acceleration cutoff (4096 rows) so the ELL
+structures are actually built.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.ops import pallas_well as pw
+
+
+def _banded_random(n, w, bw, seed=7):
+    """Random matrix whose columns stay within +-bw of the diagonal."""
+    rng = np.random.default_rng(seed)
+    r = np.repeat(np.arange(n), w)
+    c = np.clip(r + rng.integers(-bw, bw + 1, r.shape), 0, n - 1)
+    v = rng.standard_normal(r.shape)
+    m = sps.coo_matrix((v, (r, c)), shape=(n, n)).tocsr()
+    m.sum_duplicates()
+    m.sort_indices()
+    return m
+
+
+@pytest.fixture
+def tiled_env(monkeypatch):
+    monkeypatch.setenv("AMGX_TPU_TILED_ELL", "1")
+
+
+def test_tile_ell_layout():
+    cols = np.arange(12, dtype=np.int64).reshape(6, 2)
+    vals = np.arange(12, dtype=np.float64).reshape(6, 2)
+    tc, tv = pw.tile_ell(cols, vals)
+    assert tc.shape == (1, 8, 2 * 128)
+    # row r, slot k lives at lane k*128 + r of sublane r//128 (here 0)
+    assert tc[0, 0, 0 * 128 + 3] == cols[3, 0]
+    assert tc[0, 0, 1 * 128 + 3] == cols[3, 1]
+    assert tv[0, 0, 1 * 128 + 5] == vals[5, 1]
+    # padding rows are zero
+    assert tv[0, 0, 0 * 128 + 6] == 0.0
+
+
+def test_build_windowed_basic(tiled_env):
+    m = _banded_random(6000, 5, 300)
+    A = SparseMatrix.from_scipy(m, dtype=np.float32)
+    assert A.ell_wcols is not None and A.ell_wwidth is not None
+    assert A.ell_wwidth % 128 == 0
+    # local ids in range
+    assert int(np.asarray(A.ell_wcols).max()) < A.ell_wwidth
+    # window bases lane-aligned
+    assert np.all(np.asarray(A.ell_wbase) % 128 == 0)
+
+
+def test_no_window_when_no_locality(tiled_env):
+    """Column structure spanning far beyond the window cap: no windowed
+    arrays; the matrix rides the XLA ELL path."""
+    rng = np.random.default_rng(3)
+    n = 40000
+    m = sps.random(n, n, density=4e-4, random_state=rng,
+                   format="csr") + sps.eye_array(n) * 3.0
+    m = m.tocsr()
+    m.sort_indices()
+    A = SparseMatrix.from_scipy(m, dtype=np.float32)
+    assert A.has_ell
+    assert A.ell_wcols is None
+
+
+def test_windowed_spmv_interpret(tiled_env):
+    m = _banded_random(6000, 6, 500, seed=11)
+    A = SparseMatrix.from_scipy(m, dtype=np.float32)
+    assert A.ell_wcols is not None
+    x = np.random.default_rng(5).standard_normal(6000).astype(np.float32)
+    y = pw.pallas_well_spmv(A, x, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y), m @ x, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_windowed_empty_rows_interpret(tiled_env):
+    """Rows with no entries and a ragged final tile."""
+    n = 5100
+    rng = np.random.default_rng(9)
+    r = np.repeat(np.arange(0, n, 3), 2)
+    c = np.clip(r + rng.integers(-40, 41, r.shape), 0, n - 1)
+    v = rng.standard_normal(r.shape)
+    m = sps.coo_matrix((v, (r, c)), shape=(n, n)).tocsr()
+    m.sum_duplicates()
+    m.sort_indices()
+    A = SparseMatrix.from_scipy(m, dtype=np.float32)
+    assert A.ell_wcols is not None
+    x = rng.standard_normal(n).astype(np.float32)
+    y = pw.pallas_well_spmv(A, x, interpret=True)
+    np.testing.assert_allclose(np.asarray(y), m @ x, rtol=2e-4, atol=2e-4)
+
+
+def test_replace_values_refreshes_windowed(tiled_env):
+    m = _banded_random(5200, 4, 200, seed=2)
+    A = SparseMatrix.from_scipy(m, dtype=np.float32)
+    assert A.ell_wvals is not None
+    A2 = A.replace_values(np.asarray(A.values) * -0.5)
+    x = np.random.default_rng(1).standard_normal(5200).astype(np.float32)
+    y = pw.pallas_well_spmv(A2, x, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y), -0.5 * (m @ x), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_cpu_backend_skips_windowed_build():
+    """Without the env override, CPU builds no windowed arrays and the
+    dispatcher stays on the XLA path."""
+    m = _banded_random(5000, 5, 300)
+    A = SparseMatrix.from_scipy(m, dtype=np.float32)
+    assert A.ell_wcols is None
+    assert not pw.pallas_well_supported()
