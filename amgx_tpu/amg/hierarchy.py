@@ -48,6 +48,10 @@ class AMGLevel:
         self.P: SparseMatrix | None = None
         self.R: SparseMatrix | None = None
         self.smoother: Solver | None = None
+        # device numeric-Galerkin plan to the NEXT level (structure
+        # reuse; amg/spgemm.py); None when the pattern can't be planned
+        # (e.g. truncated interpolation drops product entries)
+        self.rap_plan = None
 
     @property
     def n_rows(self):
@@ -86,6 +90,10 @@ class AMGSolver(Solver):
         # not self.reordering — make_nested neutralizes only the
         # solve-boundary permutation.
         self.coarse_reorder = str(g("matrix_reordering")).upper()
+        # structure_reuse_levels (reference amg_config): 0 = resetup
+        # rebuilds everything; k > 0 = the top k Galerkin products
+        # re-evaluate on device (amg/spgemm.py plans); < 0 = all levels
+        self.structure_reuse = int(g("structure_reuse_levels"))
         if self.intensive_smoothing:
             self.presweeps = max(self.presweeps, 4)
             self.postsweeps = max(self.postsweeps, 4)
@@ -133,7 +141,12 @@ class AMGSolver(Solver):
 
         A = scalarized(A, "AMG")
         self.levels = [AMGLevel(A, 0)]
-        Asp = A.to_scipy()
+        self._coarsen_from(A.to_scipy())
+        self._finalize_setup()
+
+    def _coarsen_from(self, Asp):
+        """Extend ``self.levels`` by coarsening from the last level
+        (whose host CSR is ``Asp``) until a stop condition hits."""
         # reference amg.cu:207-230: when the coarse solver is dense LU,
         # coarsening stops once the level fits the dense trigger size
         coarse_name, _ = self.cfg.get_scoped("coarse_solver", self.scope)
@@ -163,11 +176,28 @@ class AMGSolver(Solver):
             lvl.P = SparseMatrix.from_scipy(P.astype(dtype))
             lvl.R = SparseMatrix.from_scipy(R.astype(dtype))
             Ac = Ac.astype(dtype)
+            if self.structure_reuse != 0:
+                lvl.rap_plan = self._try_plan_rap(R, Asp, P, Ac)
             self.levels.append(
                 AMGLevel(SparseMatrix.from_scipy(Ac), len(self.levels))
             )
             Asp = Ac
 
+    @staticmethod
+    def _try_plan_rap(R, Asp, P, Ac):
+        """Numeric-Galerkin plan for structure reuse, or None when the
+        stored coarse pattern doesn't cover the product (truncation,
+        geometric dense-reduction with dropped entries)."""
+        from amgx_tpu.amg.spgemm import plan_rap
+
+        try:
+            Acc = Ac.tocsr().copy()
+            Acc.sort_indices()
+            return plan_rap(R.tocsr(), Asp.tocsr(), P.tocsr(), Acc)
+        except ValueError:
+            return None
+
+    def _finalize_setup(self):
         # smoothers on all but the coarsest; coarse solver on the last
         for lvl in self.levels[:-1]:
             lvl.smoother = self._make_smoother(lvl.A)
@@ -182,6 +212,40 @@ class AMGSolver(Solver):
             from amgx_tpu.core.printing import emit
 
             emit(self.grid_stats())
+
+    def _resetup_impl(self, A: SparseMatrix) -> bool:
+        """Values-only refresh (reference structure_reuse_levels /
+        replace_coefficients): re-evaluate the top Galerkin products on
+        device via the stored plans, rebuild any unplanned tail on host."""
+        if self.structure_reuse == 0 or not self.levels:
+            return False
+        from amgx_tpu.ops.diagonal import scalarized
+
+        A = scalarized(A, "AMG")
+        lvl0 = self.levels[0]
+        if A.n_rows != lvl0.A.n_rows or A.nnz != lvl0.A.nnz:
+            return False
+        lvl0.A = lvl0.A.replace_values(A.values)
+        depth = len(self.levels) - 1
+        if self.structure_reuse > 0:
+            depth = min(self.structure_reuse, depth)
+        i = 0
+        while i < depth and self.levels[i].rap_plan is not None:
+            lvl = self.levels[i]
+            ac_vals = lvl.rap_plan.apply(
+                lvl.R.values, lvl.A.values, lvl.P.values
+            )
+            nxt = self.levels[i + 1]
+            nxt.A = nxt.A.replace_values(ac_vals)
+            i += 1
+        if i < len(self.levels) - 1:
+            # tail not refreshable in place: re-coarsen from level i
+            del self.levels[i + 1:]
+            self.levels[i].P = self.levels[i].R = None
+            self.levels[i].rap_plan = None
+            self._coarsen_from(self.levels[i].A.to_scipy())
+        self._finalize_setup()
+        return True
 
     def _collect_params(self):
         per_level = []

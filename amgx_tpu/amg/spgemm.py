@@ -1,0 +1,152 @@
+"""Fixed-pattern sparse-sparse products on device (numeric SpGEMM).
+
+Reference parity: CSR_Multiply / csr_multiply_detail.cu (2.6k lines of
+hash-table SpGEMM) and the setup's Galerkin products
+(classical_amg_level.cu computeAOperator).  TPU-first split:
+
+  * The SYMBOLIC phase (output pattern discovery) runs on host at
+    setup, where scipy already computes the product structure — a hash
+    SpGEMM on TPU would fight the hardware (dynamic shapes, scatter).
+  * The NUMERIC phase is compiled to the device as a *plan*: for a
+    fixed pattern, every output nonzero is a sum over a fixed list of
+    (left_nnz, right_nnz) contribution pairs.  The plan stores those
+    index lists sorted by output position, so re-evaluating the product
+    for NEW VALUES is three gathers and one ordered segment-sum — fully
+    jittable, no host round-trip.
+
+This powers ``structure_reuse_levels`` (reference amg_level resetup):
+when coefficients change but the mesh/pattern doesn't, the whole
+Galerkin chain A -> R A P per level re-evaluates on device.
+
+RAP is planned in two stages (AP, then R(AP)) — the three-factor path
+list would be |paths(R)|x|paths(AP)| long, while staging through the AP
+pattern keeps plan memory O(paths(A,P)) + O(paths(R,AP)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _csr_expand(indptr, take):
+    """For each element e of ``take`` (row ids into a CSR), the flat
+    index ranges [indptr[r], indptr[r+1]) concatenated; plus the repeat
+    counts."""
+    counts = (indptr[take + 1] - indptr[take]).astype(np.int64)
+    total = int(counts.sum())
+    out_starts = np.zeros(len(take) + 1, dtype=np.int64)
+    np.cumsum(counts, out=out_starts[1:])
+    seg = np.repeat(np.arange(len(take), dtype=np.int64), counts)
+    offset_in_seg = np.arange(total, dtype=np.int64) - out_starts[seg]
+    return indptr[take[seg]].astype(np.int64) + offset_in_seg, seg, counts
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SpMMPlan:
+    """Numeric plan for ``Out = B @ C`` with fixed CSR patterns.
+
+    left_idx/right_idx: (T,) flat nnz indices into B.data / C.data
+    out_idx:            (T,) output nnz positions, sorted ascending
+    """
+
+    left_idx: jnp.ndarray
+    right_idx: jnp.ndarray
+    out_idx: jnp.ndarray
+    nnz_out: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    def apply(self, b_vals, c_vals):
+        contrib = b_vals[self.left_idx] * c_vals[self.right_idx]
+        return jax.ops.segment_sum(
+            contrib,
+            self.out_idx,
+            num_segments=self.nnz_out,
+            indices_are_sorted=True,
+        )
+
+    @property
+    def n_paths(self) -> int:
+        return int(self.left_idx.shape[0])
+
+
+def plan_spmm(Bsp, Csp, Outsp) -> SpMMPlan:
+    """Build the numeric plan for ``Outsp = Bsp @ Csp`` (host, numpy).
+
+    ``Outsp`` must be the scipy product's CSR structure (canonical,
+    sorted indices); its values are ignored.
+    """
+    B = Bsp.tocsr()
+    C = Csp.tocsr()
+    Out = Outsp.tocsr()
+    assert B.shape[1] == C.shape[0] and Out.shape == (
+        B.shape[0],
+        C.shape[1],
+    )
+    nb = B.indices.shape[0]
+    # paths: for each B nnz e = (i, k), all C row-k entries (k, j)
+    c_flat, seg, _ = _csr_expand(
+        C.indptr.astype(np.int64), B.indices.astype(np.int64)
+    )
+    b_idx = seg  # seg IS the B nnz id (expansion is B-nnz major)
+    # output row of each path = B row of e
+    b_rows = np.repeat(
+        np.arange(B.shape[0], dtype=np.int64), np.diff(B.indptr)
+    )
+    rows = b_rows[b_idx]
+    cols = C.indices[c_flat].astype(np.int64)
+    # locate (rows, cols) in Out's CSR: key = row*(ncols+1) + col is
+    # strictly increasing in canonical CSR order, so one global
+    # searchsorted finds every path's output slot
+    ncols = Out.shape[1]
+    out_keys = (
+        np.repeat(
+            np.arange(Out.shape[0], dtype=np.int64), np.diff(Out.indptr)
+        )
+        * (ncols + 1)
+        + Out.indices.astype(np.int64)
+    )
+    path_keys = rows * (ncols + 1) + cols
+    pos = np.searchsorted(out_keys, path_keys)
+    if not (
+        (pos < out_keys.shape[0]).all() and (out_keys[pos] == path_keys).all()
+    ):
+        raise ValueError("Outsp pattern does not cover the product")
+    order = np.argsort(pos, kind="stable")
+    return SpMMPlan(
+        left_idx=jnp.asarray(b_idx[order].astype(np.int32)),
+        right_idx=jnp.asarray(c_flat[order].astype(np.int32)),
+        out_idx=jnp.asarray(pos[order].astype(np.int32)),
+        nnz_out=int(Out.indices.shape[0]),
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RAPPlan:
+    """Two-stage numeric Galerkin plan: ``Ac = R @ (A @ P)`` with all
+    four patterns fixed (reference computeAOperator; structure reuse)."""
+
+    ap: SpMMPlan  # A @ P  -> AP pattern
+    rap: SpMMPlan  # R @ AP -> Ac pattern
+
+    def apply(self, r_vals, a_vals, p_vals):
+        ap_vals = self.ap.apply(a_vals, p_vals)
+        return self.rap.apply(r_vals, ap_vals)
+
+
+def plan_rap(Rsp, Asp, Psp, Acsp) -> RAPPlan:
+    """Host symbolic phase for the Galerkin product (scipy structures).
+
+    ``Acsp`` must be (or cover) the structure of ``R @ A @ P`` —
+    exactly what setup computed it as.
+    """
+    APsp = (Asp.tocsr() @ Psp.tocsr()).tocsr()
+    APsp.sort_indices()
+    return RAPPlan(
+        ap=plan_spmm(Asp, Psp, APsp),
+        rap=plan_spmm(Rsp, APsp, Acsp),
+    )

@@ -885,11 +885,29 @@ def solver_get_iteration_residual(slv_h: int, it: int, idx: int = 0):
 @_traced
 def solver_resetup(slv_h: int, mtx_h: int):
     """Refresh the solver for a matrix whose VALUES changed but whose
-    structure is intact (reference AMGX_solver_resetup, amgx_c.h:604-607;
-    structure_reuse path).  Falls back to full setup — the jit cache keys
-    on shapes, so unchanged structure re-dispatches without recompiling
-    the solve."""
-    return solver_setup(slv_h, mtx_h)
+    structure is intact (reference AMGX_solver_resetup, amgx_c.h:604-607).
+    With structure_reuse_levels != 0 the AMG Galerkin chain re-evaluates
+    on device (amg/spgemm.py plans); otherwise falls back to full setup
+    — the jit cache keys on shapes, so unchanged structure re-dispatches
+    without recompiling the solve."""
+    from amgx_tpu.solvers.base import Solver as _Solver
+
+    s = _get(slv_h, _SolverHandle)
+    m = _get(mtx_h, _Matrix)
+    if (
+        s.solver is None
+        or not isinstance(s.solver, _Solver)  # e.g. _DistSolver
+        or (m.global_sp is not None and s.res.n_devices > 1)
+    ):
+        return solver_setup(slv_h, mtx_h)
+    if m.A is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+    A = m.A
+    if np.dtype(A.values.dtype) != np.dtype(s.mode.mat_dtype):
+        A = A.astype(s.mode.mat_dtype)
+    s.solver.resetup(A)
+    s.matrix = m
+    return RC_OK
 
 
 def solver_destroy(slv_h):

@@ -113,7 +113,12 @@ class DistributedAMG:
         from amgx_tpu.amg.hierarchy import AMGSolver
         from amgx_tpu.core.matrix import SparseMatrix
 
-        tail_amg = AMGSolver(self.cfg, self.scope)
+        from amgx_tpu.solvers.registry import make_nested
+
+        # nested: the distributed cycle feeds residuals in the
+        # consolidated ordering directly into make_cycle(), bypassing
+        # solve()'s permute/unpermute — the tail must never reorder
+        tail_amg = make_nested(AMGSolver(self.cfg, self.scope))
         tail_amg.setup(SparseMatrix.from_scipy(self.h.tail_matrix))
         self.tail_amg = tail_amg
         self._tail_cycle = tail_amg.make_cycle()

@@ -49,6 +49,10 @@ except Exception:  # pragma: no cover
 
 _LANE = 128
 _ROW_BLOCK = 64 * 1024  # rows per grid step (f32: 256 KB out block)
+# VMEM budget for the double-buffered diagonal-values block
+# (2 * nd * R * 4 bytes must stay well under the ~16 MB/core VMEM);
+# R shrinks for many-diagonal matrices.
+_VALS_VMEM_BUDGET = 8 * 1024 * 1024
 # Max one-sided halo (in rows). Window buffer = R + 2*halo + spill row;
 # 64K + 2*1M rows would blow VMEM, so matrices with bandwidth beyond
 # this use the XLA path. 256K rows halo -> (64K+512K+128)*4B = 2.3 MB.
@@ -102,7 +106,8 @@ def _pallas_dia_spmv(dia_vals, x, offsets, n, interpret=False):
     nd = len(offsets)
     halo_lo = _pad_up(max(0, -min(offsets)), _LANE)
     halo_hi = _pad_up(max(0, max(offsets)), _LANE)
-    R = min(_ROW_BLOCK, _pad_up(n, 1024))
+    r_cap = max(1024, _VALS_VMEM_BUDGET // (8 * nd) // 1024 * 1024)
+    R = min(_ROW_BLOCK, r_cap, _pad_up(n, 1024))
     m = R // _LANE
     nt = -(-n // R)
     npad = nt * R
@@ -151,45 +156,28 @@ def dia_kernel_eligible(A) -> bool:
     return max(abs(o) for o in offs) <= _HALO_MAX
 
 
-class _Probe:
-    """Once-per-backend compile-and-run probe for the kernel."""
-
-    def __init__(self):
-        self._ok = {}
-
-    def __call__(self) -> bool:
-        if not _HAVE_PALLAS:
-            return False
-        backend = jax.default_backend()
-        if backend not in self._ok:
-            if backend != "tpu":
-                self._ok[backend] = False
-            else:
-                try:
-                    n = 4096
-                    offs = (-64, -1, 0, 1, 64)
-                    rng = np.random.default_rng(0)
-                    dv = np.zeros((len(offs), n), np.float32)
-                    for k, o in enumerate(offs):
-                        lo, hi = max(0, -o), n - max(0, o)
-                        dv[k, lo:hi] = rng.standard_normal(hi - lo)
-                    x = rng.standard_normal(n).astype(np.float32)
-                    y = np.asarray(_pallas_dia_spmv(
-                        jnp.asarray(dv), jnp.asarray(x), offs, n
-                    ))
-                    ref = np.zeros(n, np.float32)
-                    for k, o in enumerate(offs):
-                        lo, hi = max(0, -o), n - max(0, o)
-                        ref[lo:hi] += dv[k, lo:hi] * x[lo + o:hi + o]
-                    self._ok[backend] = bool(
-                        np.allclose(y, ref, rtol=1e-5, atol=1e-5)
-                    )
-                except Exception:
-                    self._ok[backend] = False
-        return self._ok[backend]
+def _probe_trial() -> bool:
+    n = 4096
+    offs = (-64, -1, 0, 1, 64)
+    rng = np.random.default_rng(0)
+    dv = np.zeros((len(offs), n), np.float32)
+    for k, o in enumerate(offs):
+        lo, hi = max(0, -o), n - max(0, o)
+        dv[k, lo:hi] = rng.standard_normal(hi - lo)
+    x = rng.standard_normal(n).astype(np.float32)
+    y = np.asarray(_pallas_dia_spmv(
+        jnp.asarray(dv), jnp.asarray(x), offs, n
+    ))
+    ref = np.zeros(n, np.float32)
+    for k, o in enumerate(offs):
+        lo, hi = max(0, -o), n - max(0, o)
+        ref[lo:hi] += dv[k, lo:hi] * x[lo + o:hi + o]
+    return np.allclose(y, ref, rtol=1e-5, atol=1e-5)
 
 
-pallas_dia_supported = _Probe()
+from amgx_tpu.ops.pallas_probe import KernelProbe  # noqa: E402
+
+pallas_dia_supported = KernelProbe(_probe_trial, _HAVE_PALLAS)
 
 
 def pallas_dia_spmv(A, x, interpret=False):

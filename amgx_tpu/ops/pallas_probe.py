@@ -1,0 +1,38 @@
+"""Once-per-backend compile-and-run probes for Pallas kernels.
+
+Mosaic support for the kernels' primitives (wide DMA, lane rotations,
+dynamic lane gathers) varies by TPU generation and jaxlib, so each
+kernel module registers a small trial; the result is cached per
+backend and the dispatcher falls back to the XLA path when the trial
+fails.  Shared so the guard/caching logic can't drift between kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+
+class KernelProbe:
+    """Callable returning whether ``trial`` compiles AND returns
+    correct results on the current backend (TPU only; cached)."""
+
+    def __init__(self, trial: Callable[[], bool], have_pallas: bool):
+        self._trial = trial
+        self._have = have_pallas
+        self._ok: dict = {}
+
+    def __call__(self) -> bool:
+        if not self._have:
+            return False
+        backend = jax.default_backend()
+        if backend not in self._ok:
+            if backend != "tpu":
+                self._ok[backend] = False
+            else:
+                try:
+                    self._ok[backend] = bool(self._trial())
+                except Exception:
+                    self._ok[backend] = False
+        return self._ok[backend]

@@ -200,47 +200,30 @@ def _pallas_well_spmv(tcols, tvals, bases, x, n_rows, W, interpret=False):
     return out.reshape(nt * _ROW_TILE)[:n_rows]
 
 
-class _Probe:
-    """Once-per-backend compile-and-run probe for the kernel."""
-
-    def __init__(self):
-        self._ok = {}
-
-    def __call__(self) -> bool:
-        if not _HAVE_PALLAS:
-            return False
-        backend = jax.default_backend()
-        if backend not in self._ok:
-            if backend != "tpu":
-                self._ok[backend] = False
-            else:
-                try:
-                    rng = np.random.default_rng(0)
-                    n, w, bw = 2048, 3, 200
-                    r = np.arange(n)
-                    cols = np.clip(
-                        r[:, None] + rng.integers(-bw, bw, (n, w)), 0, n - 1
-                    )
-                    vals = rng.standard_normal((n, w)).astype(np.float32)
-                    ro = np.arange(0, (n + 1) * w, w, dtype=np.int64)
-                    built = build_windowed_ell(ro, cols, vals)
-                    assert built is not None
-                    tc, tv, bases, W = built
-                    x = np.arange(n, dtype=np.float32)
-                    y = _pallas_well_spmv(
-                        jnp.asarray(tc), jnp.asarray(tv),
-                        jnp.asarray(bases), jnp.asarray(x), n, W,
-                    )
-                    ref = (vals * x[cols]).sum(1)
-                    self._ok[backend] = bool(
-                        np.allclose(np.asarray(y), ref, rtol=1e-5)
-                    )
-                except Exception:
-                    self._ok[backend] = False
-        return self._ok[backend]
+def _probe_trial() -> bool:
+    rng = np.random.default_rng(0)
+    n, w, bw = 2048, 3, 200
+    r = np.arange(n)
+    cols = np.clip(
+        r[:, None] + rng.integers(-bw, bw, (n, w)), 0, n - 1
+    )
+    vals = rng.standard_normal((n, w)).astype(np.float32)
+    ro = np.arange(0, (n + 1) * w, w, dtype=np.int64)
+    built = build_windowed_ell(ro, cols, vals)
+    assert built is not None
+    tc, tv, bases, W = built
+    x = np.arange(n, dtype=np.float32)
+    y = _pallas_well_spmv(
+        jnp.asarray(tc), jnp.asarray(tv),
+        jnp.asarray(bases), jnp.asarray(x), n, W,
+    )
+    ref = (vals * x[cols]).sum(1)
+    return np.allclose(np.asarray(y), ref, rtol=1e-5)
 
 
-pallas_well_supported = _Probe()
+from amgx_tpu.ops.pallas_probe import KernelProbe  # noqa: E402
+
+pallas_well_supported = KernelProbe(_probe_trial, _HAVE_PALLAS)
 
 
 def pallas_well_spmv(A, x, interpret=False):

@@ -344,6 +344,32 @@ class Solver:
         self.setup_time = time.perf_counter() - t0
         return self
 
+    def resetup(self, A: SparseMatrix):
+        """Refresh for a matrix whose VALUES changed but whose structure
+        is intact (reference AMGX_solver_resetup / structure_reuse).
+        Subclasses take fast paths via ``_resetup_impl``; anything that
+        can't falls back to a full setup."""
+        if (
+            self.A is None
+            or self._scale_vecs is not None
+            or self._reorder is not None
+            or A.n_rows != self.A.n_rows
+            or A.nnz != self.A.nnz
+            or A.block_size != self.A.block_size
+        ):
+            return self.setup(A)
+        t0 = time.perf_counter()
+        if not self._resetup_impl(A):
+            return self.setup(A)
+        self.A = A
+        self._jit_cache.clear()
+        self.setup_time = time.perf_counter() - t0
+        return self
+
+    def _resetup_impl(self, A: SparseMatrix) -> bool:
+        """Attempt a values-only refresh; False -> caller runs setup."""
+        return False
+
     def apply_params(self):
         return self._params
 

@@ -52,6 +52,16 @@ class KrylovSolver(Solver):
         else:
             self._params = (A, None)
 
+    def _resetup_impl(self, A):
+        """Values-only refresh: delegate to the preconditioner (which
+        falls back to its own full setup when it has no fast path)."""
+        if self.precond is not None:
+            self.precond.resetup(A)
+            self._params = (A, self.precond.apply_params())
+        else:
+            self._params = (A, None)
+        return True
+
     def _make_M(self):
         """Pure fn(Mp, r) -> z; identity when unpreconditioned."""
         if self.precond is None:
