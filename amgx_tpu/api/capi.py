@@ -133,6 +133,9 @@ class _Matrix:
         self.global_sp = None
         self.owner = None
         self.grid = None
+        # per-rank partial-upload accumulation (rank-order calls)
+        self.pending_parts = None
+        self.pending_owner = None
 
 
 class _Distribution:
@@ -458,12 +461,6 @@ def _upload_global(
     """
     import scipy.sparse as sps
 
-    if n != n_global:
-        raise AMGXError(
-            RC_NOT_IMPLEMENTED,
-            "per-rank partial upload needs a multi-process launch; "
-            "upload the full system once (n == n_global)",
-        )
     mat_dt = m.mode.mat_dtype
     rp = _as_array(row_ptrs, np.int32, n + 1)
     ci = _as_array(col_indices_global, col_dtype, nnz)
@@ -472,6 +469,16 @@ def _upload_global(
         raise AMGXError(
             RC_NOT_SUPPORTED_BLOCKSIZE,
             "distributed upload: scalar matrices only for now",
+        )
+    if n != n_global:
+        # per-rank partial upload (reference: each rank calls with ITS
+        # rows).  Single-process embodiment: call once per partition in
+        # rank order; this call carries the rows of partition
+        # len(m.pending_parts).  Assembly completes when the row count
+        # reaches n_global.
+        return _upload_global_partial(
+            m, n_global, n, rp, ci, vals, diag_data, partition_vector,
+            mat_dt,
         )
     if diag_data is not None:
         dg = _as_array(diag_data, mat_dt, n * b * b)
@@ -495,6 +502,82 @@ def _upload_global(
         else _as_array(partition_vector, np.int32, n)
     )
     m.A = SparseMatrix.from_scipy(sp)  # single-chip fallback view
+    return RC_OK
+
+
+def _upload_global_partial(
+    m, n_global, n, rp, ci, vals, diag_data, partition_vector, mat_dt
+):
+    """Accumulate one partition's rows (rank-order calls); assemble the
+    global system when all rows have arrived.  A zero-row call after
+    assembly completed is a trailing empty rank: no-op."""
+    import scipy.sparse as sps
+
+    if m.pending_parts is None:
+        if n == 0 and m.global_sp is not None:
+            return RC_OK
+        m.pending_parts = []
+        m.pending_owner = None
+    if partition_vector is not None:
+        m.pending_owner = _as_array(partition_vector, np.int32, n_global)
+    dg = (
+        None
+        if diag_data is None
+        else _as_array(diag_data, mat_dt, n)
+    )
+    m.pending_parts.append((n, rp, ci.astype(np.int64), vals, dg))
+    total = sum(p[0] for p in m.pending_parts)
+    if total < n_global:
+        return RC_OK
+    if total > n_global:
+        m.pending_parts = None
+        raise AMGXError(
+            RC_BAD_PARAMETERS,
+            f"partial uploads cover {total} rows > n_global={n_global}",
+        )
+    n_parts = len(m.pending_parts)
+    owner = m.pending_owner
+    if owner is None:
+        # contiguous blocks in call order
+        sizes = np.array([p[0] for p in m.pending_parts], np.int64)
+        owner = np.repeat(
+            np.arange(n_parts, dtype=np.int32), sizes
+        )
+    rows_of = [
+        np.nonzero(owner == p)[0].astype(np.int64)
+        for p in range(n_parts)
+    ]
+    if any(
+        len(rows_of[p]) != m.pending_parts[p][0] for p in range(n_parts)
+    ):
+        m.pending_parts = None
+        raise AMGXError(
+            RC_BAD_PARAMETERS,
+            "partition row counts do not match the uploaded blocks "
+            "(partial uploads must arrive in rank order)",
+        )
+    gr, gc, gv = [], [], []
+    for p, (np_, rp_, ci_, v_, dg_) in enumerate(m.pending_parts):
+        lrows = np.repeat(
+            rows_of[p], np.diff(rp_).astype(np.int64)
+        )
+        gr.append(lrows)
+        gc.append(ci_)
+        gv.append(v_)
+        if dg_ is not None:
+            gr.append(rows_of[p])
+            gc.append(rows_of[p])
+            gv.append(dg_)
+    sp = sps.csr_matrix(
+        (np.concatenate(gv), (np.concatenate(gr), np.concatenate(gc))),
+        shape=(n_global, n_global),
+    )
+    sp.sum_duplicates()
+    sp.sort_indices()
+    m.global_sp = sp
+    m.owner = owner
+    m.A = SparseMatrix.from_scipy(sp)  # single-chip fallback view
+    m.pending_parts = None
     return RC_OK
 
 
@@ -526,6 +609,7 @@ def matrix_upload_all_global(
     )
 
 
+@_traced
 def matrix_upload_all_global_32(
     mtx_h: int,
     n_global: int,

@@ -3,19 +3,22 @@
 Reference parity: multiply(A, B, C, view) with block-size dispatch
 (src/multiply.cu:49-110) and cuSPARSE bsrmv (src/amgx_cusparse.cu:49-145).
 
-TPU formulation: two data layouts, both fully static-shape and jittable.
+TPU formulation: static-shape, jittable layouts ordered by speed.
 
-  * ELL path (preferred): fixed-width padded rows.  ``x[ell_cols]`` is a
-    dense (n, w[, b]) gather, the product reduces over the width axis —
-    a shape XLA fuses and tiles onto the VPU/MXU directly.  Padding slots
-    carry value 0 so no masking is needed.
-  * CSR path (fallback for irregular matrices): gather per-nnz, then
-    ``segment_sum`` over precomputed sorted row ids.
+  * DIA (stencil matrices): Pallas shift-FMA kernel
+    (:mod:`amgx_tpu.ops.pallas_dia`) with an XLA shift+FMA fallback.
+  * dense (small unstructured): one MXU matmul.
+  * windowed ELL (unstructured with column locality — natural or
+    RCM-manufactured, :mod:`amgx_tpu.ops.reorder`): Pallas lane-gather
+    kernel (:mod:`amgx_tpu.ops.pallas_well`); XLA gather fallback over
+    the plain ELL arrays.
+  * CSR (irregular fallback): gather per-nnz + ``segment_sum`` over
+    precomputed sorted row ids.
 
-The distributed SpMV with halo overlap (reference
-multiply.cu:95-110 exchange_halo_split_gather -> interior -> boundary)
-lives in :mod:`amgx_tpu.distributed.spmv`; this module is the
-single-shard compute kernel it calls.
+The distributed SpMV with halo overlap (reference multiply.cu:95-110
+exchange_halo_split_gather -> interior -> boundary) lives in
+:mod:`amgx_tpu.distributed.solve`; this module is the single-shard
+compute kernel it calls.
 """
 
 from __future__ import annotations

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Matrix format converter (reference examples/convert.c analogue).
+
+Converts between MatrixMarket (.mtx) and the %%NVAMGBinary format in
+either direction, keyed on the OUTPUT file's extension:
+
+    python examples/convert.py in.mtx out.bin     # mtx -> binary
+    python examples/convert.py in.bin out.mtx     # binary -> mtx
+
+RHS/solution vectors embedded in the system file ride along.
+"""
+
+import sys
+
+
+def main(argv):
+    if len(argv) != 3:
+        print(__doc__)
+        return 2
+    src, dst = argv[1], argv[2]
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.io.matrix_market import (
+        read_system,
+        write_system,
+        write_system_binary,
+    )
+
+    Ad, rhs, sol = read_system(src)
+    bx, by = Ad["block_dims"]
+    if bx != by:
+        raise SystemExit(f"rectangular blocks {bx}x{by} unsupported")
+    A = SparseMatrix.from_coo(
+        Ad["rows"], Ad["cols"], Ad["vals"],
+        n_rows=Ad["n_rows"], n_cols=Ad["n_cols"], block_size=bx,
+        build_ell=False,
+    )
+    if dst.endswith((".bin", ".amgx")):
+        write_system_binary(dst, A, rhs, sol)
+    else:
+        write_system(dst, A, rhs, sol)
+    print(
+        f"{src} -> {dst}: {A.n_rows}x{A.n_cols}, nnz={A.nnz},"
+        f" block_size={A.block_size},"
+        f" rhs={'yes' if rhs is not None else 'no'},"
+        f" sol={'yes' if sol is not None else 'no'}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -382,3 +382,107 @@ def test_matrix_comm_from_maps_one_ring_validation():
             A_h, 1, 1, [1], [2], [np.array([0, 3], np.int32)],
             [2], [np.array([0, 1], np.int32)],
         )
+
+
+def test_capi_per_rank_partial_upload():
+    """Rank-order partial uploads (n < n_global per call) assemble the
+    same system as one full upload and solve distributed (reference:
+    each rank uploads its own rows, amgx_c.h:547-560)."""
+    from amgx_tpu.api import capi
+    from amgx_tpu.io.poisson import poisson_3d_7pt
+
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "max_iters": 80, "tolerance": 1e-8,'
+        ' "monitor_residual": 1}}'
+    )
+    n_parts = 4
+    res = capi.resources_create(cfg, None, n_parts)
+    sp = poisson_3d_7pt(10).to_scipy().tocsr()
+    n = sp.shape[0]
+    A = capi.matrix_create(res, "dDDI")
+    bounds = np.linspace(0, n, n_parts + 1).astype(int)
+    for p in range(n_parts):
+        lo, hi = bounds[p], bounds[p + 1]
+        blk = sp[lo:hi]
+        rc = capi.matrix_upload_all_global(
+            A, n, hi - lo, blk.nnz, 1, 1, blk.indptr,
+            blk.indices.astype(np.int64), blk.data, None, 1, 1, None,
+        )
+        assert rc == capi.RC_OK
+    m = capi._get(A, capi._Matrix)
+    assert m.global_sp is not None
+    assert (m.global_sp != sp).nnz == 0
+    # contiguous call-order ownership
+    assert int(m.owner[0]) == 0 and int(m.owner[-1]) == n_parts - 1
+
+    b = capi.vector_create(res, "dDDI")
+    x = capi.vector_create(res, "dDDI")
+    capi.vector_upload(b, n, 1, np.ones(n))
+    capi.vector_set_zero(x, n, 1)
+    slv = capi.solver_create(res, "dDDI", cfg)
+    capi.solver_setup(slv, A)
+    capi.solver_solve_with_0_initial_guess(slv, b, x)
+    assert capi.solver_get_status(slv) == capi.SOLVE_SUCCESS
+    xs = capi.vector_download(x)
+    rel = np.linalg.norm(np.ones(n) - sp @ xs) / np.sqrt(n)
+    assert rel < 1e-6
+
+
+def test_capi_partial_upload_overflow_rejected():
+    from amgx_tpu.api import capi
+    from amgx_tpu.io.poisson import poisson_2d_5pt
+
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "max_iters": 10}}'
+    )
+    res = capi.resources_create(cfg, None, 2)
+    sp = poisson_2d_5pt(8).to_scipy().tocsr()
+    n = sp.shape[0]
+    A = capi.matrix_create(res, "dDDI")
+    blk = sp[: n - 3]
+    capi.matrix_upload_all_global(
+        A, n, n - 3, blk.nnz, 1, 1, blk.indptr,
+        blk.indices.astype(np.int64), blk.data, None, 1, 1, None,
+    )
+    blk2 = sp[n - 5:]  # overlaps: 5 + (n-3) > n
+    with pytest.raises(capi.AMGXError):
+        capi.matrix_upload_all_global(
+            A, n, 5, blk2.nnz, 1, 1, blk2.indptr,
+            blk2.indices.astype(np.int64), blk2.data, None, 1, 1, None,
+        )
+
+
+def test_capi_partial_upload_trailing_empty_rank():
+    """A zero-row rank after assembly completes must be a no-op, not a
+    stale new accumulation (rank sets where the tail ranks own no
+    rows)."""
+    from amgx_tpu.api import capi
+    from amgx_tpu.io.poisson import poisson_2d_5pt
+
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"solver": "PCG",'
+        ' "max_iters": 40, "tolerance": 1e-8, "monitor_residual": 1}}'
+    )
+    res = capi.resources_create(cfg, None, 4)
+    sp = poisson_2d_5pt(10).to_scipy().tocsr()
+    n = sp.shape[0]
+    A = capi.matrix_create(res, "dDDI")
+    bounds = [0, 40, 80, n]  # 3 real blocks + 1 empty rank
+    for p in range(3):
+        lo, hi = bounds[p], bounds[p + 1]
+        blk = sp[lo:hi]
+        capi.matrix_upload_all_global(
+            A, n, hi - lo, blk.nnz, 1, 1, blk.indptr,
+            blk.indices.astype(np.int64), blk.data, None, 1, 1, None,
+        )
+    empty = sp[0:0]
+    rc = capi.matrix_upload_all_global(
+        A, n, 0, 0, 1, 1, empty.indptr, empty.indices.astype(np.int64),
+        empty.data, None, 1, 1, None,
+    )
+    assert rc == capi.RC_OK
+    m = capi._get(A, capi._Matrix)
+    assert m.pending_parts is None  # no stale accumulation
+    assert (m.global_sp != sp).nnz == 0
