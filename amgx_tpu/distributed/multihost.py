@@ -59,15 +59,21 @@ def initialize(
         )
         slurm_multi = int(os.environ.get("SLURM_NTASKS", "1") or 1) > 1
         if not (any(k in os.environ for k in markers) or slurm_multi):
-            return  # single process / launcher already initialized jax
-        jax.distributed.initialize()
+            return  # single process
+        try:
+            jax.distributed.initialize()
+        except RuntimeError:
+            pass  # launcher already initialized the runtime: idempotent
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except RuntimeError:
+        pass  # already initialized: idempotent
 
 
 def local_part_from_rows(
@@ -103,13 +109,11 @@ def local_part_from_rows(
     if rows_pp is None:
         rows_pp = int((part_offsets[1:] - part_offsets[:-1]).max())
     own = (gcols >= lo) & (gcols < hi)
-    halo_glob = np.unique(gcols[~own])
-    cols = np.empty(gcols.shape, dtype=np.int32)
-    cols[own] = (gcols[own] - lo).astype(np.int32)
-    if halo_glob.size:
-        cols[~own] = (
-            rows_pp + np.searchsorted(halo_glob, gcols[~own])
-        ).astype(np.int32)
+    from amgx_tpu.distributed.partition import halo_localize
+
+    cols, halo_glob = halo_localize(
+        gcols, own, (gcols[own] - lo).astype(np.int32), rows_pp
+    )
     return dict(
         indptr=indptr, cols=cols, vals=vals, halo_glob=halo_glob,
         rows_pp=int(rows_pp),

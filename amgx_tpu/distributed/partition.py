@@ -70,6 +70,14 @@ class DistributedMatrix:
     # to the shared ELL arrays (no second operator copy, no scatter).
     int_mask: Optional[np.ndarray] = None  # [N, rows] bool
     own_mask: Optional[np.ndarray] = None  # [N, rows] bool (non-pad)
+    # windowed-tiled ELL arrays of the INTERIOR rows (ops.pallas_well
+    # layout, stacked on the shard axis): the interior pass reads only
+    # x_loc, so on TPU it rides the Pallas windowed kernel while the
+    # halo exchange is in flight; boundary rows stay on the XLA path.
+    ell_wcols: Optional[np.ndarray] = None  # [N, nt, 8, w*128] int32
+    ell_wvals: Optional[np.ndarray] = None  # [N, nt, 8, w*128]
+    ell_wbase: Optional[np.ndarray] = None  # [N, nt] int32
+    ell_wwidth: Optional[int] = None  # window lanes (static)
     # row ownership: owner[i] = part owning global row i;
     # local_of[i] = its local slot — identity layout for contiguous
     # partitions (owner = i // rows_per_part).
@@ -100,6 +108,39 @@ class DistributedMatrix:
         if self.owner is None:
             return vp.reshape(-1)[: self.n_global]
         return vp[self.owner, self.local_of]
+
+
+def _build_interior_windowed(
+    parts, ell_cols, ell_vals, int_mask, rows_pp, counts
+):
+    """Windowed tiling (ops.pallas_well layout) of each shard's interior
+    rows, stacked on the shard axis, or None when any shard's interior
+    columns have no bounded window.  Interior columns are all local
+    (< rows_pp), so the kernel gathers from x_loc only — it runs while
+    the halo exchange is in flight."""
+    from amgx_tpu.ops.pallas_well import build_windowed_ell
+
+    n_parts = ell_cols.shape[0]
+    per = []
+    wmax_lanes = 0
+    for p in range(n_parts):
+        m = int_mask[p][:, None]
+        cols_p = np.where(m, ell_cols[p], 0)
+        vals_p = np.where(m, ell_vals[p], 0)
+        lens = np.zeros(rows_pp, dtype=np.int64)
+        nr = int(counts[p])
+        lens[:nr] = np.diff(parts[p]["indptr"])
+        lens[~int_mask[p]] = 0  # boundary/padding rows: no real slots
+        ro = np.concatenate([[0], np.cumsum(lens)])
+        built = build_windowed_ell(ro, cols_p, vals_p)
+        if built is None:
+            return None
+        per.append(built)
+        wmax_lanes = max(wmax_lanes, built[3])
+    wcols = np.stack([b[0] for b in per])
+    wvals = np.stack([b[1] for b in per])
+    wbase = np.stack([b[2] for b in per])
+    return wcols, wvals, wbase, int(wmax_lanes)
 
 
 def grid_partition_parts(grid, n_parts):
@@ -210,18 +251,28 @@ def local_numbering(owner, n_parts):
     return local_of, counts, part_rows
 
 
+def halo_localize(gcols, is_owned, owned_local, rows_pp):
+    """Shared halo-slot numbering (bit-parity critical: the multi-host
+    per-process path must reproduce this exactly): off-owned columns
+    map to ``rows_pp + position in the SORTED unique halo-id list``."""
+    halo_glob = np.unique(gcols[~is_owned])
+    cols = np.empty(gcols.shape, dtype=np.int32)
+    cols[is_owned] = owned_local
+    if halo_glob.size:
+        cols[~is_owned] = (
+            rows_pp + np.searchsorted(halo_glob, gcols[~is_owned])
+        ).astype(np.int32)
+    return cols, halo_glob
+
+
 def localize_columns(indptr, gcols, vals, owner, local_of, p, rows_pp):
     """Owned-first renumbering of one shard's rows: owned columns map to
     their local slot, off-shard columns to appended halo slots
     (reference loadDistributed_LocalToGlobal/InitLocalMatrix)."""
     is_owned = owner[gcols] == p
-    halo_glob = np.unique(gcols[~is_owned])
-    cols = np.empty(gcols.shape, dtype=np.int32)
-    cols[is_owned] = local_of[gcols[is_owned]]
-    if halo_glob.size:
-        cols[~is_owned] = (
-            rows_pp + np.searchsorted(halo_glob, gcols[~is_owned])
-        ).astype(np.int32)
+    cols, halo_glob = halo_localize(
+        gcols, is_owned, local_of[gcols[is_owned]], rows_pp
+    )
     return dict(indptr=indptr, cols=cols, vals=vals, halo_glob=halo_glob)
 
 
@@ -333,6 +384,26 @@ def finalize_partition(
             own_mask[p, : counts[p]] = True
         int_mask = own_mask & ~is_bnd
 
+    # ---- Pallas windowed tiling of the interior rows (TPU) ----------
+    wcols = wvals = wbase = None
+    wwidth = None
+    if int_mask is not None:
+        import jax as _jax
+
+        from amgx_tpu.core.matrix import _want_tiled_ell
+
+        # gate on the EFFECTIVE device dtype: f64 host arrays land as
+        # f32 on device when x64 is disabled (the usual TPU setting)
+        eff = np.dtype(Adtype)
+        if eff == np.float64 and not _jax.config.jax_enable_x64:
+            eff = np.dtype(np.float32)
+        if _want_tiled_ell(eff):
+            built = _build_interior_windowed(
+                parts, ell_cols, ell_vals, int_mask, rows_pp, counts
+            )
+            if built is not None:
+                wcols, wvals, wbase, wwidth = built
+
     return DistributedMatrix(
         n_global=n,
         n_parts=n_parts,
@@ -342,6 +413,10 @@ def finalize_partition(
         diag=diag,
         int_mask=int_mask,
         own_mask=own_mask,
+        ell_wcols=wcols,
+        ell_wvals=wvals,
+        ell_wbase=wbase,
+        ell_wwidth=wwidth,
         perms=None if dm is None else dm["perms"],
         send_idx_d=None if dm is None else dm["send_idx_d"],
         halo_dir=None if dm is None else dm["halo_dir"],

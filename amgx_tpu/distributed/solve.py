@@ -34,6 +34,17 @@ def _shard_params(A: DistributedMatrix):
             jnp.asarray(A.int_mask),
             jnp.asarray(A.own_mask),
         )
+    if A.ell_wcols is not None:
+        from amgx_tpu.ops.pallas_well import pallas_well_supported
+
+        # ship the tiled copies only where the kernel actually runs —
+        # they duplicate the ELL footprint in HBM
+        if pallas_well_supported():
+            out["wtile"] = (
+                jnp.asarray(A.ell_wcols),
+                jnp.asarray(A.ell_wvals),
+                jnp.asarray(A.ell_wbase),
+            )
     if A.uses_ppermute:
         out["ex"] = (
             tuple(jnp.asarray(s) for s in A.send_idx_d),
@@ -76,19 +87,39 @@ def make_local_spmv(A: DistributedMatrix, axis):
     on the permute results — XLA's latency-hiding scheduler overlaps
     it with the in-flight exchange."""
 
+    use_wtile = False
+    if A.ell_wcols is not None:
+        from amgx_tpu.ops.pallas_well import pallas_well_supported
+
+        use_wtile = pallas_well_supported()  # matches _shard_params
+
     def spmv(shard, x_loc):
         ell_cols, ell_vals = shard["ell"]
         if "split" in shard:
             int_mask, own_mask = shard["split"]
             halo = exchange_halo(A, shard, x_loc, axis)
-            # interior pass: columns clamped into the local range (the
-            # clamp only touches boundary rows, which the mask zeroes)
-            # — no dependence on the permute results, so it overlaps
-            nloc = x_loc.shape[0]
-            lc = jnp.minimum(ell_cols, nloc - 1)
-            yi = jnp.where(
-                int_mask, jnp.sum(ell_vals * x_loc[lc], axis=-1), 0
-            )
+            if use_wtile:
+                # interior pass on the Pallas windowed kernel: interior
+                # columns are all local, so the gather reads only x_loc
+                # — overlaps with the in-flight exchange.  Boundary/
+                # padding rows carry zero values in the tiled arrays,
+                # so the output needs no mask.
+                from amgx_tpu.ops.pallas_well import _pallas_well_spmv
+
+                wc, wv, wb = shard["wtile"]
+                yi = _pallas_well_spmv(
+                    wc, wv, wb, x_loc, x_loc.shape[0], A.ell_wwidth
+                )
+            else:
+                # XLA interior pass: columns clamped into the local
+                # range (the clamp only touches boundary rows, which
+                # the mask zeroes) — no dependence on the permute
+                # results, so it overlaps
+                nloc = x_loc.shape[0]
+                lc = jnp.minimum(ell_cols, nloc - 1)
+                yi = jnp.where(
+                    int_mask, jnp.sum(ell_vals * x_loc[lc], axis=-1), 0
+                )
             xf = jnp.concatenate([x_loc, halo])
             yb = jnp.where(
                 own_mask & ~int_mask,
