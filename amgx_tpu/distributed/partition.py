@@ -110,29 +110,38 @@ class DistributedMatrix:
         return vp[self.owner, self.local_of]
 
 
+def part_interior_windowed(
+    part, ell_cols_p, ell_vals_p, int_mask_p, rows_pp, count
+):
+    """Windowed tiling (ops.pallas_well layout) of ONE shard's interior
+    rows, or None when its interior columns have no bounded window.
+    Interior columns are all local (< rows_pp), so the kernel gathers
+    from x_loc only — it runs while the halo exchange is in flight."""
+    from amgx_tpu.ops.pallas_well import build_windowed_ell
+
+    m = int_mask_p[:, None]
+    cols_p = np.where(m, ell_cols_p, 0)
+    vals_p = np.where(m, ell_vals_p, 0)
+    lens = np.zeros(rows_pp, dtype=np.int64)
+    lens[: int(count)] = np.diff(part["indptr"])
+    lens[~int_mask_p] = 0  # boundary/padding rows: no real slots
+    ro = np.concatenate([[0], np.cumsum(lens)])
+    return build_windowed_ell(ro, cols_p, vals_p)
+
+
 def _build_interior_windowed(
     parts, ell_cols, ell_vals, int_mask, rows_pp, counts
 ):
-    """Windowed tiling (ops.pallas_well layout) of each shard's interior
-    rows, stacked on the shard axis, or None when any shard's interior
-    columns have no bounded window.  Interior columns are all local
-    (< rows_pp), so the kernel gathers from x_loc only — it runs while
-    the halo exchange is in flight."""
-    from amgx_tpu.ops.pallas_well import build_windowed_ell
-
+    """Per-shard windowed tiling stacked on the shard axis, or None
+    when any shard's interior columns have no bounded window."""
     n_parts = ell_cols.shape[0]
     per = []
     wmax_lanes = 0
     for p in range(n_parts):
-        m = int_mask[p][:, None]
-        cols_p = np.where(m, ell_cols[p], 0)
-        vals_p = np.where(m, ell_vals[p], 0)
-        lens = np.zeros(rows_pp, dtype=np.int64)
-        nr = int(counts[p])
-        lens[:nr] = np.diff(parts[p]["indptr"])
-        lens[~int_mask[p]] = 0  # boundary/padding rows: no real slots
-        ro = np.concatenate([[0], np.cumsum(lens)])
-        built = build_windowed_ell(ro, cols_p, vals_p)
+        built = part_interior_windowed(
+            parts[p], ell_cols[p], ell_vals[p], int_mask[p], rows_pp,
+            counts[p],
+        )
         if built is None:
             return None
         per.append(built)
@@ -141,6 +150,43 @@ def _build_interior_windowed(
     wvals = np.stack([b[1] for b in per])
     wbase = np.stack([b[2] for b in per])
     return wcols, wvals, wbase, int(wmax_lanes)
+
+
+def tiled_ell_wanted(dtype) -> bool:
+    """Whether to build windowed-tiled ELL copies for this matrix
+    dtype — judged on the EFFECTIVE device dtype (f64 host arrays land
+    as f32 on device when x64 is disabled, the usual TPU setting).
+    Single gate for BOTH assembly paths (global partitioner and the
+    multi-host one), so they cannot diverge."""
+    import jax as _jax
+
+    from amgx_tpu.core.matrix import _want_tiled_ell
+
+    eff = np.dtype(dtype)
+    if eff == np.float64 and not _jax.config.jax_enable_x64:
+        eff = np.dtype(np.float32)
+    return _want_tiled_ell(eff)
+
+
+def part_ell_arrays(part, rows_pp, w, dtype):
+    """One shard's padded ELL block + diagonal — the per-shard slice of
+    the stacked arrays (bit-parity-critical: both assembly paths, the
+    global partitioner and the multi-host one, fill through here)."""
+    indptr, cols, vals = part["indptr"], part["cols"], part["vals"]
+    nr = indptr.shape[0] - 1
+    ell_cols = np.zeros((rows_pp, w), dtype=np.int32)
+    ell_vals = np.zeros((rows_pp, w), dtype=dtype)
+    # padding rows get unit diagonal so smoothers stay finite there
+    diag = np.ones((rows_pp,), dtype=dtype)
+    diag[:nr] = 0.0
+    lens = np.diff(indptr)
+    row_ids = np.repeat(np.arange(nr), lens)
+    pos = np.arange(cols.shape[0]) - indptr[row_ids].astype(np.int64)
+    ell_cols[row_ids, pos] = cols
+    ell_vals[row_ids, pos] = vals
+    dmask = cols == row_ids
+    diag[row_ids[dmask]] = vals[dmask]
+    return ell_cols, ell_vals, diag
 
 
 def grid_partition_parts(grid, n_parts):
@@ -276,27 +322,30 @@ def localize_columns(indptr, gcols, vals, owner, local_of, p, rows_pp):
     return dict(indptr=indptr, cols=cols, vals=vals, halo_glob=halo_glob)
 
 
-def finalize_partition(
-    parts, owner, local_of, counts, n, n_parts, proc_grid=None,
-    split=True,
-):
-    """Build the exchange plan + stacked device arrays from per-shard
-    localized CSRs (the output of localize_columns)."""
-    rows_pp = max(int(counts.max()), 1)
-    Adtype = parts[0]["vals"].dtype if parts else np.float64
+def build_exchange_plan(halo_globs, owner_fn, local_fn, n_parts):
+    """Exchange plan from each part's sorted halo-id list alone.
 
+    ``halo_globs[p]`` is part p's ``halo_glob`` (sorted global ids it
+    needs); ``owner_fn``/``local_fn`` map global-id arrays to owning
+    part / local slot.  Everything here is O(total boundary) — in a
+    multi-host launch the lists ride one small allgather and every
+    process builds the (replicated) plan independently
+    (reference distributed_arranger.h create_B2L/create_boundary_lists).
+
+    Returns ``(dm, fallback)``: the neighbor-ppermute plan dict (or
+    None) and the all_gather fallback maps dict.
+    """
     # boundary (B2L) lists: rows of p needed by q, sorted by global id
     send_sorted = {}  # (src_owner, dst) -> sorted global ids
-    for p, part in enumerate(parts):
-        for g in part["halo_glob"]:
-            key = (int(owner[g]), p)
-            send_sorted.setdefault(key, []).append(int(g))
-    for key in send_sorted:
-        send_sorted[key] = np.unique(
-            np.array(send_sorted[key], dtype=np.int64)
-        )
+    for p, hg in enumerate(halo_globs):
+        hg = np.asarray(hg, dtype=np.int64)
+        if hg.size == 0:
+            continue
+        owners = owner_fn(hg)
+        for o in np.unique(owners):
+            send_sorted[(int(o), p)] = hg[owners == o]
 
-    max_halo = max((len(p["halo_glob"]) for p in parts), default=0)
+    max_halo = max((len(h) for h in halo_globs), default=0)
     max_halo = max(max_halo, 1)
 
     # ---- neighbor-direction (ppermute) plan -------------------------
@@ -316,12 +365,12 @@ def finalize_partition(
             sidx = np.zeros((n_parts, ms), dtype=np.int32)
             for (s, t) in dpairs:
                 ids = send_sorted[(s, t)]
-                sidx[s, : len(ids)] = local_of[ids]
+                sidx[s, : len(ids)] = local_fn(ids)
             perms.append(tuple(dpairs))
             send_idx_d.append(sidx)
             for (s, t) in dpairs:
                 ids = send_sorted[(s, t)]
-                hg = parts[t]["halo_glob"]
+                hg = np.asarray(halo_globs[t], dtype=np.int64)
                 mine = np.isin(hg, ids)
                 li = np.nonzero(mine)[0]
                 halo_dir[t, li] = d
@@ -344,12 +393,45 @@ def finalize_partition(
     halo_src_pos = np.zeros((n_parts, max_halo), dtype=np.int32)
     for p in range(n_parts):
         su = send_union[p]
-        send_idx[p, : len(su)] = local_of[su]
-        hg = parts[p]["halo_glob"]
-        for li, g in enumerate(hg):
-            o = int(owner[g])
-            halo_src_part[p, li] = o
-            halo_src_pos[p, li] = int(np.searchsorted(send_union[o], g))
+        if len(su):
+            send_idx[p, : len(su)] = local_fn(su)
+        hg = np.asarray(halo_globs[p], dtype=np.int64)
+        if hg.size:
+            owners = owner_fn(hg)
+            halo_src_part[p, : hg.size] = owners
+            halo_src_pos[p, : hg.size] = [
+                int(np.searchsorted(send_union[int(o)], g))
+                for o, g in zip(owners, hg)
+            ]
+    fallback = dict(
+        send_idx=send_idx,
+        halo_src_part=halo_src_part,
+        halo_src_pos=halo_src_pos,
+        max_send=max_send,
+        max_halo=max_halo,
+    )
+    return dm, fallback
+
+
+def finalize_partition(
+    parts, owner, local_of, counts, n, n_parts, proc_grid=None,
+    split=True,
+):
+    """Build the exchange plan + stacked device arrays from per-shard
+    localized CSRs (the output of localize_columns)."""
+    rows_pp = max(int(counts.max()), 1)
+    Adtype = parts[0]["vals"].dtype if parts else np.float64
+
+    dm, fb = build_exchange_plan(
+        [p["halo_glob"] for p in parts],
+        lambda ids: owner[ids],
+        lambda ids: local_of[ids],
+        n_parts,
+    )
+    max_send, max_halo = fb["max_send"], fb["max_halo"]
+    send_idx = fb["send_idx"]
+    halo_src_part = fb["halo_src_part"]
+    halo_src_pos = fb["halo_src_pos"]
 
     # ---- ELL with uniform width across shards -----------------------
     w = 1
@@ -360,19 +442,10 @@ def finalize_partition(
     ell_cols = np.zeros((n_parts, rows_pp, w), dtype=np.int32)
     ell_vals = np.zeros((n_parts, rows_pp, w), dtype=Adtype)
     diag = np.zeros((n_parts, rows_pp), dtype=Adtype)
-    # padding rows get unit diagonal so smoothers stay finite there
-    diag[:, :] = 1.0
     for p, part in enumerate(parts):
-        nr = counts[p]
-        diag[p, :nr] = 0.0
-        indptr, cols, vals = part["indptr"], part["cols"], part["vals"]
-        lens = np.diff(indptr)
-        row_ids = np.repeat(np.arange(nr), lens)
-        pos = np.arange(cols.shape[0]) - indptr[row_ids].astype(np.int64)
-        ell_cols[p, row_ids, pos] = cols
-        ell_vals[p, row_ids, pos] = vals
-        dmask = cols == row_ids
-        diag[p, row_ids[dmask]] = vals[dmask]
+        ell_cols[p], ell_vals[p], diag[p] = part_ell_arrays(
+            part, rows_pp, w, Adtype
+        )
 
     # ---- interior/boundary split masks (latency hiding) -------------
     # rows whose every stored column is local (< rows_pp) are interior
@@ -387,22 +460,12 @@ def finalize_partition(
     # ---- Pallas windowed tiling of the interior rows (TPU) ----------
     wcols = wvals = wbase = None
     wwidth = None
-    if int_mask is not None:
-        import jax as _jax
-
-        from amgx_tpu.core.matrix import _want_tiled_ell
-
-        # gate on the EFFECTIVE device dtype: f64 host arrays land as
-        # f32 on device when x64 is disabled (the usual TPU setting)
-        eff = np.dtype(Adtype)
-        if eff == np.float64 and not _jax.config.jax_enable_x64:
-            eff = np.dtype(np.float32)
-        if _want_tiled_ell(eff):
-            built = _build_interior_windowed(
-                parts, ell_cols, ell_vals, int_mask, rows_pp, counts
-            )
-            if built is not None:
-                wcols, wvals, wbase, wwidth = built
+    if int_mask is not None and tiled_ell_wanted(Adtype):
+        built = _build_interior_windowed(
+            parts, ell_cols, ell_vals, int_mask, rows_pp, counts
+        )
+        if built is not None:
+            wcols, wvals, wbase, wwidth = built
 
     return DistributedMatrix(
         n_global=n,

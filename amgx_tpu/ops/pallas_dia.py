@@ -113,9 +113,12 @@ def _pallas_dia_spmv(dia_vals, x, offsets, n, interpret=False):
     npad = nt * R
 
     # x padded so every window read [t*R - halo_lo, t*R + R + halo_hi)
-    # is in bounds, plus one spill row for the lane-seam select.
-    mwin = (R + halo_lo + halo_hi) // _LANE + 1
-    xp = jnp.pad(x, (halo_lo, npad - n + halo_hi + _LANE))
+    # is in bounds, plus one spill row for the lane-seam select. The
+    # window row count is rounded to a multiple of 8: DMAs with a
+    # non-multiple-of-8 sublane extent fault the TPU (measured on v5e).
+    mwin = _pad_up((R + halo_lo + halo_hi) // _LANE + 1, 8)
+    xrows = (nt - 1) * m + mwin
+    xp = jnp.pad(x, (halo_lo, xrows * _LANE - halo_lo - n))
     x2d = xp.reshape(-1, _LANE)
 
     vp = jnp.pad(dia_vals, ((0, 0), (0, npad - n)))
