@@ -2,7 +2,9 @@
 
 Reference mapping (SURVEY §2.6/§5.8): the sharded levels come from
 :mod:`amgx_tpu.distributed.hierarchy` (the distributed setup loop,
-amg.cu:425-660); each distributed level smooths with damped Jacobi and
+amg.cu:425-660); each distributed level smooths with damped Jacobi,
+L1-Jacobi, Chebyshev polynomials, or multicolor GS (reference
+block_jacobi/jacobi_l1/cheb/multicolor_gauss_seidel solvers) and
 exchanges halos via neighbor ppermute; restriction/prolongation are
 communication-free (shard-local aggregates).  Below the consolidation
 threshold the remaining hierarchy is replicated on every chip
@@ -32,8 +34,36 @@ from amgx_tpu.distributed.hierarchy import (
 from amgx_tpu.distributed.solve import (
     _pdot,
     _shard_params,
+    exchange_halo,
     make_local_spmv,
 )
+
+
+def _local_colors(A):
+    """Distance-1 greedy coloring of each shard's LOCAL coupling graph
+    (halo columns excluded), stacked [N, rows] with padding rows -1.
+    Returns (colors, num_colors)."""
+    from amgx_tpu.ops.coloring import greedy_coloring
+
+    cols = np.asarray(A.ell_cols)
+    vals = np.asarray(A.ell_vals)
+    n_parts, rows, w = cols.shape
+    out = np.full((n_parts, rows), -1, dtype=np.int32)
+    nc = 1
+    for p in range(n_parts):
+        nr = int(A.n_owned[p]) if A.n_owned is not None else rows
+        rid = np.broadcast_to(
+            np.arange(rows, dtype=np.int64)[:, None], (rows, w)
+        )
+        em = (vals[p] != 0) & (cols[p] < rows) & (cols[p] != rid)
+        counts = em[:nr].sum(axis=1)
+        indptr = np.concatenate([[0], np.cumsum(counts)])
+        indices = cols[p][:nr][em[:nr]].astype(np.int64)
+        if nr:
+            c = greedy_coloring(indptr, indices, nr)
+            out[p, :nr] = c
+            nc = max(nc, int(c.max()) + 1)
+    return out, nc
 
 
 class DistributedAMG:
@@ -81,16 +111,42 @@ class DistributedAMG:
 
     # ------------------------------------------------------------------
 
+    _SMOOTHERS = {
+        "BLOCK_JACOBI": "jacobi",
+        "JACOBI_L1": "l1",
+        "CHEBYSHEV": "cheby",
+        "CHEBYSHEV_POLY": "cheby",
+        "MULTICOLOR_GS": "mcgs",
+        "GS": "mcgs",
+        "FIXCOLOR_GS": "mcgs",
+    }
+
     def _setup(self, Asp):
         sname, sscope = self.cfg.get_scoped("smoother", self.scope)
-        if sname not in ("BLOCK_JACOBI", "JACOBI_L1"):
+        self.smoother_kind = self._SMOOTHERS.get(sname)
+        if self.smoother_kind is None:
             import warnings
 
             warnings.warn(
                 f"distributed smoother {sname}: using damped Jacobi "
-                "(colored smoothers on sharded levels TBD)"
+                "(Jacobi/L1/Chebyshev/multicolor-GS are the sharded-"
+                "level roster)"
             )
-        self.l1_jacobi = sname == "JACOBI_L1"
+            self.smoother_kind = "jacobi"
+        if self.smoother_kind == "cheby":
+            self.cheby_order = max(
+                int(self.cfg.get("chebyshev_polynomial_order", sscope)),
+                1,
+            )
+            self.cheby_mode = int(
+                self.cfg.get("chebyshev_lambda_estimate_mode", sscope)
+            )
+            self.cheby_user_max = float(
+                self.cfg.get("cheby_max_lambda", sscope)
+            )
+            self.cheby_user_min = float(
+                self.cfg.get("cheby_min_lambda", sscope)
+            )
         self.omega = float(self.cfg.get("relaxation_factor", sscope))
         self.presweeps = max(int(self.cfg.get("presweeps", self.scope)), 0)
         self.postsweeps = max(
@@ -108,6 +164,7 @@ class DistributedAMG:
             consolidate_rows=self.consolidate_rows,
         )
         self.fine = self.h.levels[0].A
+        self._setup_level_smoothers()
 
         # replicated tail: standard AMG on the consolidated matrix
         from amgx_tpu.amg.hierarchy import AMGSolver
@@ -139,6 +196,50 @@ class DistributedAMG:
 
     # ------------------------------------------------------------------
 
+    def _setup_level_smoothers(self):
+        """Per-sharded-level smoother metadata.
+
+        CHEBYSHEV: spectral interval of D^-1 A per level — the
+        Gershgorin row-sum bound max_i sum_j |a_ij|/|a_ii| is a true
+        upper bound on lambda_max (no estimation randomness, no
+        collectives at setup; reference cheb_solver.cu power-iterates
+        instead), lambda_min = cheby_min_lambda * lambda_max (ratio
+        semantics as in solvers/chebyshev.py).
+
+        MULTICOLOR_GS: distance-1 greedy coloring of each shard's LOCAL
+        coupling graph (halo columns excluded — cross-shard coupling
+        relaxes Jacobi-style with the sweep-stale halo, the reference's
+        per-rank coloring semantics); padding rows get color -1.
+        """
+        ship = (
+            self.h.levels
+            if len(self.h.levels) == 1
+            else self.h.levels[:-1]
+        )
+        self._level_smooth = []
+        self._level_colors = []
+        for lvl in ship:
+            A = lvl.A
+            colors = None
+            if self.smoother_kind == "cheby":
+                ev = np.abs(np.asarray(A.ell_vals)).sum(axis=-1)
+                d = np.abs(np.asarray(A.diag))
+                ratio = np.where(d > 0, ev / np.maximum(d, 1e-300), 0.0)
+                if self.cheby_mode == 3:
+                    lmax, lmin = self.cheby_user_max, self.cheby_user_min
+                else:
+                    lmax = max(float(ratio.max()), 1e-12)
+                    lmin = self.cheby_user_min * lmax
+                self._level_smooth.append(
+                    ("cheby", (float(lmax), float(lmin)))
+                )
+            elif self.smoother_kind == "mcgs":
+                colors, ncolors = _local_colors(A)
+                self._level_smooth.append(("mcgs", ncolors))
+            else:
+                self._level_smooth.append((self.smoother_kind, None))
+            self._level_colors.append(colors)
+
     def _traced_level_params(self):
         """Per-level traced arrays: (shard_params(A), P, R) stacks.
         The deepest level is the consolidation bridge — its operator
@@ -151,10 +252,12 @@ class DistributedAMG:
             if len(self.h.levels) == 1
             else self.h.levels[:-1]
         )
-        for lvl in ship:
+        for i, lvl in enumerate(ship):
             entry = [_shard_params(lvl.A)]
             for a in (lvl.P_cols, lvl.P_vals, lvl.R_cols, lvl.R_vals):
                 entry.append(None if a is None else jnp.asarray(a))
+            colors = self._level_colors[i]
+            entry.append(None if colors is None else jnp.asarray(colors))
             out.append(tuple(entry))
         if len(self.h.levels) > 1:
             out.append(())
@@ -173,10 +276,60 @@ class DistributedAMG:
         pre, post = max(self.presweeps, 1), max(self.postsweeps, 1)
         tail_cycle = self._tail_cycle
 
+        level_smooth = self._level_smooth
+
         def smooth(l, lp, r_l, z, sweeps):
             sh = lp[0]
             d = sh["diag"]
-            if self.l1_jacobi:
+            kind, meta = level_smooth[l]
+            if kind == "cheby":
+                # Chebyshev polynomial on [lmin, lmax] of D^-1 A
+                # (reference cheb_solver.cu three-term recurrence);
+                # every step is one distributed SpMV — no coloring, no
+                # extra exchanges: the TPU-preferred smoother
+                lmax, lmin = meta
+                theta = (lmax + lmin) / 2.0
+                delta = max((lmax - lmin) / 2.0, 1e-30)
+                sigma = theta / delta
+                dinv = jnp.where(d != 0, 1.0 / d, 1.0)
+                for _ in range(sweeps):
+                    rho_old = 1.0 / sigma
+                    rr = r_l if z is None else r_l - spmvs[l](sh, z)
+                    dd = dinv * rr / theta
+                    z = dd if z is None else z + dd
+                    for _k in range(self.cheby_order - 1):
+                        rho = 1.0 / (2.0 * sigma - rho_old)
+                        rr = r_l - spmvs[l](sh, z)
+                        dd = (
+                            rho * rho_old * dd
+                            + (2.0 * rho / delta) * dinv * rr
+                        )
+                        z = z + dd
+                        rho_old = rho
+                return z
+            if kind == "mcgs":
+                # multicolor GS: one halo exchange per sweep (halo is
+                # sweep-stale, the reference's per-rank semantics);
+                # same-color local rows update together
+                ncolors = meta
+                colors = lp[5]
+                dinv = jnp.where(d != 0, 1.0 / d, 1.0)
+                om = jnp.asarray(omega, r_l.dtype)
+                ell_cols, ell_vals = sh["ell"]
+                if z is None:
+                    z = jnp.zeros_like(r_l)
+                for _s in range(sweeps):
+                    halo = exchange_halo(levels[l].A, sh, z, axis)
+                    for c in range(ncolors):
+                        xf = jnp.concatenate([z, halo])
+                        y = jnp.sum(ell_vals * xf[ell_cols], axis=-1)
+                        z = jnp.where(
+                            colors == c,
+                            z + om * dinv * (r_l - y),
+                            z,
+                        )
+                return z
+            if kind == "l1":
                 # L1 diagonal: a_ii + sum_{j!=i} |a_ij| (reference
                 # jacobi_l1_solver.cu) — computed from the shard's ELL
                 # values, one cheap reduction per sweep set

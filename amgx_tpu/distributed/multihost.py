@@ -12,12 +12,13 @@ B2L maps from neighbor metadata).  TPU shape:
     block using only the block itself plus the global partition
     offsets — the global matrix is never materialized anywhere.
   * :func:`partition_from_local_parts` assembles the
-    :class:`DistributedMatrix` from the per-part localized blocks.
-    The EXCHANGE PLAN needs only each part's halo-id list
-    (O(boundary) ints per part); the stacked device arrays are
-    assembled in one process here — a true multi-host launch would
-    keep each host's slice local and all_gather just the halo-id
-    lists (round-3).  Tests validate bit-equality against the
+    :class:`DistributedMatrix` from the per-part localized blocks in
+    ONE process (stacked numpy arrays) — the single-host test shape.
+  * :func:`sharded_partition` is the true multi-host assembly: each
+    process materializes only its own parts' device arrays, the
+    exchange plan rides an allgather of the O(boundary) halo-id
+    lists, and the stacked arrays are ``jax.Array``s sharded one part
+    per mesh device.  Tests validate bit-equality against the
     global-matrix path.
 """
 
@@ -83,7 +84,12 @@ def _reraise_unless_initialized(jax):
     those too) must propagate, or this process would silently continue
     on a single-process runtime and wedge the other hosts at the first
     collective."""
-    state = getattr(jax.distributed, "global_state", None)
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if not is_init():
+            raise
+        return
+    state = getattr(jax.distributed, "global_state", None)  # older jax
     if state is None or getattr(state, "client", None) is None:
         raise
 
@@ -139,10 +145,9 @@ def partition_from_local_parts(
 
     ``parts[p]`` is :func:`local_part_from_rows`'s output for part p.
     This assembly is single-process (it stacks every part's localized
-    CSR into the [N, rows, w] device arrays); in a true multi-host
-    launch each host would keep only its own slice and the EXCHANGE
-    PLAN inputs (each part's O(boundary) ``halo_glob`` list) would
-    ride one small all_gather — that collective leg is round-3 work.
+    CSR into the [N, rows, w] device arrays); the true multi-host
+    assembly — per-process slices + the halo-id allgather — is
+    :func:`sharded_partition`.
     """
     part_offsets = np.asarray(part_offsets, dtype=np.int64)
     n_parts = len(parts)
@@ -311,14 +316,33 @@ def sharded_partition(
                 per_dev[p]["ell_wvals"] = tv
                 per_dev[p]["ell_wbase"] = bs
 
+    # global shapes/dtypes derived WITHOUT local leaves: a process whose
+    # addressable mesh devices own no parts passes an empty leaf list
+    # (make_array_from_single_device_arrays accepts it with an explicit
+    # dtype) and still constructs the same global arrays.
+    from amgx_tpu.ops.pallas_well import _ROW_TILE, _SUB
+
+    nt = -(-rows_pp // _ROW_TILE)
+    spec = {
+        "ell_cols": ((rows_pp, w), np.int32),
+        "ell_vals": ((rows_pp, w), dtype),
+        "diag": ((rows_pp,), dtype),
+        "own_mask": ((rows_pp,), np.bool_),
+        "int_mask": ((rows_pp,), np.bool_),
+        "ell_wcols": ((nt, _SUB, w * 128), np.int32),
+        "ell_wvals": ((nt, _SUB, w * 128), dtype),
+        "ell_wbase": ((nt,), np.int32),
+    }
+
     def stack(key):
+        shp, dt = spec[key]
         leaves = [
             jax.device_put(per_dev[p][key][None], devices[p])
             for p in sorted(per_dev)
         ]
-        shape = (n_parts,) + leaves[0].shape[1:]
         return jax.make_array_from_single_device_arrays(
-            shape, NamedSharding(mesh, P(axis)), leaves
+            (n_parts,) + shp, NamedSharding(mesh, P(axis)), leaves,
+            dtype=np.dtype(dt),
         )
 
     return DistributedMatrix(
