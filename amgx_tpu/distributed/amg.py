@@ -72,7 +72,8 @@ class DistributedAMG:
     def __init__(self, Asp: sps.csr_matrix, mesh: Mesh, cfg=None,
                  scope: str = "default",
                  consolidate_rows: int | None = None,
-                 owner=None, grid=None):
+                 owner=None, grid=None,
+                 grade_lower: int | None = None):
         from amgx_tpu.config.amg_config import AMGConfig
 
         self.mesh = mesh
@@ -105,6 +106,11 @@ class DistributedAMG:
                 lower * self.n_parts if lower > 0 else _CONSOLIDATE_ROWS
             )
         self.consolidate_rows = consolidate_rows
+        from amgx_tpu.distributed.hierarchy import _GRADE_LOWER
+
+        self.grade_lower = (
+            _GRADE_LOWER if grade_lower is None else grade_lower
+        )
         self._owner = owner
         self._grid = grid
         self._setup(Asp)
@@ -162,6 +168,7 @@ class DistributedAMG:
             Asp, self.n_parts, self.cfg, self.scope,
             grid=self._grid, owner=self._owner,
             consolidate_rows=self.consolidate_rows,
+            grade_lower=self.grade_lower,
         )
         self.fine = self.h.levels[0].A
         self._setup_level_smoothers()
@@ -371,6 +378,21 @@ class DistributedAMG:
             rr = r_l - spmvs[l](sh, z)
             Pc, Pv, Rc, Rv = lp[1], lp[2], lp[3], lp[4]
             rc = jnp.sum(Rv * rr[Rc], axis=1)
+            # graded-consolidation bridge (reference glue_vector):
+            # members' restricted partials ppermute onto their group
+            # leader; non-leaders continue with a zero coarse system
+            bridge = levels[l].bridge
+            if bridge is not None:
+                perms_down, is_leader = bridge
+                lead_m = jnp.asarray(is_leader)
+                me = jax.lax.axis_index(axis)
+                # log-depth reduction: each step forwards the
+                # ACCUMULATED subtree partials (see _grade_groups)
+                for perm in perms_down:
+                    rc = rc + jax.lax.ppermute(
+                        rc, axis, perm=list(perm)
+                    )
+                rc = jnp.where(lead_m[me], rc, 0.0)
             # gamma/K-cycles visit the coarse level more than once
             # (reference fixed_cycle.cu / cg_[flex_]cycle.cu); branch
             # only on the top levels to bound the unrolled trace, like
@@ -394,6 +416,14 @@ class DistributedAMG:
                         l + 1, lps, tail_params, rc2,
                         branching=(self.cycle_type == "W"),
                     )
+            if bridge is not None:
+                # unglue: tree-broadcast the leader's correction back to
+                # its group members (reference unglue_vector) — the
+                # reduction steps inverted and replayed in reverse
+                ec = jnp.where(lead_m[me], ec, 0.0)
+                for perm in reversed(perms_down):
+                    inv = [(dst, src) for (src, dst) in perm]
+                    ec = ec + jax.lax.ppermute(ec, axis, perm=inv)
             z = z + jnp.sum(Pv * ec[Pc], axis=1)
             z = smooth(l, lp, r_l, z, post)
             return z

@@ -52,6 +52,16 @@ from amgx_tpu.distributed.partition import (
 # (reference matrix_consolidation_lower_threshold semantics).
 _CONSOLIDATE_ROWS = 4096
 
+# Graded consolidation (reference glue_matrices, amg.cu:302-360): when
+# the AVERAGE owned rows per active shard drops below _GRADE_LOWER,
+# group shards (progressive power-of-two halving) and give each
+# group's coarse rows to its leader until the average recovers — the
+# sub-mesh tier between fully sharded and fully replicated.  Grading
+# moves OWNERSHIP only; aggregation already ran per original shard, so
+# the preconditioner is algorithmically unchanged at the graded level
+# itself.  0 disables.
+_GRADE_LOWER = 1024
+
 
 @dataclasses.dataclass
 class DistLevel:
@@ -65,6 +75,12 @@ class DistLevel:
     # R = P^T block: owned coarse rows x owned fine cols.
     R_cols: Optional[np.ndarray] = None
     R_vals: Optional[np.ndarray] = None
+    # graded-consolidation bridge into THIS level's coarse grid:
+    # (perms_down, is_leader) — perms_down[j] is the ppermute pair list
+    # sending member j's restricted partial to its group leader (the
+    # reference's glue_vector); prolongation inverts them.  None when
+    # the coarse grid keeps one part per shard.
+    bridge: Any = None
 
 
 @dataclasses.dataclass
@@ -176,6 +192,61 @@ def _pad_ell_blocks(mats, rows_pad):
     return cols, vals
 
 
+def _grade_groups(ncs, grade_lower):
+    """Grouping of active shards for graded consolidation.
+
+    Returns ``(lead_of, moff, perms_down, is_leader)`` or None when no
+    grading applies.  ``lead_of[p]``/``moff[p]`` place shard p's coarse
+    block inside its leader's row range; ``perms_down[j]`` is the
+    ppermute pair list for member position j+1 of every group.
+    """
+    ncs = np.asarray(ncs, dtype=np.int64)
+    n_parts = ncs.shape[0]
+    active = np.nonzero(ncs > 0)[0]
+    na = len(active)
+    if na <= 1 or grade_lower <= 0:
+        return None
+    nc_global = int(ncs.sum())
+    if nc_global / na >= grade_lower:
+        return None
+    # smallest power-of-two grouping restoring avg >= grade_lower —
+    # progressive halving, so successive levels step through sub-mesh
+    # tiers rather than collapsing to one shard at once
+    g = 1
+    while (na // g) > 1 and nc_global / (na // g) < grade_lower:
+        g *= 2
+    if g == 1:
+        return None
+    lead_of = np.arange(n_parts, dtype=np.int32)
+    moff = np.zeros(n_parts, dtype=np.int64)
+    is_leader = np.zeros(n_parts, dtype=bool)
+    groups = []
+    for i in range(0, na, g):
+        members = active[i: i + g]
+        leader = int(members[0])
+        is_leader[leader] = True
+        groups.append(members)
+        off = 0
+        for p in members:
+            lead_of[p] = leader
+            moff[p] = off
+            off += int(ncs[p])
+    # log-depth reduction tree: step s sends relative position j+s ->
+    # j for j % 2s == 0, so glue/unglue cost log2(g) collective steps
+    # (the cycle ACCUMULATES between steps — subtree sums ride up)
+    perms_down = []
+    s = 1
+    while s < g:
+        step = []
+        for members in groups:
+            for j in range(0, len(members) - s, 2 * s):
+                step.append((int(members[j + s]), int(members[j])))
+        if step:
+            perms_down.append(tuple(step))
+        s *= 2
+    return lead_of, moff, tuple(perms_down), is_leader
+
+
 def build_distributed_hierarchy(
     Asp: sps.csr_matrix,
     n_parts: int,
@@ -185,6 +256,7 @@ def build_distributed_hierarchy(
     owner=None,
     max_levels: int = 20,
     consolidate_rows: int = _CONSOLIDATE_ROWS,
+    grade_lower: int = _GRADE_LOWER,
 ) -> DistHierarchy:
     """The distributed setup loop (reference amg.cu:425-660)."""
     from amgx_tpu.amg.aggregation import infer_grid, stencil_offsets
@@ -219,21 +291,41 @@ def build_distributed_hierarchy(
         nc_global = int(np.sum(ncs))
         if nc_global >= lvl.n_global or nc_global == 0:
             break  # coarsening stalled
-        coffs = np.concatenate([[0], np.cumsum(ncs)[:-1]])
 
-        # coarse global numbering: shard p owns [coffs[p], coffs[p]+nc_p)
-        owner_c = np.repeat(
-            np.arange(lvl.n_parts, dtype=np.int32), ncs
-        )
+        # graded consolidation (sub-mesh tier): leaders own their whole
+        # group's coarse block; members' restricted partials ride the
+        # bridge ppermutes (reference glue_vector/unglue_vector)
+        graded = _grade_groups(ncs, grade_lower)
+        if graded is not None:
+            lead_of, moff, perms_down, is_leader = graded
+            bridge = (perms_down, is_leader)
+        else:
+            lead_of = np.arange(lvl.n_parts, dtype=np.int32)
+            moff = np.zeros(lvl.n_parts, dtype=np.int64)
+            bridge = None
 
-        # per-shard P (owned fine x owned coarse, both local)
+        # coarse global numbering: leader L owns one contiguous block
+        # holding its members' aggregates back to back (no grading:
+        # leader = shard, the per-shard blocks of before)
+        nc_lead = np.zeros(lvl.n_parts, dtype=np.int64)
+        for p in range(lvl.n_parts):
+            nc_lead[lead_of[p]] += ncs[p]
+        goffs = np.concatenate([[0], np.cumsum(nc_lead)[:-1]])
+        # base coarse id of shard p's aggregates
+        cbase = goffs[lead_of] + moff
+        owner_c = np.empty(nc_global, dtype=np.int32)
+        for p in range(lvl.n_parts):
+            if ncs[p]:
+                owner_c[cbase[p]: cbase[p] + ncs[p]] = lead_of[p]
+
+        # per-shard P (owned fine x LEADER-local coarse slots)
         P_blocks = [
             sps.csr_matrix(
                 (
                     np.ones(lvl.counts[p], dtype=lvl.shards[p].dtype),
-                    (np.arange(lvl.counts[p]), aggs[p]),
+                    (np.arange(lvl.counts[p]), moff[p] + aggs[p]),
                 ),
-                shape=(lvl.counts[p], ncs[p]),
+                shape=(lvl.counts[p], int(nc_lead[lead_of[p]])),
             )
             for p in range(lvl.n_parts)
         ]
@@ -243,19 +335,23 @@ def build_distributed_hierarchy(
         # coarse ids; halo rows come from the owning shard's aggregate
         # map — the single-process arranger reads them directly (a real
         # multi-host build ships them point-to-point).
-        coarse_shards, coarse_halos = [], []
         # global fine id -> global coarse id (the union of all shards'
         # aggregate maps; each entry is produced by exactly one owner)
         gagg = np.empty(lvl.n_global, dtype=np.int64)
         for p in range(lvl.n_parts):
-            gagg[lvl.g_rows[p]] = coffs[p] + aggs[p]
+            gagg[lvl.g_rows[p]] = cbase[p] + aggs[p]
 
+        # per-LEADER RAP: members' partial products land on leader-local
+        # rows and are sparse-added (reference csr_RAP_sparse_add /
+        # exchange_RAP_ext — here the single-process arranger sums them
+        # directly)
+        rap = {}
         for p in range(lvl.n_parts):
             A_p = lvl.shards[p]
             nloc = A_p.shape[1]
             # local col -> global coarse id
             col_to_gc = np.empty(nloc, dtype=np.int64)
-            col_to_gc[: lvl.counts[p]] = coffs[p] + aggs[p]
+            col_to_gc[: lvl.counts[p]] = cbase[p] + aggs[p]
             if rows_pp > lvl.counts[p]:
                 col_to_gc[lvl.counts[p]: rows_pp] = 0  # padding, no nnz
             hg = lvl.halo_globs[p]
@@ -268,10 +364,9 @@ def build_distributed_hierarchy(
                 shape=(lvl.counts[p], nc_global),
             )
             AP.sum_duplicates()
-            Ac_p = (P_blocks[p].T @ AP).tocsr()  # (nc_p, nc_global)
-            Ac_p.sum_duplicates()
-            Ac_p.sort_indices()
-            coarse_shards.append(Ac_p)
+            Ac_p = (P_blocks[p].T @ AP).tocsr()  # (nc_lead, nc_global)
+            L = int(lead_of[p])
+            rap[L] = Ac_p if L not in rap else rap[L] + Ac_p
 
         # 4. owned-first renumber of the coarse level
         local_of_c, counts_c, g_rows_c = local_numbering(
@@ -279,8 +374,13 @@ def build_distributed_hierarchy(
         )
         rows_pp_c = max(int(counts_c.max()), 1)
         new_shards, new_halos = [], []
+        empty = sps.csr_matrix(
+            (0, nc_global), dtype=Asp.dtype
+        )
         for p in range(lvl.n_parts):
-            m = coarse_shards[p]
+            m = rap.get(p, empty).tocsr()
+            m.sum_duplicates()
+            m.sort_indices()
             d = localize_columns(
                 m.indptr, m.indices, m.data, owner_c, local_of_c, p,
                 rows_pp_c,
@@ -302,7 +402,7 @@ def build_distributed_hierarchy(
         levels.append(
             DistLevel(
                 A=A_dev, P_cols=P_cols, P_vals=P_vals,
-                R_cols=R_cols, R_vals=R_vals,
+                R_cols=R_cols, R_vals=R_vals, bridge=bridge,
             )
         )
 

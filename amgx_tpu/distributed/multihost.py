@@ -320,7 +320,7 @@ def sharded_partition(
     # addressable mesh devices own no parts passes an empty leaf list
     # (make_array_from_single_device_arrays accepts it with an explicit
     # dtype) and still constructs the same global arrays.
-    from amgx_tpu.ops.pallas_well import _ROW_TILE, _SUB
+    from amgx_tpu.ops.pallas_well import _LANE, _ROW_TILE, _SUB
 
     nt = -(-rows_pp // _ROW_TILE)
     spec = {
@@ -329,8 +329,8 @@ def sharded_partition(
         "diag": ((rows_pp,), dtype),
         "own_mask": ((rows_pp,), np.bool_),
         "int_mask": ((rows_pp,), np.bool_),
-        "ell_wcols": ((nt, _SUB, w * 128), np.int32),
-        "ell_wvals": ((nt, _SUB, w * 128), dtype),
+        "ell_wcols": ((nt, _SUB, w * _LANE), np.int32),
+        "ell_wvals": ((nt, _SUB, w * _LANE), dtype),
         "ell_wbase": ((nt,), np.int32),
     }
 

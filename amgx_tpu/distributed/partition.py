@@ -355,7 +355,10 @@ def build_exchange_plan(halo_globs, owner_fn, local_fn, n_parts):
     pairs = sorted(send_sorted.keys())
     deltas = sorted({dst - src for (src, dst) in pairs})
     dm = None
-    if pairs and len(deltas) <= _MAX_DIRECTIONS:
+    # no pairs at all (every column local on every part — e.g. a level
+    # graded onto one shard) is a valid neighbor plan with zero
+    # directions, NOT an all_gather fallback
+    if len(deltas) <= _MAX_DIRECTIONS:
         perms, send_idx_d = [], []
         halo_dir = np.zeros((n_parts, max_halo), dtype=np.int32)
         halo_pos = np.zeros((n_parts, max_halo), dtype=np.int32)

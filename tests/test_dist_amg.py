@@ -97,3 +97,37 @@ def test_dist_amg_chebyshev_beats_jacobi():
     s_cheb = DistributedAMG(Asp, mesh1d(4), cfg=cfg, scope="amg")
     _, it_cheb, _ = s_cheb.solve(b, max_iters=100, tol=1e-8)
     assert it_cheb <= it_jac, (it_cheb, it_jac)
+
+
+def test_dist_amg_graded_consolidation():
+    """Graded consolidation (reference glue.h sub-mesh tier): forcing
+    the grade thresholds produces a middle level owned by a SUBSET of
+    shards (leaders), with members' restriction partials riding the
+    bridge ppermutes — and the solve converges like the ungraded one."""
+    Asp = poisson_3d_7pt(14).to_scipy()
+    b = poisson_rhs(Asp.shape[0])
+    s_flat = DistributedAMG(
+        Asp, mesh1d(8), consolidate_rows=128, grade_lower=0
+    )
+    # every sharded level keeps 8 active parts without grading
+    assert all(
+        (lvl.A.n_owned > 0).all() for lvl in s_flat.h.levels
+    ), [lvl.A.n_owned for lvl in s_flat.h.levels]
+
+    s_graded = DistributedAMG(
+        Asp, mesh1d(8), consolidate_rows=128,
+        grade_lower=1200,
+    )
+    owned = [lvl.A.n_owned.copy() for lvl in s_graded.h.levels]
+    graded_lvls = [o for o in owned if (o == 0).any() and (o > 0).any()]
+    assert graded_lvls, owned  # a sub-mesh tier exists
+    assert any(
+        lvl.bridge is not None for lvl in s_graded.h.levels
+    )
+
+    x1, it1, _ = s_flat.solve(b, max_iters=100, tol=1e-8)
+    x2, it2, _ = s_graded.solve(b, max_iters=100, tol=1e-8)
+    for x in (x1, x2):
+        rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
+        assert rel < 1e-7, rel
+    assert abs(it1 - it2) <= 3, (it1, it2)

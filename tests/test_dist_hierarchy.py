@@ -20,9 +20,13 @@ def mesh1d(n):
 
 def test_multi_level_hierarchy_shape():
     """>=3 sharded levels; per-shard rows ~ global/N at every level
-    (the VERDICT r1 scalability criterion)."""
+    (the VERDICT r1 scalability criterion).  Grading is disabled: this
+    test pins the FLAT partition shape; the graded sub-mesh tier is
+    covered by test_dist_amg_graded_consolidation."""
     Asp = poisson_3d_7pt(16).to_scipy()
-    s = DistributedAMG(Asp, mesh1d(8), consolidate_rows=128)
+    s = DistributedAMG(
+        Asp, mesh1d(8), consolidate_rows=128, grade_lower=0
+    )
     assert len(s.h.levels) >= 3
     for lvl in s.h.levels:
         A = lvl.A
@@ -64,7 +68,7 @@ def test_galerkin_rows_match_global_product():
     # Galerkin product with the same aggregates
     h2 = build_distributed_hierarchy(
         Asp, 4, cfg, "amg", consolidate_rows=Asp.shape[0] // 2 + 1,
-        max_levels=1,
+        max_levels=1, grade_lower=0,
     )
     tail = h2.tail_matrix
     # Galerkin invariants: symmetry and row sums preserved for the
@@ -266,7 +270,7 @@ def test_distributed_l1_jacobi_smoother():
     s = DistributedAMG(
         Asp, mesh1d(8), cfg=cfg, scope="amg", consolidate_rows=256
     )
-    assert s.l1_jacobi
+    assert s.smoother_kind == "l1"
     x, it, _ = s.solve(b, max_iters=80, tol=1e-8)
     rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
     assert rel < 1e-7, rel
