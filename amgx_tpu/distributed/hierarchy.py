@@ -76,10 +76,12 @@ class DistLevel:
     R_cols: Optional[np.ndarray] = None
     R_vals: Optional[np.ndarray] = None
     # graded-consolidation bridge into THIS level's coarse grid:
-    # (perms_down, is_leader) — perms_down[j] is the ppermute pair list
-    # sending member j's restricted partial to its group leader (the
-    # reference's glue_vector); prolongation inverts them.  None when
-    # the coarse grid keeps one part per shard.
+    # (perms_down, is_leader) — perms_down[j] is step j of a stride-2^j
+    # ppermute REDUCTION TREE toward each group leader (the reference's
+    # glue_vector): the consumer must accumulate (rc += ppermute(rc))
+    # between steps, so forwarded values are subtree sums; prolongation
+    # replays the inverted steps in reverse order.  None when the
+    # coarse grid keeps one part per shard.
     bridge: Any = None
 
 
@@ -197,8 +199,9 @@ def _grade_groups(ncs, grade_lower):
 
     Returns ``(lead_of, moff, perms_down, is_leader)`` or None when no
     grading applies.  ``lead_of[p]``/``moff[p]`` place shard p's coarse
-    block inside its leader's row range; ``perms_down[j]`` is the
-    ppermute pair list for member position j+1 of every group.
+    block inside its leader's row range; ``perms_down[j]`` is step j of
+    a stride-2^j reduction tree toward the leaders — consumers MUST
+    accumulate between steps (see DistLevel.bridge).
     """
     ncs = np.asarray(ncs, dtype=np.int64)
     n_parts = ncs.shape[0]

@@ -180,7 +180,9 @@ def _probe_trial() -> bool:
 
 from amgx_tpu.ops.pallas_probe import KernelProbe  # noqa: E402
 
-pallas_dia_supported = KernelProbe(_probe_trial, _HAVE_PALLAS)
+pallas_dia_supported = KernelProbe(
+    _probe_trial, _HAVE_PALLAS, disable_env="AMGX_TPU_DISABLE_PALLAS_DIA"
+)
 
 
 def pallas_dia_spmv(A, x, interpret=False):

@@ -16,16 +16,35 @@ import jax
 
 class KernelProbe:
     """Callable returning whether ``trial`` compiles AND returns
-    correct results on the current backend (TPU only; cached)."""
+    correct results on the current backend (TPU only; cached).
 
-    def __init__(self, trial: Callable[[], bool], have_pallas: bool):
+    ``disable_env``: name of an environment variable that force-fails
+    the probe without running the trial.  A kernel fault (bad DMA,
+    Mosaic bug) can CRASH the TPU runtime rather than raise, so
+    processes that must survive (bench.py, the C API host) first run
+    the trial in a throwaway subprocess and set this variable when it
+    dies — the in-process probe then never touches the kernel.
+    """
+
+    def __init__(
+        self,
+        trial: Callable[[], bool],
+        have_pallas: bool,
+        disable_env: str | None = None,
+    ):
         self._trial = trial
         self._have = have_pallas
+        self._disable_env = disable_env
         self._ok: dict = {}
 
     def __call__(self) -> bool:
         if not self._have:
             return False
+        if self._disable_env is not None:
+            import os
+
+            if os.environ.get(self._disable_env):
+                return False
         backend = jax.default_backend()
         if backend not in self._ok:
             if backend != "tpu":

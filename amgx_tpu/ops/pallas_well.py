@@ -223,7 +223,10 @@ def _probe_trial() -> bool:
 
 from amgx_tpu.ops.pallas_probe import KernelProbe  # noqa: E402
 
-pallas_well_supported = KernelProbe(_probe_trial, _HAVE_PALLAS)
+pallas_well_supported = KernelProbe(
+    _probe_trial, _HAVE_PALLAS,
+    disable_env="AMGX_TPU_DISABLE_PALLAS_WELL",
+)
 
 
 def pallas_well_spmv(A, x, interpret=False):

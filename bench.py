@@ -181,7 +181,8 @@ def _backend_responsive(timeout_s=240) -> bool:
 
     code = (
         "import amgx_tpu; amgx_tpu.initialize(); "
-        "import jax; jax.devices(); print('ok')"
+        "import jax; jax.devices(); "
+        "print('ok', jax.default_backend())"
     )
     try:
         r = subprocess.run(
@@ -190,18 +191,69 @@ def _backend_responsive(timeout_s=240) -> bool:
             capture_output=True,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-        return r.returncode == 0 and b"ok" in r.stdout
+        if r.returncode != 0 or b"ok" not in r.stdout:
+            return False
+        return r.stdout.split()[-1].decode()
     except subprocess.TimeoutExpired:
         return False
+
+
+def _isolate_kernel_probes(timeout_s=300):
+    """Run each Pallas kernel's compile-probe in a throwaway subprocess
+    BEFORE this process touches the device.  A kernel fault crashes the
+    TPU runtime (observed: misaligned DMA kills the worker) — the
+    subprocess absorbs the crash and the parent disables that kernel
+    via its AMGX_TPU_DISABLE_* variable, keeping the recorded bench on
+    the XLA fallback paths instead of dying."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for mod, env in (
+        ("pallas_dia", "AMGX_TPU_DISABLE_PALLAS_DIA"),
+        ("pallas_well", "AMGX_TPU_DISABLE_PALLAS_WELL"),
+    ):
+        code = (
+            "import amgx_tpu; amgx_tpu.initialize(); import sys; "
+            f"from amgx_tpu.ops.{mod} import {mod}_supported; "
+            f"sys.exit(0 if {mod}_supported() else 3)"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], cwd=here,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            # SIGTERM, not SIGKILL: a SIGKILLed client can wedge the
+            # remote tunnel's lease for many minutes
+            proc.terminate()
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            rc = -1
+        if rc == 0:
+            print(f"bench: {mod} kernel probe ok", file=sys.stderr)
+        else:
+            os.environ[env] = "1"
+            print(
+                f"bench: {mod} probe rc={rc}; kernel disabled "
+                "(XLA fallback)",
+                file=sys.stderr,
+            )
 
 
 def main():
     import os
     import subprocess
 
-    if os.environ.get("_AMGX_BENCH_CHILD") != "1" and not (
-        _backend_responsive()
-    ):
+    backend = (
+        "cpu"
+        if os.environ.get("_AMGX_BENCH_CHILD") == "1"
+        else _backend_responsive()
+    )
+    if not backend:
         # pinned backend unreachable: record CPU numbers rather than
         # hanging (the JSON labels the device)
         print(
@@ -216,6 +268,9 @@ def main():
                 [sys.executable, os.path.abspath(__file__)], env=env
             ).returncode
         )
+
+    if backend == "tpu":
+        _isolate_kernel_probes()
 
     import amgx_tpu
 
