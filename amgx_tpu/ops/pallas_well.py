@@ -48,6 +48,12 @@ except Exception:  # pragma: no cover
 _SUB = 8
 _LANE = 128
 _ROW_TILE = _SUB * _LANE  # 1024 rows per grid step
+# Window alignment (lanes): bases and widths are multiples of one
+# (8, 128) vreg tile so the x-window DMA is a 2-D copy whose sublane
+# start AND extent are multiples of 8 — the only DMA shape validated
+# fault-free on real TPU (non-multiple-of-8 extents crash the worker;
+# see ops/pallas_dia.py).
+_WALIGN = _SUB * _LANE
 # Max column-window width (lanes).  Table cost is W/128 selects per
 # gathered vreg; 16384 lanes = 128 table vregs = 64 KB window buffer.
 _WMAX_DEFAULT = 16384
@@ -131,8 +137,8 @@ def build_windowed_ell(
     empty = cmax < 0
     cmin[empty] = 0
     cmax[empty] = 0
-    bases = (cmin // _LANE) * _LANE
-    W = int(_pad_up(int((cmax - bases).max()) + 1, _LANE))
+    bases = (cmin // _WALIGN) * _WALIGN
+    W = int(_pad_up(int((cmax - bases).max()) + 1, _WALIGN))
     if W > wmax:
         return None
 
@@ -144,11 +150,14 @@ def build_windowed_ell(
     return tcols, tvals, bases.astype(np.int32), W
 
 
-def _well_kernel(x_hbm, bases_ref, cols_ref, vals_ref, o_ref, xwin, sem,
+def _well_kernel(x_hbm, brows_ref, cols_ref, vals_ref, o_ref, xwin, sem,
                  *, w, W):
     t = pl.program_id(0)
+    # 2-D window copy: sublane start (brow, multiple of 8) and extent
+    # (W/128 rows, multiple of 8) are both vreg-tile aligned — the
+    # fault-free DMA shape (see _WALIGN)
     cp = pltpu.make_async_copy(
-        x_hbm.at[pl.ds(bases_ref[t], W)], xwin, sem
+        x_hbm.at[pl.ds(brows_ref[t], W // _LANE)], xwin, sem
     )
     cp.start()
     cp.wait()
@@ -169,8 +178,12 @@ def _pallas_well_spmv(tcols, tvals, bases, x, n_rows, W, interpret=False):
     """y = A @ x from windowed tiled ELL arrays."""
     nt, _, wl = tcols.shape
     w = wl // _LANE
-    # pad x so every window read [base, base+W) is in bounds
-    xp = jnp.pad(x, (0, W))
+    # pad x so every window read [base, base+W) is in bounds, to a
+    # whole number of (8, 128) row tiles
+    xlen = _pad_up(x.shape[0] + W, _WALIGN)
+    xp = jnp.pad(x, (0, xlen - x.shape[0]))
+    x2d = xp.reshape(-1, _LANE)
+    brows = bases // _LANE  # multiples of 8 by construction
 
     out = pl.pallas_call(
         functools.partial(_well_kernel, w=w, W=W),
@@ -189,14 +202,14 @@ def _pallas_well_spmv(tcols, tvals, bases, x, n_rows, W, interpret=False):
         ),
         out_shape=jax.ShapeDtypeStruct((nt, _SUB, _LANE), tvals.dtype),
         scratch_shapes=[
-            pltpu.VMEM((W,), tvals.dtype),
+            pltpu.VMEM((W // _LANE, _LANE), tvals.dtype),
             pltpu.SemaphoreType.DMA,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(xp, bases, tcols, tvals)
+    )(x2d, brows, tcols, tvals)
     return out.reshape(nt * _ROW_TILE)[:n_rows]
 
 

@@ -79,6 +79,28 @@ def reorder_coarse_level(P, R, Ac, dtype):
     return P2, R2, Ac2
 
 
+def _max_tile_span(sp) -> int:
+    """Max raw column span (cmax - cmin + 1) over 1024-row tiles — the
+    alignment-free locality measure the AUTO adoption decision uses
+    (the kernel's W quantizes this up to whole vreg tiles, which would
+    blur genuine locality gains out of a quantized comparison)."""
+    from amgx_tpu.ops.pallas_well import _ROW_TILE
+
+    sp = sp.tocsr()
+    n = sp.shape[0]
+    if sp.nnz == 0:
+        return 0
+    rows = np.repeat(np.arange(n), np.diff(sp.indptr))
+    tiles = rows // _ROW_TILE
+    nt = int(tiles[-1]) + 1
+    cmin = np.full(nt, np.iinfo(np.int64).max)
+    cmax = np.full(nt, -1)
+    np.minimum.at(cmin, tiles, sp.indices)
+    np.maximum.at(cmax, tiles, sp.indices)
+    has = cmax >= 0
+    return int((cmax[has] - cmin[has] + 1).max(initial=0))
+
+
 def maybe_reorder(A, mode: str = "AUTO"):
     """Try an RCM renumbering of ``A``; returns ``(A2, perm)`` with
     ``A2 = A[perm][:, perm]`` or ``(A, None)`` when not worthwhile.
@@ -113,9 +135,15 @@ def maybe_reorder(A, mode: str = "AUTO"):
     sp2.sort_indices()
     A2 = _m.SparseMatrix.from_scipy(sp2, dtype=np.dtype(A.values.dtype))
     if mode == "AUTO":
+        # compare RAW tile spans, not the vreg-quantized kernel widths:
+        # adopt when the ordering halves the locality measure, or when
+        # it unlocks a fast structure the stored order lacks
         gained = A2.has_dia or (
             A2.ell_wwidth is not None
-            and (cur_w is None or A2.ell_wwidth * 2 <= cur_w)
+            and (
+                cur_w is None
+                or _max_tile_span(sp2) * 2 <= _max_tile_span(sp)
+            )
         )
         if not gained:
             return A, None

@@ -172,10 +172,11 @@ def _solve_record(n_side):
     }
 
 
-def _backend_responsive(timeout_s=240) -> bool:
+def _backend_responsive(timeout_s=240):
     """Probe backend init in a subprocess: a broken remote tunnel hangs
     jax.devices() indefinitely, which must not take the benchmark run
-    down with it."""
+    down with it.  Returns the backend name ('tpu'/'cpu'/...) on
+    success, False when the backend is unreachable."""
     import subprocess
     import os
 
@@ -191,9 +192,18 @@ def _backend_responsive(timeout_s=240) -> bool:
             capture_output=True,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-        if r.returncode != 0 or b"ok" not in r.stdout:
+        if r.returncode != 0:
             return False
-        return r.stdout.split()[-1].decode()
+        # parse the token FOLLOWING the 'ok' sentinel: runtime/plugin
+        # chatter may follow on stdout, and a wrong backend string
+        # would silently skip the TPU kernel-probe isolation
+        toks = r.stdout.split()
+        if b"ok" not in toks:
+            return False
+        idx = toks.index(b"ok")
+        if idx + 1 >= len(toks):
+            return False
+        return toks[idx + 1].decode()
     except subprocess.TimeoutExpired:
         return False
 
