@@ -3,8 +3,9 @@
 Reference mapping (SURVEY §2.6/§5.8): the sharded levels come from
 :mod:`amgx_tpu.distributed.hierarchy` (the distributed setup loop,
 amg.cu:425-660); each distributed level smooths with damped Jacobi,
-L1-Jacobi, Chebyshev polynomials, or multicolor GS (reference
-block_jacobi/jacobi_l1/cheb/multicolor_gauss_seidel solvers) and
+L1-Jacobi, Chebyshev polynomials, multicolor GS, or multicolor DILU
+(reference block_jacobi/jacobi_l1/cheb/multicolor_gauss_seidel/
+multicolor_dilu solvers) and
 exchanges halos via neighbor ppermute; restriction/prolongation are
 communication-free (shard-local aggregates).  Below the consolidation
 threshold the remaining hierarchy is replicated on every chip
@@ -66,6 +67,135 @@ def _local_colors(A):
     return out, nc
 
 
+def _local_dilu(A, colors, nc):
+    """Per-shard DILU factor + per-color compact L/U ELL slices
+    (reference multicolor_dilu_solver.cu, the workhorse smoother).
+
+    The factor uses each shard's LOCAL owned couplings only (restricted
+    additive-Schwarz flavor — cross-shard coupling enters through the
+    outer residual, like the reference's per-rank factor):
+
+        E_i = a_ii - sum_{j: color(j) < color(i)} a_ij a_ji / E_j
+
+    Apply = forward color sweep (E+L) y = r, backward (E+U) z = E y.
+    Rows are sliced per color into compact stacked arrays, so one
+    application costs O(nnz) total — each stored entry is touched by
+    exactly one forward and one backward stage.
+
+    Returns a tuple (one entry per color) of stacked arrays
+    ``(ridx, Lc, Lv, Uc, Uv, Einv)``; row/column pads point at the
+    spill slot ``rows_pp`` with zero values/Einv.
+    """
+    ell_cols = np.asarray(A.ell_cols)
+    ell_vals = np.asarray(A.ell_vals)
+    n_parts, rows_pp, w = ell_cols.shape
+    per = []  # [p][c] -> dict
+    for p in range(n_parts):
+        nr = int(A.n_owned[p]) if A.n_owned is not None else rows_pp
+        cp = colors[p]
+        rid = np.repeat(np.arange(rows_pp), w).reshape(rows_pp, w)
+        keep = (
+            (ell_vals[p] != 0) & (ell_cols[p] < nr) & (rid < nr)
+        )
+        Al = sps.csr_matrix(
+            (
+                ell_vals[p][keep],
+                (rid[keep], ell_cols[p][keep]),
+            ),
+            shape=(nr, nr),
+        )
+        d = np.asarray(Al.diagonal())
+        # pairwise products p_ij = a_ij * a_ji on the symmetric-
+        # intersection pattern (Hadamard with the transpose)
+        Pm = Al.multiply(Al.T.tocsr()).tocsr()
+        E = d.copy()
+        for c in range(1, nc):
+            rows_c = np.nonzero(cp[:nr] == c)[0]
+            if not len(rows_c):
+                continue
+            lower = (cp[:nr] >= 0) & (cp[:nr] < c)
+            invE = np.where(
+                lower & (E != 0), 1.0 / np.where(E != 0, E, 1.0), 0.0
+            )
+            E[rows_c] = d[rows_c] - Pm[rows_c] @ invE
+        einv = np.where(E != 0, 1.0 / np.where(E != 0, E, 1.0), 0.0)
+
+        Alc = Al.tocoo()
+        row_color = cp[:nr][Alc.row]
+        col_color = cp[:nr][Alc.col]
+        shard_cols = []
+        for c in range(nc):
+            rows_c = np.nonzero(cp[:nr] == c)[0]
+            sel = row_color == c
+            r_of = np.full(nr, -1, dtype=np.int64)
+            r_of[rows_c] = np.arange(len(rows_c))
+            ent_r = r_of[Alc.row[sel]]
+            ent_c = Alc.col[sel]
+            ent_v = Alc.data[sel]
+            low = col_color[sel] < c  # rows here all have color c
+            off = ent_c != Alc.row[sel]  # strictly off-diagonal
+            shard_cols.append(
+                dict(
+                    rows=rows_c,
+                    einv=einv[rows_c],
+                    L=(ent_r[off & low], ent_c[off & low],
+                       ent_v[off & low]),
+                    U=(ent_r[off & ~low], ent_c[off & ~low],
+                       ent_v[off & ~low]),
+                )
+            )
+        per.append(shard_cols)
+
+    def pack(trip, n_rows_c, width):
+        er, ec, ev = trip
+        cols = np.full((n_rows_c, width), rows_pp, dtype=np.int32)
+        vals = np.zeros((n_rows_c, width), dtype=ell_vals.dtype)
+        if len(er):
+            order = np.argsort(er, kind="stable")
+            er, ec, ev = er[order], ec[order], ev[order]
+            pos = np.arange(len(er)) - np.searchsorted(er, er)
+            cols[er, pos] = ec
+            vals[er, pos] = ev
+        return cols, vals
+
+    meta = []
+    for c in range(nc):
+        rc_max = max(max(len(per[p][c]["rows"]) for p in range(n_parts)), 1)
+        wl = max(
+            max(
+                (np.bincount(per[p][c]["L"][0]).max()
+                 if len(per[p][c]["L"][0]) else 0)
+                for p in range(n_parts)
+            ),
+            1,
+        )
+        wu = max(
+            max(
+                (np.bincount(per[p][c]["U"][0]).max()
+                 if len(per[p][c]["U"][0]) else 0)
+                for p in range(n_parts)
+            ),
+            1,
+        )
+        ridx = np.full((n_parts, rc_max), rows_pp, dtype=np.int32)
+        einv = np.zeros((n_parts, rc_max), dtype=ell_vals.dtype)
+        Lc = np.full((n_parts, rc_max, wl), rows_pp, dtype=np.int32)
+        Lv = np.zeros((n_parts, rc_max, wl), dtype=ell_vals.dtype)
+        Uc = np.full((n_parts, rc_max, wu), rows_pp, dtype=np.int32)
+        Uv = np.zeros((n_parts, rc_max, wu), dtype=ell_vals.dtype)
+        for p in range(n_parts):
+            e = per[p][c]
+            k = len(e["rows"])
+            ridx[p, :k] = e["rows"]
+            einv[p, :k] = e["einv"]
+            lc, lv = pack(e["L"], max(k, 1), wl)
+            uc, uv = pack(e["U"], max(k, 1), wu)
+            Lc[p, :k], Lv[p, :k] = lc[:k], lv[:k]
+            Uc[p, :k], Uv[p, :k] = uc[:k], uv[:k]
+        meta.append((ridx, Lc, Lv, Uc, Uv, einv))
+    return tuple(meta)
+
+
 class DistributedAMG:
     """Multi-level distributed AMG-PCG solver."""
 
@@ -125,6 +255,7 @@ class DistributedAMG:
         "MULTICOLOR_GS": "mcgs",
         "GS": "mcgs",
         "FIXCOLOR_GS": "mcgs",
+        "MULTICOLOR_DILU": "dilu",
     }
 
     def _setup(self, Asp):
@@ -135,8 +266,8 @@ class DistributedAMG:
 
             warnings.warn(
                 f"distributed smoother {sname}: using damped Jacobi "
-                "(Jacobi/L1/Chebyshev/multicolor-GS are the sharded-"
-                "level roster)"
+                "(Jacobi/L1/Chebyshev/multicolor-GS/DILU are the "
+                "sharded-level roster)"
             )
             self.smoother_kind = "jacobi"
         if self.smoother_kind == "cheby":
@@ -243,6 +374,10 @@ class DistributedAMG:
             elif self.smoother_kind == "mcgs":
                 colors, ncolors = _local_colors(A)
                 self._level_smooth.append(("mcgs", ncolors))
+            elif self.smoother_kind == "dilu":
+                lcolors, ncolors = _local_colors(A)
+                colors = _local_dilu(A, lcolors, ncolors)
+                self._level_smooth.append(("dilu", ncolors))
             else:
                 self._level_smooth.append((self.smoother_kind, None))
             self._level_colors.append(colors)
@@ -263,8 +398,12 @@ class DistributedAMG:
             entry = [_shard_params(lvl.A)]
             for a in (lvl.P_cols, lvl.P_vals, lvl.R_cols, lvl.R_vals):
                 entry.append(None if a is None else jnp.asarray(a))
-            colors = self._level_colors[i]
-            entry.append(None if colors is None else jnp.asarray(colors))
+            sdata = self._level_colors[i]
+            entry.append(
+                None
+                if sdata is None
+                else jax.tree.map(jnp.asarray, sdata)
+            )
             out.append(tuple(entry))
         if len(self.h.levels) > 1:
             out.append(())
@@ -335,6 +474,40 @@ class DistributedAMG:
                             z + om * dinv * (r_l - y),
                             z,
                         )
+                return z
+            if kind == "dilu":
+                # per-shard DILU (restricted additive Schwarz): forward
+                # color sweep (E+L) y = rr, backward (E+U) z' = E y —
+                # compact per-color slices, O(nnz) per application;
+                # cross-shard coupling enters through the outer
+                # residual (one distributed SpMV per sweep)
+                ncolors = meta
+                slices = lp[5]
+                om = jnp.asarray(omega, r_l.dtype)
+                nloc = r_l.shape[0]
+
+                def minv(rr):
+                    rx = jnp.concatenate(
+                        [rr, jnp.zeros((1,), rr.dtype)]
+                    )
+                    y = jnp.zeros(nloc + 1, rr.dtype)
+                    for c in range(ncolors):
+                        ridx, Lc, Lv, _, _, einv = slices[c]
+                        ly = jnp.sum(Lv * y[Lc], axis=-1)
+                        y = y.at[ridx].set(einv * (rx[ridx] - ly))
+                    zz = jnp.zeros(nloc + 1, rr.dtype)
+                    for c in range(ncolors - 1, -1, -1):
+                        ridx, _, _, Uc, Uv, einv = slices[c]
+                        uz = jnp.sum(Uv * zz[Uc], axis=-1)
+                        zz = zz.at[ridx].set(y[ridx] - einv * uz)
+                    return zz[:nloc]
+
+                for i in range(sweeps):
+                    rr = r_l if (i == 0 and z is None) else (
+                        r_l - spmvs[l](sh, z)
+                    )
+                    upd = om * minv(rr)
+                    z = upd if z is None else z + upd
                 return z
             if kind == "l1":
                 # L1 diagonal: a_ii + sum_{j!=i} |a_ij| (reference

@@ -200,7 +200,9 @@ def _backend_responsive(timeout_s=240):
         toks = r.stdout.split()
         if b"ok" not in toks:
             return False
-        idx = toks.index(b"ok")
+        # LAST occurrence: the sentinel is the child's final print, and
+        # runtime chatter can contain a standalone 'ok' before it
+        idx = len(toks) - 1 - toks[::-1].index(b"ok")
         if idx + 1 >= len(toks):
             return False
         return toks[idx + 1].decode()

@@ -63,8 +63,10 @@ def _smoother_cfg(smoother_json):
         '{"scope": "gs", "solver": "MULTICOLOR_GS",'
         ' "relaxation_factor": 1.0, "monitor_residual": 0}',
         '{"scope": "jl1", "solver": "JACOBI_L1", "monitor_residual": 0}',
+        '{"scope": "dilu", "solver": "MULTICOLOR_DILU",'
+        ' "relaxation_factor": 1.0, "monitor_residual": 0}',
     ],
-    ids=["chebyshev", "multicolor_gs", "jacobi_l1"],
+    ids=["chebyshev", "multicolor_gs", "jacobi_l1", "multicolor_dilu"],
 )
 def test_dist_amg_smoother_roster(smoother_json, recwarn):
     """Sharded levels smooth with the full roster (Chebyshev polynomial,
