@@ -38,6 +38,7 @@ from amgx_tpu.distributed.solve import (
     exchange_halo,
     make_local_spmv,
 )
+from amgx_tpu.core.profiling import named_scope, trace_range
 
 
 def _local_colors(A):
@@ -424,7 +425,11 @@ class DistributedAMG:
 
         level_smooth = self._level_smooth
 
-        def smooth(l, lp, r_l, z, sweeps):
+        def smooth(l, lp, r_l, z, sweeps, tag):
+            with named_scope(f"damg_l{l}_{tag}"):
+                return _smooth_body(l, lp, r_l, z, sweeps)
+
+        def _smooth_body(l, lp, r_l, z, sweeps):
             sh = lp[0]
             d = sh["diag"]
             kind, meta = level_smooth[l]
@@ -537,20 +542,24 @@ class DistributedAMG:
             if l == len(levels) - 1:
                 # consolidation bridge: gather -> replicated tail cycle
                 # -> scatter back to owned slots (glue_vector/unglue)
-                pool = jax.lax.all_gather(r_l, axis)  # [N, rows_pp]
-                rg = jnp.zeros((ng,), r_l.dtype)
-                # .add, not .set: padding slots alias id 0 (masked to 0)
-                rg = rg.at[pool_ids_flat].add(
-                    jnp.where(pool_msk_flat, pool.reshape(-1), 0.0)
-                )
-                eg = tail_cycle(tail_params, rg, jnp.zeros_like(rg))
+                with named_scope(f"damg_l{l}_tail_glue"):
+                    pool = jax.lax.all_gather(r_l, axis)  # [N, rows_pp]
+                    rg = jnp.zeros((ng,), r_l.dtype)
+                    # .add, not .set: padding slots alias id 0
+                    # (masked to 0)
+                    rg = rg.at[pool_ids_flat].add(
+                        jnp.where(pool_msk_flat, pool.reshape(-1), 0.0)
+                    )
+                with named_scope("damg_tail_cycle"):
+                    eg = tail_cycle(tail_params, rg, jnp.zeros_like(rg))
                 me = jax.lax.axis_index(axis)
                 return jnp.where(msk[me], eg[gids[me]], 0.0)
             sh = lp[0]
-            z = smooth(l, lp, r_l, None, pre)
-            rr = r_l - spmvs[l](sh, z)
-            Pc, Pv, Rc, Rv = lp[1], lp[2], lp[3], lp[4]
-            rc = jnp.sum(Rv * rr[Rc], axis=1)
+            z = smooth(l, lp, r_l, None, pre, "presmooth")
+            with named_scope(f"damg_l{l}_restrict"):
+                rr = r_l - spmvs[l](sh, z)
+                Pc, Pv, Rc, Rv = lp[1], lp[2], lp[3], lp[4]
+                rc = jnp.sum(Rv * rr[Rc], axis=1)
             # graded-consolidation bridge (reference glue_vector):
             # members' restricted partials ppermute onto their group
             # leader; non-leaders continue with a zero coarse system
@@ -561,11 +570,12 @@ class DistributedAMG:
                 me = jax.lax.axis_index(axis)
                 # log-depth reduction: each step forwards the
                 # ACCUMULATED subtree partials (see _grade_groups)
-                for perm in perms_down:
-                    rc = rc + jax.lax.ppermute(
-                        rc, axis, perm=list(perm)
-                    )
-                rc = jnp.where(lead_m[me], rc, 0.0)
+                with named_scope(f"damg_l{l}_glue"):
+                    for perm in perms_down:
+                        rc = rc + jax.lax.ppermute(
+                            rc, axis, perm=list(perm)
+                        )
+                    rc = jnp.where(lead_m[me], rc, 0.0)
             # gamma/K-cycles visit the coarse level more than once
             # (reference fixed_cycle.cu / cg_[flex_]cycle.cu); branch
             # only on the top levels to bound the unrolled trace, like
@@ -593,12 +603,14 @@ class DistributedAMG:
                 # unglue: tree-broadcast the leader's correction back to
                 # its group members (reference unglue_vector) — the
                 # reduction steps inverted and replayed in reverse
-                ec = jnp.where(lead_m[me], ec, 0.0)
-                for perm in reversed(perms_down):
-                    inv = [(dst, src) for (src, dst) in perm]
-                    ec = ec + jax.lax.ppermute(ec, axis, perm=inv)
-            z = z + jnp.sum(Pv * ec[Pc], axis=1)
-            z = smooth(l, lp, r_l, z, post)
+                with named_scope(f"damg_l{l}_unglue"):
+                    ec = jnp.where(lead_m[me], ec, 0.0)
+                    for perm in reversed(perms_down):
+                        inv = [(dst, src) for (src, dst) in perm]
+                        ec = ec + jax.lax.ppermute(ec, axis, perm=inv)
+            with named_scope(f"damg_l{l}_prolong"):
+                z = z + jnp.sum(Pv * ec[Pc], axis=1)
+            z = smooth(l, lp, r_l, z, post, "postsmooth")
             return z
 
         def kcycle(l, lps, tail_params, b_c):
