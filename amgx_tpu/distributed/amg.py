@@ -204,7 +204,8 @@ class DistributedAMG:
                  scope: str = "default",
                  consolidate_rows: int | None = None,
                  owner=None, grid=None,
-                 grade_lower: int | None = None):
+                 grade_lower: int | None = None,
+                 _local=None):
         from amgx_tpu.config.amg_config import AMGConfig
 
         self.mesh = mesh
@@ -244,7 +245,28 @@ class DistributedAMG:
         )
         self._owner = owner
         self._grid = grid
+        self._local = _local
         self._setup(Asp)
+
+    @classmethod
+    def from_local_parts(
+        cls, local_parts, part_offsets, mesh: Mesh, cfg=None,
+        scope: str = "default", consolidate_rows: int | None = None,
+        grade_lower: int | None = None, comm=None,
+    ):
+        """Per-process entry (reference per-rank upload + setup_v2):
+        ``local_parts[p]`` is multihost.local_part_from_rows output for
+        the parts this process drives; the global matrix is never
+        materialized.  Setup traffic rides the comm fabric
+        (distributed.comm.default_comm when None)."""
+        from amgx_tpu.distributed.partition import OffsetOwnership
+
+        return cls(
+            None, mesh, cfg=cfg, scope=scope,
+            consolidate_rows=consolidate_rows,
+            grade_lower=grade_lower,
+            _local=(local_parts, OffsetOwnership(part_offsets), comm),
+        )
 
     # ------------------------------------------------------------------
 
@@ -296,12 +318,25 @@ class DistributedAMG:
         self.cycle_iters = int(self.cfg.get("cycle_iters", self.scope))
         self._solve_cache = {}
 
-        self.h: DistHierarchy = build_distributed_hierarchy(
-            Asp, self.n_parts, self.cfg, self.scope,
-            grid=self._grid, owner=self._owner,
-            consolidate_rows=self.consolidate_rows,
-            grade_lower=self.grade_lower,
-        )
+        if self._local is not None:
+            from amgx_tpu.distributed.hierarchy import (
+                build_distributed_hierarchy_local,
+            )
+
+            local_parts, ownership, comm = self._local
+            self.h: DistHierarchy = build_distributed_hierarchy_local(
+                local_parts, ownership, self.cfg, self.scope,
+                comm=comm,
+                consolidate_rows=self.consolidate_rows,
+                grade_lower=self.grade_lower,
+            )
+        else:
+            self.h = build_distributed_hierarchy(
+                Asp, self.n_parts, self.cfg, self.scope,
+                grid=self._grid, owner=self._owner,
+                consolidate_rows=self.consolidate_rows,
+                grade_lower=self.grade_lower,
+            )
         self.fine = self.h.levels[0].A
         self._setup_level_smoothers()
 

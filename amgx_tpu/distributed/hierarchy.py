@@ -5,44 +5,58 @@ exchange_RAP_ext, consolidation glue.h:200).
 
 TPU-first structure
 -------------------
-Setup runs on host per *shard*: every coarsening step consumes only a
-shard's owned rows plus one-ring halo data, so on a multi-host
-deployment each process holds ~global/N of every level.  The steps per
-level, mirroring the reference flow:
+Setup runs on host **per part**: every coarsening step consumes only a
+part's owned rows plus one-ring halo data, and every cross-part byte
+flows through the :mod:`amgx_tpu.distributed.comm` fabric — a part's
+setup never indexes another part's arrays, so on a multi-host
+deployment each process holds ~global/N of every level (the reference's
+per-rank setup_v2 shape).  The steps per level:
 
-  1. shard-local aggregation on the owned submatrix (geometric blocks
+  1. part-local aggregation on the owned submatrix (geometric blocks
      when the local box is stencil-structured, matching handshake
-     otherwise) — aggregates never span shards, so P and R are block-
-     diagonal across shards and restriction/prolongation need NO
+     otherwise) — aggregates never span parts, so P and R are block-
+     diagonal across parts and restriction/prolongation need NO
      communication in the solve;
-  2. halo P-row exchange: a shard fetches the P rows of its fine halo
-     nodes from their owners (reference exchange_halo_rows_P);
-  3. shard-local Galerkin rows: Ac_p = P_pᵀ (A_p P_ext) — the coarse
+  2. halo coarse-id fetch: a part requests the coarse assignment of its
+     fine halo nodes from their owners (reference exchange_halo_rows_P)
+     — one O(boundary) request/answer round on the comm fabric;
+  3. part-local Galerkin rows: Ac_p = P_pᵀ (A_p P_ext) — the coarse
      rows owned by p, with columns in global coarse numbering
-     (reference exchange_RAP_ext + csr_RAP_sparse_add);
-  4. owned-first renumber of the coarse level (halo appended) and a new
-     neighbor-exchange plan.
+     (reference exchange_RAP_ext + csr_RAP_sparse_add); under graded
+     consolidation the partial rows ride the fabric to their group
+     leader, which sparse-adds them in part order;
+  4. owned-first renumber of the coarse level against ANALYTIC coarse
+     ownership (leaders own contiguous id blocks — O(n_parts) offsets,
+     no global-length arrays) and a new neighbor-exchange plan built
+     from allgathered O(boundary) halo-id lists.
 
 Coarsening continues until the global coarse size drops below the
 consolidation threshold; the remaining hierarchy is *consolidated*
 (gathered and replicated on every chip — reference glue_matrices) where
 coarse work is too small to shard profitably.  The solve-side cycle
-runs the distributed levels with ppermute halo exchange and damped
-Jacobi smoothing, then the replicated tail as a standard AMG cycle.
+runs the distributed levels with ppermute halo exchange, then the
+replicated tail as a standard AMG cycle.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import scipy.sparse as sps
 
+from amgx_tpu.distributed.comm import (
+    LoopbackComm,
+    fetch_by_owner,
+)
 from amgx_tpu.distributed.partition import (
+    ArrayOwnership,
     DistributedMatrix,
+    OffsetOwnership,
+    Ownership,
     finalize_partition,
-    local_numbering,
+    halo_localize,
     localize_columns,
     partition_rows,
 )
@@ -94,6 +108,9 @@ class DistHierarchy:
     # mapping: stacked coarse vector [N, rows_pp] <-> tail global rows
     tail_owner: Optional[np.ndarray] = None
     tail_local_of: Optional[np.ndarray] = None
+    # per-process setup accounting: comm traffic + peak per-part sizes
+    # (the O(global/N) memory-contract evidence)
+    setup_stats: Optional[dict] = None
 
 
 def _local_aggregate(A_pp: sps.csr_matrix, cfg, scope) -> np.ndarray:
@@ -102,74 +119,6 @@ def _local_aggregate(A_pp: sps.csr_matrix, cfg, scope) -> np.ndarray:
     from amgx_tpu.amg.aggregation import select_aggregates
 
     return select_aggregates(A_pp, cfg, scope)[0]
-
-
-class _ShardedLevelCSR:
-    """Host-side per-shard CSR state of one level (the arranger's view:
-    owned rows, local columns owned-first + halo, global halo ids)."""
-
-    def __init__(self, shards, halo_globs, g_rows, owner, local_of,
-                 counts):
-        self.shards = shards  # list[sps.csr_matrix] local cols
-        self.halo_globs = halo_globs  # list[np.ndarray] global ids
-        self.g_rows = g_rows  # list[np.ndarray] owned global row ids
-        self.owner = owner
-        self.local_of = local_of
-        self.counts = counts
-
-    @property
-    def n_parts(self):
-        return len(self.shards)
-
-    @property
-    def n_global(self):
-        return int(self.counts.sum())
-
-
-def _shard_the_matrix(Asp, owner, n_parts) -> _ShardedLevelCSR:
-    """Initial sharding of the (fine) matrix — the stand-in for the
-    reference's distributed upload; each entry of `shards` is what one
-    rank would hold."""
-    local_of, counts, part_rows = local_numbering(owner, n_parts)
-    rows_pp = max(int(counts.max()), 1)
-    shards, halo_globs = [], []
-    for p in range(n_parts):
-        local = Asp[part_rows[p]].tocsr()
-        d = localize_columns(
-            local.indptr, local.indices, local.data, owner, local_of,
-            p, rows_pp,
-        )
-        nloc = rows_pp + len(d["halo_glob"])
-        shards.append(
-            sps.csr_matrix(
-                (d["vals"], d["cols"], d["indptr"]),
-                shape=(counts[p], nloc),
-            )
-        )
-        halo_globs.append(d["halo_glob"])
-    return _ShardedLevelCSR(
-        shards, halo_globs, part_rows, owner, local_of, counts
-    )
-
-
-def _level_device_arrays(lvl: _ShardedLevelCSR) -> DistributedMatrix:
-    """Exchange plan + stacked arrays for one level's sharded operator."""
-    rows_pp = max(int(lvl.counts.max()), 1)
-    parts = []
-    for p in range(lvl.n_parts):
-        s = lvl.shards[p]
-        parts.append(
-            dict(
-                indptr=s.indptr,
-                cols=s.indices.astype(np.int32),
-                vals=s.data,
-                halo_glob=lvl.halo_globs[p],
-            )
-        )
-    return finalize_partition(
-        parts, lvl.owner, lvl.local_of, lvl.counts, lvl.n_global,
-        lvl.n_parts,
-    )
 
 
 def _pad_ell_blocks(mats, rows_pad):
@@ -250,6 +199,361 @@ def _grade_groups(ncs, grade_lower):
     return lead_of, moff, tuple(perms_down), is_leader
 
 
+def _finalize_level(
+    parts_by_p: Dict[int, dict],
+    own: Ownership,
+    comm: LoopbackComm,
+    proc_grid=None,
+) -> DistributedMatrix:
+    """Exchange plan + stacked device arrays for one level.
+
+    Single-process (Loopback): every part is local, so the stacked
+    [N, rows, w] numpy arrays are assembled directly.  The exchange
+    plan itself is built from O(boundary) halo-id lists only — the
+    multi-process device assembly (sharded jax.Arrays, one part per
+    addressable device, multihost.sharded_partition's stack shape)
+    plugs in here without touching the setup logic above it.
+    """
+    n_parts = own.n_parts
+    if len(parts_by_p) != n_parts:
+        raise NotImplementedError(
+            "multi-process device assembly of hierarchy levels is not "
+            "wired yet: drive all parts from one process (Loopback) "
+            "or assemble via multihost.sharded_partition"
+        )
+    parts = [parts_by_p[p] for p in range(n_parts)]
+    dm = finalize_partition(
+        parts, None, None, own.counts, own.n_global, n_parts,
+        proc_grid=proc_grid,
+        owner_fn=own.owner_of, local_fn=own.local_of_ids,
+    )
+    if not own.offset_blocks:
+        # owner=None pad/unpad assumes contiguous-by-offset blocks;
+        # other ownerships (grid slabs, arbitrary vectors) attach the
+        # materialized maps — single-process conveniences that hold the
+        # global matrix anyway
+        dm.owner, dm.local_of = own.materialize()
+    return dm
+
+
+def build_distributed_hierarchy_local(
+    local_parts: Dict[int, dict],
+    ownership: Ownership,
+    cfg,
+    scope: str,
+    comm: Optional[LoopbackComm] = None,
+    max_levels: int = 20,
+    consolidate_rows: int = _CONSOLIDATE_ROWS,
+    grade_lower: int = _GRADE_LOWER,
+    proc_grid=None,
+) -> DistHierarchy:
+    """The distributed setup loop from per-process local blocks
+    (reference per-rank setup_v2, amg.cu:425-660).
+
+    ``local_parts[p]`` is the localized CSR dict of part p
+    (``localize_columns``/``local_part_from_rows`` output: owned-first
+    columns, appended halo slots, sorted ``halo_glob``) for each part
+    this process drives (``comm.my_parts``).  ``ownership`` supplies
+    analytic owner/local lookups (O(n_parts) state).  No step consumes
+    a global-length array; cross-part data rides ``comm``.
+    """
+    if comm is None:
+        from amgx_tpu.distributed.comm import default_comm
+
+        comm = default_comm(ownership.n_parts)
+    n_parts = ownership.n_parts
+    my_parts = [p for p in comm.my_parts if p in local_parts]
+    if sorted(local_parts) != sorted(my_parts):
+        raise ValueError(
+            f"local_parts {sorted(local_parts)} != comm.my_parts "
+            f"{sorted(comm.my_parts)}"
+        )
+    max_part_nnz = 0
+    max_part_rows = 0
+
+    # per-level per-part state: csr (counts_p x (rows_pp + n_halo)),
+    # halo_glob
+    def as_csr(part, counts_p, rows_pp):
+        nloc = rows_pp + len(part["halo_glob"])
+        return sps.csr_matrix(
+            (part["vals"], part["cols"], part["indptr"]),
+            shape=(counts_p, nloc),
+        )
+
+    rows_pp0 = max(int(ownership.counts.max()), 1)
+    lvl_parts = {
+        p: dict(
+            A=as_csr(local_parts[p], int(ownership.counts[p]), rows_pp0),
+            halo_glob=np.asarray(
+                local_parts[p]["halo_glob"], dtype=np.int64
+            ),
+        )
+        for p in my_parts
+    }
+    lvl_own: Ownership = ownership
+    levels: List[DistLevel] = []
+
+    while (
+        lvl_own.n_global > consolidate_rows and len(levels) < max_levels
+    ):
+        counts = lvl_own.counts
+        rows_pp = max(int(counts.max()), 1)
+        # 1. part-local aggregation on the owned submatrix
+        aggs: Dict[int, np.ndarray] = {}
+        ncs_local: Dict[int, int] = {}
+        for p in my_parts:
+            A_pp = lvl_parts[p]["A"][:, : counts[p]].tocsr()
+            agg = _local_aggregate(A_pp, cfg, scope)
+            aggs[p] = agg
+            ncs_local[p] = int(agg.max()) + 1 if agg.size else 0
+            max_part_nnz = max(max_part_nnz, lvl_parts[p]["A"].nnz)
+            max_part_rows = max(max_part_rows, int(counts[p]))
+        # replicate the per-part coarse counts (N ints) — every part
+        # then derives the SAME grading + coarse numbering
+        ncs = np.asarray(
+            comm.allgather(ncs_local, kind="coarse-counts"),
+            dtype=np.int64,
+        )
+        nc_global = int(ncs.sum())
+        if nc_global >= lvl_own.n_global or nc_global == 0:
+            break  # coarsening stalled
+
+        # graded consolidation (sub-mesh tier): leaders own their whole
+        # group's coarse block; members' restricted partials ride the
+        # bridge ppermutes (reference glue_vector/unglue_vector)
+        graded = _grade_groups(ncs, grade_lower)
+        if graded is not None:
+            lead_of, moff, perms_down, is_leader = graded
+            bridge = (perms_down, is_leader)
+        else:
+            lead_of = np.arange(n_parts, dtype=np.int32)
+            moff = np.zeros(n_parts, dtype=np.int64)
+            bridge = None
+
+        # coarse global numbering: leader L owns one contiguous block
+        # holding its members' aggregates back to back -> coarse
+        # ownership is ANALYTIC (offsets, O(n_parts) state)
+        nc_lead = np.zeros(n_parts, dtype=np.int64)
+        for p in range(n_parts):
+            nc_lead[lead_of[p]] += ncs[p]
+        coffsets = np.concatenate([[0], np.cumsum(nc_lead)])
+        own_c = OffsetOwnership(coffsets)
+        # base coarse id of part p's aggregates
+        cbase = coffsets[lead_of] + moff
+
+        # per-part P (owned fine x LEADER-local coarse slots)
+        P_blocks = {
+            p: sps.csr_matrix(
+                (
+                    np.ones(counts[p], dtype=lvl_parts[p]["A"].dtype),
+                    (np.arange(counts[p]), moff[p] + aggs[p]),
+                ),
+                shape=(int(counts[p]), int(nc_lead[lead_of[p]])),
+            )
+            for p in my_parts
+        }
+
+        # 2. halo coarse-id fetch: each part requests gagg[h] =
+        # cbase[owner(h)] + agg_owner[local(h)] for its halo ids from
+        # their owners — O(boundary) ids each way on the fabric
+        # (reference exchange_halo_rows_P; no global gagg array exists)
+        requests: Dict[int, Dict[int, np.ndarray]] = {}
+        for p in my_parts:
+            hg = lvl_parts[p]["halo_glob"]
+            if not len(hg):
+                continue
+            owners = lvl_own.owner_of(hg)
+            requests[p] = {
+                int(o): hg[owners == o] for o in np.unique(owners)
+            }
+        answers = fetch_by_owner(
+            comm,
+            requests,
+            lambda o, ids: (
+                cbase[o] + aggs[o][lvl_own.local_of_ids(ids)]
+            ).astype(np.int64),
+            kind="halo-agg",
+        )
+
+        # 3. part-local Galerkin rows with global coarse columns
+        partial_rap: Dict[int, Dict[int, sps.csr_matrix]] = {}
+        for p in my_parts:
+            A_p = lvl_parts[p]["A"]
+            nloc = A_p.shape[1]
+            col_to_gc = np.zeros(nloc, dtype=np.int64)
+            col_to_gc[: counts[p]] = cbase[p] + aggs[p]
+            hg = lvl_parts[p]["halo_glob"]
+            if len(hg):
+                hvals = np.empty(len(hg), dtype=np.int64)
+                owners = lvl_own.owner_of(hg)
+                for o, vals in answers.get(p, {}).items():
+                    hvals[owners == o] = vals
+                col_to_gc[rows_pp: rows_pp + len(hg)] = hvals
+            coo = A_p.tocoo()
+            AP = sps.csr_matrix(
+                (coo.data, (coo.row, col_to_gc[coo.col])),
+                shape=(int(counts[p]), nc_global),
+            )
+            AP.sum_duplicates()
+            Ac_p = (P_blocks[p].T @ AP).tocsr()  # (nc_lead, nc_global)
+            partial_rap.setdefault(int(lead_of[p]), {})[p] = Ac_p
+
+        # route members' partials to their leaders (reference
+        # exchange_RAP_ext / csr_RAP_sparse_add); leaders sum in part
+        # order so the result is independent of the transport
+        outbox = {}
+        for L, by_src in partial_rap.items():
+            for src, Ac_p in by_src.items():
+                if L in my_parts:
+                    continue  # stays local
+                c = Ac_p.tocoo()
+                outbox[(src, L)] = (
+                    c.row.astype(np.int64), c.col.astype(np.int64),
+                    c.data, Ac_p.shape,
+                )
+        inbox = comm.alltoall(outbox, kind="rap-ext")
+        rap: Dict[int, sps.csr_matrix] = {}
+        for L in my_parts:
+            if nc_lead[L] == 0:
+                continue
+            by_src = dict(partial_rap.get(L, {}))
+            for (src, dst), (r, c, v, shp) in inbox.items():
+                if dst == L:
+                    by_src[src] = sps.csr_matrix(
+                        (v, (r, c)), shape=shp
+                    )
+            acc = None
+            for src in sorted(by_src):
+                acc = (
+                    by_src[src] if acc is None else acc + by_src[src]
+                )
+            if acc is not None:
+                rap[L] = acc
+
+        # 4. owned-first renumber of the coarse level (analytic coarse
+        # ownership; halo slots appended per part)
+        rows_pp_c = max(int(own_c.counts.max()), 1)
+        new_parts = {}
+        for p in my_parts:
+            m = rap.get(p)
+            if m is None:
+                m = sps.csr_matrix(
+                    (0, nc_global), dtype=lvl_parts[p]["A"].dtype
+                )
+            m = m.tocsr()
+            m.sum_duplicates()
+            m.sort_indices()
+            gcols = m.indices.astype(np.int64)
+            is_owned = own_c.owner_of(gcols) == p
+            cols, halo_glob = halo_localize(
+                gcols, is_owned,
+                own_c.local_of_ids(gcols[is_owned]), rows_pp_c,
+            )
+            nloc = rows_pp_c + len(halo_glob)
+            new_parts[p] = dict(
+                A=sps.csr_matrix(
+                    (m.data, cols, m.indptr),
+                    shape=(int(own_c.counts[p]), nloc),
+                ),
+                halo_glob=halo_glob,
+            )
+
+        # device arrays for this level (A + P/R stacked blocks)
+        A_dev = _finalize_level(
+            lvl_parts_to_parts(lvl_parts), lvl_own, comm,
+            proc_grid=proc_grid if len(levels) == 0 else None,
+        )
+        P_list = [P_blocks[p] for p in sorted(P_blocks)]
+        P_cols, P_vals = _pad_ell_blocks(P_list, rows_pp)
+        R_list = [P_blocks[p].T.tocsr() for p in sorted(P_blocks)]
+        R_cols, R_vals = _pad_ell_blocks(R_list, rows_pp_c)
+        levels.append(
+            DistLevel(
+                A=A_dev, P_cols=P_cols, P_vals=P_vals,
+                R_cols=R_cols, R_vals=R_vals, bridge=bridge,
+            )
+        )
+
+        lvl_parts = new_parts
+        lvl_own = own_c
+
+    # deepest distributed level (operator only; smoothed, no transfer).
+    # Materialize its owner/local_of arrays — O(tail size), bounded by
+    # consolidate_rows — for the cycle's consolidation gather maps.
+    counts_L = lvl_own.counts
+    rows_pp_L = max(int(counts_L.max()), 1)
+    A_last = _finalize_level(
+        lvl_parts_to_parts(lvl_parts), lvl_own, comm,
+        proc_grid=proc_grid if not levels else None,
+    )
+    owner_L, local_L = lvl_own.materialize()
+    A_last.owner = owner_L
+    A_last.local_of = local_L
+    levels.append(DistLevel(A=A_last))
+
+    # consolidated tail: allgather the last level's rows into one host
+    # matrix in GLOBAL coarse numbering (reference glue_matrices).
+    # O(tail nnz) per part — bounded by the consolidation threshold.
+    tail_local: Dict[int, Any] = {}
+    for p in my_parts:
+        m = lvl_parts[p]["A"].tocoo()
+        hg = lvl_parts[p]["halo_glob"]
+        col_to_g = np.zeros(m.shape[1], dtype=np.int64)
+        g_rows = lvl_own.global_rows(p)
+        col_to_g[: counts_L[p]] = g_rows
+        if len(hg):
+            col_to_g[rows_pp_L: rows_pp_L + len(hg)] = hg
+        tail_local[p] = (
+            g_rows[m.row], col_to_g[m.col], m.data,
+        )
+    gathered = comm.allgather(tail_local, kind="tail-glue")
+    rows = [t[0] for t in gathered]
+    cols = [t[1] for t in gathered]
+    vals = [t[2] for t in gathered]
+    ng_L = lvl_own.n_global
+    tail = sps.csr_matrix(
+        (
+            np.concatenate(vals) if vals else np.zeros(0),
+            (
+                np.concatenate(rows) if rows else np.zeros(0, int),
+                np.concatenate(cols) if cols else np.zeros(0, int),
+            ),
+        ),
+        shape=(ng_L, ng_L),
+    )
+    tail.sum_duplicates()
+    tail.sort_indices()
+
+    stats = dict(
+        comm_total_bytes=comm.stats.total_bytes,
+        comm_max_msg_bytes=comm.stats.max_msg_bytes,
+        comm_rounds=len(comm.stats.rounds),
+        max_part_nnz=int(max_part_nnz),
+        max_part_rows=int(max_part_rows),
+        n_parts=n_parts,
+    )
+    return DistHierarchy(
+        levels=levels,
+        tail_matrix=tail,
+        tail_owner=owner_L,
+        tail_local_of=local_L,
+        setup_stats=stats,
+    )
+
+
+def lvl_parts_to_parts(lvl_parts):
+    """Per-part csr state -> the localized dicts finalize expects."""
+    return {
+        p: dict(
+            indptr=d["A"].indptr,
+            cols=d["A"].indices.astype(np.int32),
+            vals=d["A"].data,
+            halo_glob=d["halo_glob"],
+        )
+        for p, d in lvl_parts.items()
+    }
+
+
 def build_distributed_hierarchy(
     Asp: sps.csr_matrix,
     n_parts: int,
@@ -261,191 +565,44 @@ def build_distributed_hierarchy(
     consolidate_rows: int = _CONSOLIDATE_ROWS,
     grade_lower: int = _GRADE_LOWER,
 ) -> DistHierarchy:
-    """The distributed setup loop (reference amg.cu:425-660)."""
+    """Single-process convenience wrapper: partition the global matrix
+    into local parts, then run the per-process setup loop
+    (:func:`build_distributed_hierarchy_local`) over a loopback fabric.
+    The reference analogue is upload_all_global followed by setup_v2;
+    per-rank uploads enter the local builder directly."""
     from amgx_tpu.amg.aggregation import infer_grid, stencil_offsets
 
     n = Asp.shape[0]
     Asp = Asp.tocsr()
     Asp.sort_indices()
+    proc_grid = None
     if owner is None:
         if grid is None:
             offs = stencil_offsets(Asp)
             grid = infer_grid(offs, n) if offs is not None else None
-        owner, _ = partition_rows(n, n_parts, grid)
+        owner, proc_grid = partition_rows(n, n_parts, grid)
     else:
         owner = np.asarray(owner, dtype=np.int32)
+    ownership = ArrayOwnership(owner, n_parts=n_parts)
 
-    lvl = _shard_the_matrix(Asp, owner, n_parts)
-    levels: List[DistLevel] = []
-
-    while (
-        lvl.n_global > consolidate_rows and len(levels) < max_levels
-    ):
-        rows_pp = max(int(lvl.counts.max()), 1)
-        # 1. shard-local aggregation on the owned submatrix
-        aggs, ncs = [], []
-        for p in range(lvl.n_parts):
-            A_pp = lvl.shards[p][:, : lvl.counts[p]]
-            # owned cols use local slots 0..counts-1 (padding-free view)
-            A_pp = A_pp.tocsr()
-            agg = _local_aggregate(A_pp, cfg, scope)
-            aggs.append(agg)
-            ncs.append(int(agg.max()) + 1 if agg.size else 0)
-        nc_global = int(np.sum(ncs))
-        if nc_global >= lvl.n_global or nc_global == 0:
-            break  # coarsening stalled
-
-        # graded consolidation (sub-mesh tier): leaders own their whole
-        # group's coarse block; members' restricted partials ride the
-        # bridge ppermutes (reference glue_vector/unglue_vector)
-        graded = _grade_groups(ncs, grade_lower)
-        if graded is not None:
-            lead_of, moff, perms_down, is_leader = graded
-            bridge = (perms_down, is_leader)
-        else:
-            lead_of = np.arange(lvl.n_parts, dtype=np.int32)
-            moff = np.zeros(lvl.n_parts, dtype=np.int64)
-            bridge = None
-
-        # coarse global numbering: leader L owns one contiguous block
-        # holding its members' aggregates back to back (no grading:
-        # leader = shard, the per-shard blocks of before)
-        nc_lead = np.zeros(lvl.n_parts, dtype=np.int64)
-        for p in range(lvl.n_parts):
-            nc_lead[lead_of[p]] += ncs[p]
-        goffs = np.concatenate([[0], np.cumsum(nc_lead)[:-1]])
-        # base coarse id of shard p's aggregates
-        cbase = goffs[lead_of] + moff
-        owner_c = np.empty(nc_global, dtype=np.int32)
-        for p in range(lvl.n_parts):
-            if ncs[p]:
-                owner_c[cbase[p]: cbase[p] + ncs[p]] = lead_of[p]
-
-        # per-shard P (owned fine x LEADER-local coarse slots)
-        P_blocks = [
-            sps.csr_matrix(
-                (
-                    np.ones(lvl.counts[p], dtype=lvl.shards[p].dtype),
-                    (np.arange(lvl.counts[p]), moff[p] + aggs[p]),
-                ),
-                shape=(lvl.counts[p], int(nc_lead[lead_of[p]])),
-            )
-            for p in range(lvl.n_parts)
-        ]
-
-        # 2+3. halo P-row exchange and shard-local Galerkin rows:
-        # P_ext maps every LOCAL column of A_p (owned + halo) to global
-        # coarse ids; halo rows come from the owning shard's aggregate
-        # map — the single-process arranger reads them directly (a real
-        # multi-host build ships them point-to-point).
-        # global fine id -> global coarse id (the union of all shards'
-        # aggregate maps; each entry is produced by exactly one owner)
-        gagg = np.empty(lvl.n_global, dtype=np.int64)
-        for p in range(lvl.n_parts):
-            gagg[lvl.g_rows[p]] = cbase[p] + aggs[p]
-
-        # per-LEADER RAP: members' partial products land on leader-local
-        # rows and are sparse-added (reference csr_RAP_sparse_add /
-        # exchange_RAP_ext — here the single-process arranger sums them
-        # directly)
-        rap = {}
-        for p in range(lvl.n_parts):
-            A_p = lvl.shards[p]
-            nloc = A_p.shape[1]
-            # local col -> global coarse id
-            col_to_gc = np.empty(nloc, dtype=np.int64)
-            col_to_gc[: lvl.counts[p]] = cbase[p] + aggs[p]
-            if rows_pp > lvl.counts[p]:
-                col_to_gc[lvl.counts[p]: rows_pp] = 0  # padding, no nnz
-            hg = lvl.halo_globs[p]
-            if len(hg):
-                col_to_gc[rows_pp: rows_pp + len(hg)] = gagg[hg]
-            # AP with global coarse columns
-            coo = A_p.tocoo()
-            AP = sps.csr_matrix(
-                (coo.data, (coo.row, col_to_gc[coo.col])),
-                shape=(lvl.counts[p], nc_global),
-            )
-            AP.sum_duplicates()
-            Ac_p = (P_blocks[p].T @ AP).tocsr()  # (nc_lead, nc_global)
-            L = int(lead_of[p])
-            rap[L] = Ac_p if L not in rap else rap[L] + Ac_p
-
-        # 4. owned-first renumber of the coarse level
-        local_of_c, counts_c, g_rows_c = local_numbering(
-            owner_c, lvl.n_parts
+    rows_pp = max(int(ownership.counts.max()), 1)
+    local_parts = {}
+    for p in range(n_parts):
+        local = Asp[ownership.global_rows(p)].tocsr()
+        local_parts[p] = localize_columns(
+            local.indptr, local.indices, local.data, owner,
+            ownership.local_arr, p, rows_pp,
         )
-        rows_pp_c = max(int(counts_c.max()), 1)
-        new_shards, new_halos = [], []
-        empty = sps.csr_matrix(
-            (0, nc_global), dtype=Asp.dtype
-        )
-        for p in range(lvl.n_parts):
-            m = rap.get(p, empty).tocsr()
-            m.sum_duplicates()
-            m.sort_indices()
-            d = localize_columns(
-                m.indptr, m.indices, m.data, owner_c, local_of_c, p,
-                rows_pp_c,
-            )
-            nloc = rows_pp_c + len(d["halo_glob"])
-            new_shards.append(
-                sps.csr_matrix(
-                    (d["vals"], d["cols"], d["indptr"]),
-                    shape=(counts_c[p], nloc),
-                )
-            )
-            new_halos.append(d["halo_glob"])
-
-        # device arrays for this level (A + P/R stacked blocks)
-        A_dev = _level_device_arrays(lvl)
-        P_cols, P_vals = _pad_ell_blocks(P_blocks, rows_pp)
-        R_blocks = [P_blocks[p].T.tocsr() for p in range(lvl.n_parts)]
-        R_cols, R_vals = _pad_ell_blocks(R_blocks, rows_pp_c)
-        levels.append(
-            DistLevel(
-                A=A_dev, P_cols=P_cols, P_vals=P_vals,
-                R_cols=R_cols, R_vals=R_vals, bridge=bridge,
-            )
-        )
-
-        lvl = _ShardedLevelCSR(
-            new_shards, new_halos, g_rows_c, owner_c, local_of_c,
-            counts_c,
-        )
-
-    # deepest distributed level (operator only; smoothed, no transfer)
-    levels.append(DistLevel(A=_level_device_arrays(lvl)))
-
-    # consolidated tail: gather the last level's rows into one host
-    # matrix in GLOBAL coarse numbering (reference glue_matrices)
-    rows, cols, vals = [], [], []
-    for p in range(lvl.n_parts):
-        m = lvl.shards[p].tocoo()
-        rows_pp_l = max(int(lvl.counts.max()), 1)
-        hg = lvl.halo_globs[p]
-        col_to_g = np.empty(m.shape[1], dtype=np.int64)
-        col_to_g[: lvl.counts[p]] = lvl.g_rows[p]
-        if rows_pp_l > lvl.counts[p]:
-            col_to_g[lvl.counts[p]: rows_pp_l] = 0
-        if len(hg):
-            col_to_g[rows_pp_l: rows_pp_l + len(hg)] = hg
-        rows.append(lvl.g_rows[p][m.row])
-        cols.append(col_to_g[m.col])
-        vals.append(m.data)
-    tail = sps.csr_matrix(
-        (
-            np.concatenate(vals),
-            (np.concatenate(rows), np.concatenate(cols)),
-        ),
-        shape=(lvl.n_global, lvl.n_global),
+    h = build_distributed_hierarchy_local(
+        local_parts, ownership, cfg, scope,
+        max_levels=max_levels,
+        consolidate_rows=consolidate_rows,
+        grade_lower=grade_lower,
+        proc_grid=proc_grid,
     )
-    tail.sum_duplicates()
-    tail.sort_indices()
-
-    return DistHierarchy(
-        levels=levels,
-        tail_matrix=tail,
-        tail_owner=lvl.owner,
-        tail_local_of=lvl.local_of,
-    )
+    # fine-level pad/unpad convenience for non-contiguous partitions
+    # (grid slabs / arbitrary partition vectors): the global-matrix
+    # entry point has the O(n_global) arrays anyway
+    h.levels[0].A.owner = owner
+    h.levels[0].A.local_of = ownership.local_arr
+    return h

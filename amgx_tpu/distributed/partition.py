@@ -93,12 +93,20 @@ class DistributedMatrix:
         return self.perms is not None
 
     def pad_vector(self, v):
-        """Global vector (n_global,) -> stacked padded [N, rows]."""
+        """Global vector (n_global,) -> stacked padded [N, rows].
+
+        ``owner is None`` means contiguous-by-offset ownership (the
+        per-process layout): part p owns global rows
+        [offs[p], offs[p+1]) with offs = cumsum(n_owned) — correct for
+        non-uniform blocks too, unlike a flat reshape."""
         v = np.asarray(v)
         out = np.zeros((self.n_parts, self.rows_per_part), dtype=v.dtype)
         if self.owner is None:
-            flat = out.reshape(-1)
-            flat[: self.n_global] = v
+            offs = np.concatenate(
+                [[0], np.cumsum(self.n_owned)]
+            ).astype(np.int64)
+            for p in range(self.n_parts):
+                out[p, : self.n_owned[p]] = v[offs[p]: offs[p + 1]]
         else:
             out[self.owner, self.local_of] = v
         return out
@@ -106,7 +114,9 @@ class DistributedMatrix:
     def unpad_vector(self, vp):
         vp = np.asarray(vp)
         if self.owner is None:
-            return vp.reshape(-1)[: self.n_global]
+            return np.concatenate(
+                [vp[p, : self.n_owned[p]] for p in range(self.n_parts)]
+            )
         return vp[self.owner, self.local_of]
 
 
@@ -281,6 +291,209 @@ def partition_matrix(
     )
 
 
+class Ownership:
+    """Analytic row-ownership with O(n_parts) state (the per-process
+    memory contract: no global-length arrays).  ``owner_of``/
+    ``local_of_ids`` map global-id arrays; ``global_rows(p)`` lists one
+    part's owned global ids (O(local)); ``materialize()`` builds the
+    O(n_global) arrays — only for boundary conveniences on SMALL levels
+    (the consolidated tail)."""
+
+    counts: np.ndarray
+
+    @property
+    def n_parts(self) -> int:
+        return int(self.counts.shape[0])
+
+    @property
+    def n_global(self) -> int:
+        return int(self.counts.sum())
+
+    def owner_of(self, ids):
+        raise NotImplementedError
+
+    def local_of_ids(self, ids):
+        raise NotImplementedError
+
+    def global_rows(self, p):
+        raise NotImplementedError
+
+    def materialize(self):
+        owner = np.empty(self.n_global, dtype=np.int32)
+        local_of = np.empty(self.n_global, dtype=np.int32)
+        for p in range(self.n_parts):
+            g = self.global_rows(p)
+            owner[g] = p
+            local_of[g] = np.arange(len(g), dtype=np.int32)
+        return owner, local_of
+
+    @property
+    def uniform_contiguous(self) -> bool:
+        return False
+
+    @property
+    def offset_blocks(self) -> bool:
+        """True when part p owns exactly global rows
+        [cumsum(counts)[p], cumsum(counts)[p+1]) — the layout the
+        owner=None pad/unpad convention assumes."""
+        return False
+
+
+class OffsetOwnership(Ownership):
+    """Contiguous row blocks given by part offsets (the reference's
+    partition-offsets upload path, sharded_partition's shape)."""
+
+    def __init__(self, part_offsets):
+        self.part_offsets = np.asarray(part_offsets, dtype=np.int64)
+        self.counts = (
+            self.part_offsets[1:] - self.part_offsets[:-1]
+        ).astype(np.int64)
+
+    def owner_of(self, ids):
+        return (
+            np.searchsorted(
+                self.part_offsets, np.asarray(ids), side="right"
+            )
+            - 1
+        ).astype(np.int32)
+
+    def local_of_ids(self, ids):
+        ids = np.asarray(ids, dtype=np.int64)
+        return (
+            ids - self.part_offsets[self.owner_of(ids)]
+        ).astype(np.int32)
+
+    def global_rows(self, p):
+        return np.arange(
+            self.part_offsets[p], self.part_offsets[p + 1],
+            dtype=np.int64,
+        )
+
+    @property
+    def uniform_contiguous(self) -> bool:
+        rows_pp = int(self.counts.max()) if len(self.counts) else 1
+        expect = np.minimum(
+            np.arange(len(self.part_offsets)) * rows_pp,
+            self.part_offsets[-1],
+        )
+        return bool(np.array_equal(self.part_offsets, expect))
+
+    @property
+    def offset_blocks(self) -> bool:
+        return True
+
+
+class GridOwnership(Ownership):
+    """px*py*pz slab partition of an nx*ny*nz lexicographic grid —
+    ownership is computed from coordinates (O(1) state), halo size is
+    O(surface).  Matches partition_rows(grid=...) numbering."""
+
+    def __init__(self, grid, proc_grid):
+        self.grid = tuple(int(v) for v in grid)
+        self.proc_grid = tuple(int(v) for v in proc_grid)
+        nx, ny, nz = self.grid
+        px, py, pz = self.proc_grid
+        # slab boundaries identical to partition_rows
+        self._xb = np.searchsorted(
+            np.minimum(np.arange(nx) * px // nx, px - 1),
+            np.arange(px + 1), side="left",
+        )
+        self._yb = np.searchsorted(
+            np.minimum(np.arange(ny) * py // ny, py - 1),
+            np.arange(py + 1), side="left",
+        )
+        self._zb = np.searchsorted(
+            np.minimum(np.arange(nz) * pz // nz, pz - 1),
+            np.arange(pz + 1), side="left",
+        )
+        cx = np.diff(self._xb)
+        cy = np.diff(self._yb)
+        cz = np.diff(self._zb)
+        self.counts = (
+            cx[None, None, :] * cy[None, :, None] * cz[:, None, None]
+        ).reshape(-1).astype(np.int64)
+
+    def _coords(self, ids):
+        nx, ny, _ = self.grid
+        ids = np.asarray(ids, dtype=np.int64)
+        return ids % nx, (ids // nx) % ny, ids // (nx * ny)
+
+    def owner_of(self, ids):
+        nx, ny, nz = self.grid
+        px, py, pz = self.proc_grid
+        ix, iy, iz = self._coords(ids)
+        bx = np.minimum(ix * px // nx, px - 1)
+        by = np.minimum(iy * py // ny, py - 1)
+        bz = np.minimum(iz * pz // nz, pz - 1)
+        return (bx + px * (by + py * bz)).astype(np.int32)
+
+    def local_of_ids(self, ids):
+        nx, ny, nz = self.grid
+        px, py, pz = self.proc_grid
+        ix, iy, iz = self._coords(ids)
+        bx = np.minimum(ix * px // nx, px - 1)
+        by = np.minimum(iy * py // ny, py - 1)
+        bz = np.minimum(iz * pz // nz, pz - 1)
+        ox, oy, oz = self._xb[bx], self._yb[by], self._zb[bz]
+        sx = self._xb[bx + 1] - ox
+        sy = self._yb[by + 1] - oy
+        # local slot = lexicographic index within the owned sub-box
+        # (matches local_numbering's global-order-preserving numbering)
+        return (
+            (ix - ox) + sx * ((iy - oy) + sy * (iz - oz))
+        ).astype(np.int32)
+
+    def global_rows(self, p):
+        nx, ny, _ = self.grid
+        px, py, _ = self.proc_grid
+        bx = p % px
+        by = (p // px) % py
+        bz = p // (px * py)
+        xs = np.arange(self._xb[bx], self._xb[bx + 1], dtype=np.int64)
+        ys = np.arange(self._yb[by], self._yb[by + 1], dtype=np.int64)
+        zs = np.arange(self._zb[bz], self._zb[bz + 1], dtype=np.int64)
+        return (
+            xs[None, None, :]
+            + nx * (ys[None, :, None] + self.grid[1] * zs[:, None, None])
+        ).reshape(-1)
+
+
+class ArrayOwnership(Ownership):
+    """Ownership from explicit owner/local_of arrays (the reference's
+    arbitrary partition-vector upload).  O(n_global) state — the
+    single-process compatibility shape, not the multi-host one."""
+
+    def __init__(self, owner, local_of=None, n_parts=None):
+        self.owner = np.asarray(owner, dtype=np.int32)
+        n_parts = (
+            int(self.owner.max()) + 1 if n_parts is None else n_parts
+        )
+        self.counts = np.bincount(
+            self.owner, minlength=n_parts
+        ).astype(np.int64)
+        if local_of is None:
+            local_of, _, self._part_rows = local_numbering(
+                self.owner, n_parts
+            )
+        else:
+            self._part_rows = None
+        self.local_arr = np.asarray(local_of, dtype=np.int32)
+
+    def owner_of(self, ids):
+        return self.owner[np.asarray(ids)]
+
+    def local_of_ids(self, ids):
+        return self.local_arr[np.asarray(ids)]
+
+    def global_rows(self, p):
+        if self._part_rows is not None:
+            return self._part_rows[p]
+        return np.nonzero(self.owner == p)[0]
+
+    def materialize(self):
+        return self.owner, self.local_arr
+
+
 def local_numbering(owner, n_parts):
     """(local_of, counts, part_rows): slot of each global row within its
     part (global order preserved within a part), owned-row counts, and
@@ -418,17 +631,25 @@ def build_exchange_plan(halo_globs, owner_fn, local_fn, n_parts):
 
 def finalize_partition(
     parts, owner, local_of, counts, n, n_parts, proc_grid=None,
-    split=True,
+    split=True, owner_fn=None, local_fn=None,
 ):
     """Build the exchange plan + stacked device arrays from per-shard
-    localized CSRs (the output of localize_columns)."""
+    localized CSRs (the output of localize_columns).
+
+    ``owner``/``local_of`` may be None when ``owner_fn``/``local_fn``
+    provide analytic ownership (the per-process path: no global-length
+    arrays; pad/unpad then require uniform contiguous blocks)."""
     rows_pp = max(int(counts.max()), 1)
     Adtype = parts[0]["vals"].dtype if parts else np.float64
 
+    if owner_fn is None:
+        owner_fn = lambda ids: owner[ids]
+    if local_fn is None:
+        local_fn = lambda ids: local_of[ids]
     dm, fb = build_exchange_plan(
         [p["halo_glob"] for p in parts],
-        lambda ids: owner[ids],
-        lambda ids: local_of[ids],
+        owner_fn,
+        local_fn,
         n_parts,
     )
     max_send, max_halo = fb["max_send"], fb["max_halo"]
