@@ -49,10 +49,12 @@ def strength_ahat(Asp: sps.csr_matrix, theta: float, max_row_sum: float):
         strong &= ~weak_rows[row_ids]
 
     # copies: csr_matrix((data, indices, indptr)) shares the arrays, and
-    # eliminate_zeros() mutates them in place — must not corrupt Asp
+    # eliminate_zeros() mutates them in place — must not corrupt Asp.
+    # shape is preserved (not forced square): the distributed builder
+    # feeds rectangular owned-rows x (owned+halo) local blocks.
     S = sps.csr_matrix(
         (strong.astype(np.int8), indices.copy(), indptr.copy()),
-        shape=(n, n),
+        shape=Asp.shape,
     )
     S.eliminate_zeros()
     return S

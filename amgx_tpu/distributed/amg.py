@@ -36,6 +36,7 @@ from amgx_tpu.distributed.solve import (
     _pdot,
     _shard_params,
     exchange_halo,
+    exchange_halo_reverse,
     make_local_spmv,
 )
 from amgx_tpu.core.profiling import named_scope, trace_range
@@ -318,17 +319,43 @@ class DistributedAMG:
         self.cycle_iters = int(self.cfg.get("cycle_iters", self.scope))
         self._solve_cache = {}
 
+        algorithm = str(
+            self.cfg.get("algorithm", self.scope)
+        ).upper()
         if self._local is not None:
-            from amgx_tpu.distributed.hierarchy import (
-                build_distributed_hierarchy_local,
+            local_parts, ownership, comm = self._local
+            if algorithm == "CLASSICAL":
+                from amgx_tpu.distributed.classical import (
+                    build_distributed_classical_hierarchy_local,
+                )
+
+                self.h: DistHierarchy = (
+                    build_distributed_classical_hierarchy_local(
+                        local_parts, ownership, self.cfg, self.scope,
+                        comm=comm,
+                        consolidate_rows=self.consolidate_rows,
+                    )
+                )
+            else:
+                from amgx_tpu.distributed.hierarchy import (
+                    build_distributed_hierarchy_local,
+                )
+
+                self.h = build_distributed_hierarchy_local(
+                    local_parts, ownership, self.cfg, self.scope,
+                    comm=comm,
+                    consolidate_rows=self.consolidate_rows,
+                    grade_lower=self.grade_lower,
+                )
+        elif algorithm == "CLASSICAL":
+            from amgx_tpu.distributed.classical import (
+                build_distributed_classical_hierarchy,
             )
 
-            local_parts, ownership, comm = self._local
-            self.h: DistHierarchy = build_distributed_hierarchy_local(
-                local_parts, ownership, self.cfg, self.scope,
-                comm=comm,
+            self.h = build_distributed_classical_hierarchy(
+                Asp, self.n_parts, self.cfg, self.scope,
+                grid=self._grid, owner=self._owner,
                 consolidate_rows=self.consolidate_rows,
-                grade_lower=self.grade_lower,
             )
         else:
             self.h = build_distributed_hierarchy(
@@ -442,7 +469,12 @@ class DistributedAMG:
             )
             out.append(tuple(entry))
         if len(self.h.levels) > 1:
-            out.append(())
+            # deepest level: ship ONLY its exchange maps — classical
+            # restriction/prolongation at the level above need the
+            # coarse plan for the reverse/forward halo exchanges; the
+            # operator itself lives in the replicated tail
+            sp = _shard_params(self.h.levels[-1].A)
+            out.append(({"ex": sp["ex"]},))
         return tuple(out)
 
     def _make_cycle(self):
@@ -594,7 +626,23 @@ class DistributedAMG:
             with named_scope(f"damg_l{l}_restrict"):
                 rr = r_l - spmvs[l](sh, z)
                 Pc, Pv, Rc, Rv = lp[1], lp[2], lp[3], lp[4]
-                rc = jnp.sum(Rv * rr[Rc], axis=1)
+                if levels[l].classical:
+                    # R = P^T with shard-coupling P: scatter-add the
+                    # partials into extended coarse slots (owned +
+                    # coarse halo), then fold halo partials back to
+                    # their owners (reference add_from_halo)
+                    A_next = levels[l + 1].A
+                    sh_next = lps[l + 1][0]
+                    rows_c = A_next.rows_per_part
+                    y = jnp.zeros(
+                        (rows_c + A_next.max_halo,), rr.dtype
+                    )
+                    y = y.at[Pc].add(Pv * rr[:, None])
+                    rc = exchange_halo_reverse(
+                        A_next, sh_next, y[:rows_c], y[rows_c:], axis
+                    )
+                else:
+                    rc = jnp.sum(Rv * rr[Rc], axis=1)
             # graded-consolidation bridge (reference glue_vector):
             # members' restricted partials ppermute onto their group
             # leader; non-leaders continue with a zero coarse system
@@ -644,7 +692,16 @@ class DistributedAMG:
                         inv = [(dst, src) for (src, dst) in perm]
                         ec = ec + jax.lax.ppermute(ec, axis, perm=inv)
             with named_scope(f"damg_l{l}_prolong"):
-                z = z + jnp.sum(Pv * ec[Pc], axis=1)
+                if levels[l].classical:
+                    # P gathers from owned coarse + coarse halo: one
+                    # forward halo exchange of the correction
+                    A_next = levels[l + 1].A
+                    sh_next = lps[l + 1][0]
+                    halo_e = exchange_halo(A_next, sh_next, ec, axis)
+                    e_ext = jnp.concatenate([ec, halo_e])
+                    z = z + jnp.sum(Pv * e_ext[Pc], axis=1)
+                else:
+                    z = z + jnp.sum(Pv * ec[Pc], axis=1)
             z = smooth(l, lp, r_l, z, post, "postsmooth")
             return z
 

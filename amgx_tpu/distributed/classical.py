@@ -1,0 +1,750 @@
+"""Distributed classical (Ruge-Stuben) AMG setup.
+
+Reference parity: the distributed classical path —
+classical_amg_level.cu:297-318 (computeAOperator_distributed + RAP halo
+renumber), distributed_arranger.h:58-210 (exchange_halo_rows_P,
+exchange_RAP_ext, create_rings), selectors/pmis.cu (distributed PMIS
+with boundary exchanges), interpolators/distance1.cu.
+
+Per-process shape: each part holds its owned rows + one-ring halo ids
+only; every cross-part byte rides the :mod:`amgx_tpu.distributed.comm`
+fabric.  The reference's TWO-RING halo (B2L_rings=2,
+distributed_manager.h:260-310) exists to give each rank the row
+structure of its one-ring nodes; here the same information content
+moves as three targeted exchanges instead of a second structural ring:
+
+  * reverse strong edges: part q tells owner(i) about its strong
+    entries S[j, i] into halo column i (one O(boundary) round) — this
+    is what the transpose-degree PMIS weights and the symmetrized
+    PMIS neighborhood need from ring 2;
+  * per-round ghost state fetches: PMIS runs SYNCHRONOUSLY — each
+    round fetches the (weight, state) of ghost nodes, updates owned
+    states with the serial update rule, re-fetches, and marks F
+    points; with deterministic hash weights on global ids the
+    selection is IDENTICAL to the serial pmis_select;
+  * halo P-rows: owners ship the interpolation rows of requested
+    one-ring fine nodes with global coarse columns (reference
+    exchange_halo_rows_P) for the Galerkin product.
+
+Interpolation is distance-1 (D1) — row-local given ghost C/F flags and
+coarse ids.  The partial RAP rows for remote coarse points ship to
+their owners and are sparse-added in part order (exchange_RAP_ext +
+csr_RAP_sparse_add).  Unlike the aggregation path, P couples shards,
+so the solve-side transfers communicate: prolongation does a coarse
+halo exchange, restriction a reverse (accumulating) exchange — see
+distributed/solve.py exchange_halo_reverse.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import scipy.sparse as sps
+
+from amgx_tpu.amg.classical import (
+    strength_ahat,
+    truncate_interp,
+)
+from amgx_tpu.distributed.comm import LoopbackComm, fetch_by_owner
+from amgx_tpu.distributed.hierarchy import (
+    DistHierarchy,
+    DistLevel,
+    _finalize_level,
+    _pad_ell_blocks,
+    finish_distributed_hierarchy,
+    init_lvl_parts,
+    lvl_parts_to_parts,
+)
+from amgx_tpu.distributed.partition import (
+    OffsetOwnership,
+    Ownership,
+    halo_localize,
+)
+
+_PMIS_MAX_ROUNDS = 200  # serial pmis_select cap
+
+
+def _hash_at(ids, seed: int = 0) -> np.ndarray:
+    """The serial _hash_weights formula evaluated at specific global
+    ids (O(len(ids)), not O(n_global)) — bit-identical to
+    amg.classical._hash_weights(n, seed)[ids], which is what makes the
+    distributed PMIS selection identical to the serial one."""
+    idx = np.asarray(ids, dtype=np.uint64)
+    z = (idx + np.uint64(seed)) * np.uint64(0xBF58476D1CE4E5B9)
+    z ^= z >> np.uint64(31)
+    z *= np.uint64(0x94D049BB133111EB)
+    z ^= z >> np.uint64(29)
+    return (z % np.uint64(1 << 30)).astype(np.float64) / float(1 << 30)
+
+
+def _part_strength(A_local: sps.csr_matrix, counts_p: int, theta,
+                   max_row_sum) -> sps.csr_matrix:
+    """Strength mask of one part's owned rows (row-local computation:
+    AHAT thresholds depend only on the row itself, so the per-part
+    result equals the corresponding rows of the global mask)."""
+    return strength_ahat(A_local, theta, max_row_sum)
+
+
+def _pmis_distributed(
+    lvl_parts, lvl_own: Ownership, comm, my_parts, S_parts,
+    rows_pp: int, seed: int = 0,
+):
+    """Synchronous distributed PMIS — identical selection to the serial
+    pmis_select (same weights, same update schedule).
+
+    Returns ``cf[p]`` (int8 per owned row, 1=C) per part.
+    """
+    counts = lvl_own.counts
+
+    # ---- reverse strong edges: tell owners about S[j, ghost] -------
+    # outbox[(q, o)] = (targets_global, sources_global) for q's strong
+    # entries into halo columns owned by o
+    outbox = {}
+    for p in my_parts:
+        S = S_parts[p]
+        hg = lvl_parts[p]["halo_glob"]
+        if not len(hg):
+            continue
+        coo = S.tocoo()
+        hal = coo.col >= rows_pp
+        if not hal.any():
+            continue
+        tgt_glob = hg[coo.col[hal] - rows_pp]
+        src_glob = lvl_own.global_rows(p)[coo.row[hal]]
+        owners = lvl_own.owner_of(tgt_glob)
+        for o in np.unique(owners):
+            m = owners == o
+            outbox[(p, int(o))] = (tgt_glob[m], src_glob[m])
+    inbox = comm.alltoall(outbox, kind="rev-edges")
+    # rev_edges[p]: (tgt_local, src_global) arrays
+    rev_edges: Dict[int, tuple] = {}
+    for (src_p, o), (tgt, src) in sorted(inbox.items()):
+        tl = lvl_own.local_of_ids(tgt)
+        if o in rev_edges:
+            a, b = rev_edges[o]
+            rev_edges[o] = (
+                np.concatenate([a, tl]), np.concatenate([b, src])
+            )
+        else:
+            rev_edges[o] = (tl, src)
+
+    # ---- transpose-degree weights ----------------------------------
+    # lam[i] = |S^T_i| = local strong rows into i + reverse edges
+    lam = {}
+    for p in my_parts:
+        S = S_parts[p]
+        nl = int(counts[p])
+        loc = np.zeros(nl, dtype=np.int64)
+        coo = S.tocoo()
+        own_c = coo.col < nl
+        np.add.at(loc, coo.col[own_c], 1)
+        if p in rev_edges:
+            np.add.at(loc, rev_edges[p][0], 1)
+        lam[p] = loc
+    # identical weights to serial pmis_select: lam + hash(global id)
+    w = {
+        p: lam[p] + _hash_at(lvl_own.global_rows(p), seed=seed)
+        for p in my_parts
+    }
+
+    # ---- ghost lists: halo ids + reverse-edge sources --------------
+    ghosts = {}
+    for p in my_parts:
+        ids = [np.asarray(lvl_parts[p]["halo_glob"], dtype=np.int64)]
+        if p in rev_edges:
+            ids.append(rev_edges[p][1])
+        g = np.unique(np.concatenate(ids)) if ids else np.zeros(0, int)
+        ghosts[p] = g
+
+    # fetch ghost weights once (static)
+    reqs = {}
+    for p in my_parts:
+        g = ghosts[p]
+        if not len(g):
+            continue
+        owners = lvl_own.owner_of(g)
+        reqs[p] = {int(o): g[owners == o] for o in np.unique(owners)}
+    w_ans = fetch_by_owner(
+        comm, reqs,
+        lambda o, ids: w[o][lvl_own.local_of_ids(ids)],
+        kind="pmis-w",
+    )
+    gw = {}
+    for p in my_parts:
+        g = ghosts[p]
+        vals = np.zeros(len(g))
+        owners = lvl_own.owner_of(g) if len(g) else np.zeros(0, int)
+        for o, v in w_ans.get(p, {}).items():
+            vals[owners == o] = v
+        gw[p] = vals
+
+    # ---- per-part neighbor tables (owned-row index, neighbor) ------
+    # neighbor encoded as: >=0 owned local id; <0 -> ghost slot -1-g
+    nbr = {}
+    for p in my_parts:
+        S = S_parts[p]
+        nl = int(counts[p])
+        hg = np.asarray(lvl_parts[p]["halo_glob"], dtype=np.int64)
+        coo = S.tocoo()
+        codes = coo.col.astype(np.int64).copy()
+        hal = coo.col >= rows_pp
+        if hal.any():
+            gl = hg[coo.col[hal] - rows_pp]
+            codes[hal] = -1 - np.searchsorted(ghosts[p], gl)
+        rows = [coo.row.astype(np.int64)]
+        cols = [codes]
+        # intra-part transpose edges: serial PMIS runs on the
+        # SYMMETRIZED graph (S + S^T), so an asymmetric strong entry
+        # S[i, j] must also give owned j its edge back to i
+        own_c = coo.col < nl
+        if own_c.any():
+            rows.append(coo.col[own_c].astype(np.int64))
+            cols.append(coo.row[own_c].astype(np.int64))
+        # reverse edges: sources are always remote rows -> ghost slots
+        if p in rev_edges:
+            tl, srcg = rev_edges[p]
+            rows.append(tl.astype(np.int64))
+            cols.append(-1 - np.searchsorted(ghosts[p], srcg))
+        nbr[p] = (np.concatenate(rows), np.concatenate(cols))
+
+    # ---- synchronous rounds ----------------------------------------
+    state = {p: np.zeros(int(counts[p]), dtype=np.int8)
+             for p in my_parts}
+    # isolated (no strong neighbors either direction) -> C
+    for p in my_parts:
+        deg = np.zeros(int(counts[p]), dtype=np.int64)
+        np.add.at(deg, nbr[p][0], 1)
+        state[p][deg == 0] = 1
+
+    def ghost_states(round_tag):
+        ans = fetch_by_owner(
+            comm, reqs,
+            lambda o, ids: state[o][lvl_own.local_of_ids(ids)],
+            kind=f"pmis-st{round_tag}",
+        )
+        gs = {}
+        for p in my_parts:
+            g = ghosts[p]
+            vals = np.zeros(len(g), dtype=np.int8)
+            owners = (
+                lvl_own.owner_of(g) if len(g) else np.zeros(0, int)
+            )
+            for o, v in ans.get(p, {}).items():
+                vals[owners == o] = v
+            gs[p] = vals
+        return gs
+
+    for rnd in range(_PMIS_MAX_ROUNDS):
+        # symmetric termination check — every process enters the round
+        total_und = int(np.sum(comm.allgather(
+            {p: int((state[p] == 0).sum()) for p in my_parts},
+            kind="pmis-und",
+        )))
+        if total_und == 0:
+            break
+        gs = ghost_states(2 * rnd)
+        for p in my_parts:
+            rowi, code = nbr[p]
+            st = state[p]
+            und = st == 0
+            wu_own = np.where(und, w[p], -1.0)
+            wu_g = np.where(gs[p] == 0, gw[p], -1.0)
+            isg = code < 0
+            nb_w = np.empty(len(code))
+            nb_w[isg] = wu_g[-1 - code[isg]]
+            nb_w[~isg] = wu_own[code[~isg]]
+            nbmax = np.full(int(counts[p]), -1.0)
+            np.maximum.at(nbmax, rowi, nb_w)
+            new_c = und & (wu_own > nbmax)
+            st[new_c] = 1
+        gs = ghost_states(2 * rnd + 1)
+        for p in my_parts:
+            rowi, code = nbr[p]
+            st = state[p]
+            isg = code < 0
+            nb_c = np.empty(len(code), dtype=bool)
+            nb_c[isg] = gs[p][-1 - code[isg]] == 1
+            nb_c[~isg] = st[code[~isg]] == 1
+            has_c = np.zeros(int(counts[p]), dtype=bool)
+            np.logical_or.at(has_c, rowi, nb_c)
+            st[(st == 0) & has_c] = -1
+    for p in my_parts:
+        state[p][state[p] == 0] = 1  # leftovers become C
+    return {p: (state[p] == 1).astype(np.int8) for p in my_parts}
+
+
+def _direct_interpolation_local(
+    A_local: sps.csr_matrix, S_local: sps.csr_matrix, counts_p: int,
+    cf_row: np.ndarray, cf_col: np.ndarray, gc_col: np.ndarray,
+) -> sps.csr_matrix:
+    """D1 interpolation of one part's owned rows (reference
+    interpolators/distance1.cu; the serial direct_interpolation with
+    split row/column index spaces).
+
+    ``cf_col``/``gc_col`` give C/F flag and GLOBAL coarse id per LOCAL
+    column (owned slots + halo slots).  Returns csr (counts_p x
+    nc_global-shaped columns as global coarse ids via gc_col).
+    """
+    indptr, indices, data = (
+        A_local.indptr, A_local.indices, A_local.data,
+    )
+    nr = counts_p
+    row_ids = np.repeat(np.arange(nr), np.diff(indptr))
+    offd = indices != row_ids
+
+    # strong flag per A entry: S shares A's row structure only where
+    # entries survived; look up by (row, col) keys
+    Scoo = S_local.tocoo()
+    ncol = A_local.shape[1]
+    s_keys = Scoo.row.astype(np.int64) * ncol + Scoo.col
+    a_keys = row_ids.astype(np.int64) * ncol + indices
+    strong_flag = np.isin(a_keys, s_keys)
+
+    is_C_col = cf_col[indices] == 1
+    neg = data < 0
+    pos = offd & (data > 0)
+
+    sum_neg = np.zeros(nr)
+    np.add.at(sum_neg, row_ids, np.where(offd & neg, data, 0.0))
+    sum_pos = np.zeros(nr)
+    np.add.at(sum_pos, row_ids, np.where(pos, data, 0.0))
+    strongC = strong_flag & is_C_col
+    sum_negC = np.zeros(nr)
+    np.add.at(sum_negC, row_ids, np.where(strongC & neg, data, 0.0))
+    sum_posC = np.zeros(nr)
+    np.add.at(sum_posC, row_ids, np.where(strongC & pos, data, 0.0))
+
+    diag = A_local.diagonal().astype(np.float64).copy()
+    no_posC = sum_posC == 0
+    diag = diag + np.where(no_posC, sum_pos, 0.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        alpha = np.where(sum_negC != 0, sum_neg / sum_negC, 0.0)
+        beta = np.where(sum_posC != 0, sum_pos / sum_posC, 0.0)
+    diag = np.where(diag != 0, diag, 1.0)
+
+    keep = strongC & (cf_row[row_ids] == 0)
+    coef = np.where(data < 0, alpha[row_ids], beta[row_ids])
+    pvals = -coef * data / diag[row_ids]
+    rows_f = row_ids[keep]
+    cols_f = gc_col[indices[keep]]
+    vals_f = pvals[keep]
+
+    rows_c = np.nonzero(cf_row == 1)[0]
+    cols_c = gc_col[rows_c]
+    vals_c = np.ones(rows_c.shape[0])
+
+    rows = np.concatenate([rows_f, rows_c])
+    gcols = np.concatenate([cols_f, cols_c]).astype(np.int64)
+    vals = np.concatenate([vals_f, vals_c])
+    # compact to the part's coarse-column set; caller re-expands
+    ucols, inv = np.unique(gcols, return_inverse=True)
+    P = sps.csr_matrix(
+        (vals, (rows, inv)), shape=(nr, max(len(ucols), 1))
+    )
+    P.sum_duplicates()
+    P.sort_indices()
+    return P, ucols
+
+
+def build_distributed_classical_hierarchy_local(
+    local_parts: Dict[int, dict],
+    ownership: Ownership,
+    cfg,
+    scope: str,
+    comm: Optional[LoopbackComm] = None,
+    max_levels: int = 20,
+    consolidate_rows: int = 4096,
+    proc_grid=None,
+) -> DistHierarchy:
+    """Distributed classical-AMG setup loop from per-process blocks
+    (reference setup_v2 + classical_amg_level.cu distributed flow)."""
+    if comm is None:
+        from amgx_tpu.distributed.comm import default_comm
+
+        comm = default_comm(ownership.n_parts)
+    n_parts = ownership.n_parts
+    my_parts = [p for p in comm.my_parts if p in local_parts]
+    if sorted(local_parts) != sorted(my_parts):
+        raise ValueError(
+            f"local_parts {sorted(local_parts)} != comm.my_parts "
+            f"{sorted(comm.my_parts)}"
+        )
+
+    theta = float(cfg.get("strength_threshold", scope))
+    max_row_sum = float(cfg.get("max_row_sum", scope))
+    trunc = float(cfg.get("interp_truncation_factor", scope))
+    max_el = int(cfg.get("interp_max_elements", scope))
+    interp = str(cfg.get("interpolator", scope)).upper()
+    if interp not in ("D1",):
+        import warnings
+
+        warnings.warn(
+            f"distributed classical interpolator {interp}: using D1 "
+            "(distance-1 is the distributed roster)"
+        )
+
+    lvl_parts = init_lvl_parts(local_parts, ownership, my_parts)
+    lvl_own: Ownership = ownership
+    levels: List[DistLevel] = []
+    max_part_nnz = 0
+    max_part_rows = 0
+
+    while (
+        lvl_own.n_global > consolidate_rows and len(levels) < max_levels
+    ):
+        counts = lvl_own.counts
+        rows_pp = max(int(counts.max()), 1)
+
+        # ---- strength + PMIS (synchronous, serial-identical) -------
+        S_parts = {
+            p: _part_strength(
+                lvl_parts[p]["A"], int(counts[p]), theta, max_row_sum
+            )
+            for p in my_parts
+        }
+        for p in my_parts:
+            max_part_nnz = max(max_part_nnz, lvl_parts[p]["A"].nnz)
+            max_part_rows = max(max_part_rows, int(counts[p]))
+        cf = _pmis_distributed(
+            lvl_parts, lvl_own, comm, my_parts, S_parts, rows_pp
+        )
+
+        # ---- coarse numbering: owners number their C points --------
+        ncs = np.asarray(
+            comm.allgather(
+                {p: int(cf[p].sum()) for p in my_parts},
+                kind="coarse-counts",
+            ),
+            dtype=np.int64,
+        )
+        nc_global = int(ncs.sum())
+        if nc_global >= lvl_own.n_global or nc_global == 0:
+            break
+        coffsets = np.concatenate([[0], np.cumsum(ncs)])
+        own_c = OffsetOwnership(coffsets)
+
+        # global coarse id per owned row (C points only; -1 for F)
+        gcid = {}
+        for p in my_parts:
+            g = np.full(int(counts[p]), -1, dtype=np.int64)
+            cm = np.cumsum(cf[p]) - 1
+            sel = cf[p] == 1
+            g[sel] = coffsets[p] + cm[sel]
+            gcid[p] = g
+
+        # ---- ghost C/F + coarse ids for halo columns ---------------
+        reqs = {}
+        for p in my_parts:
+            hg = lvl_parts[p]["halo_glob"]
+            if not len(hg):
+                continue
+            owners = lvl_own.owner_of(hg)
+            reqs[p] = {
+                int(o): hg[owners == o] for o in np.unique(owners)
+            }
+        ans = fetch_by_owner(
+            comm, reqs,
+            lambda o, ids: np.stack([
+                cf[o][lvl_own.local_of_ids(ids)].astype(np.int64),
+                gcid[o][lvl_own.local_of_ids(ids)],
+            ]),
+            kind="halo-cf",
+        )
+
+        # ---- D1 interpolation of owned rows ------------------------
+        P_parts = {}  # p -> (P csr compact, global coarse col ids)
+        for p in my_parts:
+            nloc = lvl_parts[p]["A"].shape[1]
+            cf_col = np.zeros(nloc, dtype=np.int8)
+            gc_col = np.full(nloc, -1, dtype=np.int64)
+            cf_col[: int(counts[p])] = cf[p]
+            gc_col[: int(counts[p])] = gcid[p]
+            hg = lvl_parts[p]["halo_glob"]
+            if len(hg):
+                owners = lvl_own.owner_of(hg)
+                cfh = np.zeros(len(hg), dtype=np.int8)
+                gch = np.full(len(hg), -1, dtype=np.int64)
+                for o, v in ans.get(p, {}).items():
+                    m = owners == o
+                    cfh[m] = v[0].astype(np.int8)
+                    gch[m] = v[1]
+                cf_col[rows_pp: rows_pp + len(hg)] = cfh
+                gc_col[rows_pp: rows_pp + len(hg)] = gch
+            P, ucols = _direct_interpolation_local(
+                lvl_parts[p]["A"], S_parts[p], int(counts[p]),
+                cf[p], cf_col, gc_col,
+            )
+            if trunc < 1.0 or max_el >= 0:
+                P = truncate_interp(P, trunc, max_el)
+            P_parts[p] = (P.tocsr(), ucols)
+
+        # ---- halo P-rows (reference exchange_halo_rows_P) ----------
+        def p_rows_payload(o, ids):
+            P, ucols = P_parts[o]
+            li = lvl_own.local_of_ids(ids)
+            sub = P[li]
+            return (
+                sub.indptr.astype(np.int64),
+                ucols[sub.indices],
+                sub.data,
+            )
+
+        p_ans = fetch_by_owner(
+            comm, reqs, p_rows_payload, kind="halo-P",
+        )
+
+        # ---- part-local Galerkin: Pext^T (A_p Pext) ----------------
+        # extended coarse column space: owned coarse + ghost coarse
+        rap_partial = {}  # p -> csr (nc_own x nc_global cols global)
+        for p in my_parts:
+            A_p = lvl_parts[p]["A"]
+            nloc = A_p.shape[1]
+            P_own, ucols_own = P_parts[p]
+            hg = lvl_parts[p]["halo_glob"]
+            # halo P rows in (lens, gcols, vals) per owner, re-ordered
+            # to the halo list
+            hp_indptr = np.zeros(len(hg) + 1, dtype=np.int64)
+            hp_cols: list = []
+            hp_vals: list = []
+            if len(hg):
+                owners = lvl_own.owner_of(hg)
+                per_halo_rows = [None] * len(hg)
+                for o, (iptr, gcols, vals) in p_ans.get(p, {}).items():
+                    idx = np.nonzero(owners == o)[0]
+                    for k, h in enumerate(idx):
+                        per_halo_rows[h] = (
+                            gcols[iptr[k]: iptr[k + 1]],
+                            vals[iptr[k]: iptr[k + 1]],
+                        )
+                for h in range(len(hg)):
+                    row = per_halo_rows[h]
+                    ln = 0 if row is None else len(row[0])
+                    hp_indptr[h + 1] = hp_indptr[h] + ln
+                    if ln:
+                        hp_cols.append(row[0])
+                        hp_vals.append(row[1])
+            hp_gcols = (
+                np.concatenate(hp_cols) if hp_cols
+                else np.zeros(0, dtype=np.int64)
+            )
+            hp_v = (
+                np.concatenate(hp_vals) if hp_vals else np.zeros(0)
+            )
+            # extended coarse columns for this part
+            cx = np.unique(np.concatenate([ucols_own, hp_gcols]))
+            # P_ext over local fine slots (owned rows 0..counts,
+            # halo rows at rows_pp..)
+            Pcoo = P_own.tocoo()
+            rows_ext = [Pcoo.row]
+            cols_ext = [
+                np.searchsorted(cx, ucols_own[Pcoo.col])
+            ]
+            vals_ext = [Pcoo.data]
+            if len(hg):
+                lens = np.diff(hp_indptr)
+                rows_ext.append(
+                    rows_pp + np.repeat(np.arange(len(hg)), lens)
+                )
+                cols_ext.append(np.searchsorted(cx, hp_gcols))
+                vals_ext.append(hp_v)
+            P_ext = sps.csr_matrix(
+                (
+                    np.concatenate(vals_ext),
+                    (
+                        np.concatenate(rows_ext),
+                        np.concatenate(cols_ext),
+                    ),
+                ),
+                shape=(nloc, max(len(cx), 1)),
+            )
+            AP = (A_p @ P_ext).tocsr()  # counts_p x ncx
+            # P_owned^T in the same extended space
+            P_ownx = sps.csr_matrix(
+                (
+                    Pcoo.data,
+                    (Pcoo.row, np.searchsorted(cx, ucols_own[Pcoo.col])),
+                ),
+                shape=(int(counts[p]), max(len(cx), 1)),
+            )
+            part = (P_ownx.T @ AP).tocoo()  # ncx x ncx
+            # back to global coarse ids
+            rap_partial[p] = (
+                cx[part.row], cx[part.col], part.data,
+            )
+
+        # ---- route partial rows to coarse owners -------------------
+        outbox = {}
+        local_keep = {}
+        for p in my_parts:
+            gr, gc, gv = rap_partial[p]
+            owners = own_c.owner_of(gr)
+            for o in np.unique(owners):
+                m = owners == o
+                if int(o) == p:
+                    local_keep[p] = (gr[m], gc[m], gv[m])
+                else:
+                    outbox[(p, int(o))] = (gr[m], gc[m], gv[m])
+        inbox = comm.alltoall(outbox, kind="rap-ext")
+        rap_rows = {}
+        for L in my_parts:
+            trips = []
+            if L in local_keep:
+                trips.append((L, local_keep[L]))
+            for (src, dst), t in inbox.items():
+                if dst == L:
+                    trips.append((src, t))
+            acc = None
+            nc_own = int(own_c.counts[L])
+            for src, (gr, gc, gv) in sorted(trips):
+                m = sps.csr_matrix(
+                    (gv, (gr - coffsets[L], gc)),
+                    shape=(nc_own, nc_global),
+                )
+                acc = m if acc is None else acc + m
+            if acc is None:
+                acc = sps.csr_matrix((nc_own, nc_global))
+            acc.sum_duplicates()
+            acc.sort_indices()
+            rap_rows[L] = acc
+
+        # ---- localize the coarse level -----------------------------
+        rows_pp_c = max(int(own_c.counts.max()), 1)
+        new_parts = {}
+        p_halo_cache = {}
+        for p in my_parts:
+            m = rap_rows[p].tocsr()
+            gcols = m.indices.astype(np.int64)
+            # union halo: RAP columns + P ghost coarse ids (P columns
+            # must resolve in the coarse level's halo numbering)
+            _, ucols_own = P_parts[p]
+            pg = ucols_own[
+                (ucols_own < coffsets[p])
+                | (ucols_own >= coffsets[p + 1])
+            ]
+            is_owned = own_c.owner_of(gcols) == p
+            cols, halo_glob = halo_localize(
+                gcols, is_owned,
+                own_c.local_of_ids(gcols[is_owned]), rows_pp_c,
+            )
+            if len(pg):
+                extra = np.setdiff1d(pg, halo_glob)
+                if len(extra):
+                    merged = np.union1d(halo_glob, extra)
+                    # re-map halo slots into the merged list
+                    remap = rows_pp_c + np.searchsorted(
+                        merged, halo_glob
+                    )
+                    hal = cols >= rows_pp_c
+                    cols = cols.copy()
+                    cols[hal] = remap[cols[hal] - rows_pp_c].astype(
+                        np.int32
+                    )
+                    halo_glob = merged
+            nloc = rows_pp_c + len(halo_glob)
+            new_parts[p] = dict(
+                A=sps.csr_matrix(
+                    (m.data, cols, m.indptr),
+                    shape=(int(own_c.counts[p]), nloc),
+                ),
+                halo_glob=halo_glob,
+            )
+            p_halo_cache[p] = halo_glob
+
+        # ---- device arrays: A + P in extended coarse numbering -----
+        A_dev = _finalize_level(
+            lvl_parts_to_parts(lvl_parts), lvl_own, comm,
+            proc_grid=proc_grid if len(levels) == 0 else None,
+        )
+        P_local = []
+        for p in sorted(my_parts):
+            P_own, ucols_own = P_parts[p]
+            halo_c = p_halo_cache[p]
+            # global coarse -> coarse-LOCAL extended slot
+            owned_m = (
+                (ucols_own >= coffsets[p])
+                & (ucols_own < coffsets[p + 1])
+            )
+            slot = np.empty(len(ucols_own), dtype=np.int64)
+            slot[owned_m] = ucols_own[owned_m] - coffsets[p]
+            slot[~owned_m] = rows_pp_c + np.searchsorted(
+                halo_c, ucols_own[~owned_m]
+            )
+            coo = P_own.tocoo()
+            P_local.append(
+                sps.csr_matrix(
+                    (coo.data, (coo.row, slot[coo.col])),
+                    shape=(
+                        int(counts[p]),
+                        rows_pp_c + len(halo_c),
+                    ),
+                )
+            )
+        P_cols, P_vals = _pad_ell_blocks(P_local, rows_pp)
+        levels.append(
+            DistLevel(
+                A=A_dev, P_cols=P_cols, P_vals=P_vals,
+                R_cols=None, R_vals=None, bridge=None,
+                classical=True,
+            )
+        )
+
+        lvl_parts = new_parts
+        lvl_own = own_c
+
+    # deepest level + consolidated tail: shared finish with the
+    # aggregation builder
+    return finish_distributed_hierarchy(
+        lvl_parts, lvl_own, comm, levels, proc_grid,
+        max_part_nnz, max_part_rows, my_parts,
+    )
+
+
+def build_distributed_classical_hierarchy(
+    Asp: sps.csr_matrix,
+    n_parts: int,
+    cfg,
+    scope: str,
+    grid=None,
+    owner=None,
+    max_levels: int = 20,
+    consolidate_rows: int = 4096,
+) -> DistHierarchy:
+    """Single-process convenience wrapper (mirrors
+    hierarchy.build_distributed_hierarchy): partition the global matrix
+    into local parts, then run the per-process classical setup loop
+    over a loopback fabric."""
+    from amgx_tpu.amg.aggregation import infer_grid, stencil_offsets
+    from amgx_tpu.distributed.partition import (
+        ArrayOwnership,
+        localize_columns,
+        partition_rows,
+    )
+
+    n = Asp.shape[0]
+    Asp = Asp.tocsr()
+    Asp.sort_indices()
+    proc_grid = None
+    if owner is None:
+        if grid is None:
+            offs = stencil_offsets(Asp)
+            grid = infer_grid(offs, n) if offs is not None else None
+        owner, proc_grid = partition_rows(n, n_parts, grid)
+    else:
+        owner = np.asarray(owner, dtype=np.int32)
+    ownership = ArrayOwnership(owner, n_parts=n_parts)
+
+    rows_pp = max(int(ownership.counts.max()), 1)
+    local_parts = {}
+    for p in range(n_parts):
+        local = Asp[ownership.global_rows(p)].tocsr()
+        local_parts[p] = localize_columns(
+            local.indptr, local.indices, local.data, owner,
+            ownership.local_arr, p, rows_pp,
+        )
+    return build_distributed_classical_hierarchy_local(
+        local_parts, ownership, cfg, scope,
+        max_levels=max_levels,
+        consolidate_rows=consolidate_rows,
+        proc_grid=proc_grid,
+    )

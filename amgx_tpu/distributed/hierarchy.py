@@ -97,6 +97,12 @@ class DistLevel:
     # replays the inverted steps in reverse order.  None when the
     # coarse grid keeps one part per shard.
     bridge: Any = None
+    # classical levels: P couples shards — P_cols index the COARSE
+    # level's extended local numbering (owned slots + coarse halo), so
+    # prolongation needs a coarse halo exchange and restriction a
+    # reverse (accumulating) exchange; R_cols/R_vals are unused
+    # (R = P^T is applied by scatter-add + reverse exchange).
+    classical: bool = False
 
 
 @dataclasses.dataclass
@@ -236,6 +242,94 @@ def _finalize_level(
     return dm
 
 
+def init_lvl_parts(local_parts, ownership: Ownership, my_parts):
+    """Localized part dicts -> the per-part csr level state both
+    builders (aggregation and classical) iterate on."""
+    rows_pp0 = max(int(ownership.counts.max()), 1)
+
+    def as_csr(part, counts_p):
+        nloc = rows_pp0 + len(part["halo_glob"])
+        return sps.csr_matrix(
+            (part["vals"], part["cols"], part["indptr"]),
+            shape=(counts_p, nloc),
+        )
+
+    return {
+        p: dict(
+            A=as_csr(local_parts[p], int(ownership.counts[p])),
+            halo_glob=np.asarray(
+                local_parts[p]["halo_glob"], dtype=np.int64
+            ),
+        )
+        for p in my_parts
+    }
+
+
+def finish_distributed_hierarchy(
+    lvl_parts, lvl_own: Ownership, comm, levels, proc_grid,
+    max_part_nnz: int, max_part_rows: int, my_parts,
+) -> DistHierarchy:
+    """Shared tail of both distributed builders: finalize the deepest
+    level (materializing its small owner maps for the cycle's
+    consolidation gather), allgather the consolidated tail matrix
+    (reference glue_matrices — O(tail nnz) per part, bounded by the
+    consolidation threshold), and package the traffic stats."""
+    counts_L = lvl_own.counts
+    rows_pp_L = max(int(counts_L.max()), 1)
+    A_last = _finalize_level(
+        lvl_parts_to_parts(lvl_parts), lvl_own, comm,
+        proc_grid=proc_grid if not levels else None,
+    )
+    owner_L, local_L = lvl_own.materialize()
+    A_last.owner = owner_L
+    A_last.local_of = local_L
+    levels.append(DistLevel(A=A_last))
+
+    tail_local = {}
+    for p in my_parts:
+        m = lvl_parts[p]["A"].tocoo()
+        hg = lvl_parts[p]["halo_glob"]
+        col_to_g = np.zeros(m.shape[1], dtype=np.int64)
+        g_rows = lvl_own.global_rows(p)
+        col_to_g[: counts_L[p]] = g_rows
+        if len(hg):
+            col_to_g[rows_pp_L: rows_pp_L + len(hg)] = hg
+        tail_local[p] = (g_rows[m.row], col_to_g[m.col], m.data)
+    gathered = comm.allgather(tail_local, kind="tail-glue")
+    rows = [t[0] for t in gathered]
+    cols = [t[1] for t in gathered]
+    vals = [t[2] for t in gathered]
+    ng_L = lvl_own.n_global
+    tail = sps.csr_matrix(
+        (
+            np.concatenate(vals) if vals else np.zeros(0),
+            (
+                np.concatenate(rows) if rows else np.zeros(0, int),
+                np.concatenate(cols) if cols else np.zeros(0, int),
+            ),
+        ),
+        shape=(ng_L, ng_L),
+    )
+    tail.sum_duplicates()
+    tail.sort_indices()
+
+    stats = dict(
+        comm_total_bytes=comm.stats.total_bytes,
+        comm_max_msg_bytes=comm.stats.max_msg_bytes,
+        comm_rounds=len(comm.stats.rounds),
+        max_part_nnz=int(max_part_nnz),
+        max_part_rows=int(max_part_rows),
+        n_parts=comm.n_parts,
+    )
+    return DistHierarchy(
+        levels=levels,
+        tail_matrix=tail,
+        tail_owner=owner_L,
+        tail_local_of=local_L,
+        setup_stats=stats,
+    )
+
+
 def build_distributed_hierarchy_local(
     local_parts: Dict[int, dict],
     ownership: Ownership,
@@ -271,25 +365,7 @@ def build_distributed_hierarchy_local(
     max_part_nnz = 0
     max_part_rows = 0
 
-    # per-level per-part state: csr (counts_p x (rows_pp + n_halo)),
-    # halo_glob
-    def as_csr(part, counts_p, rows_pp):
-        nloc = rows_pp + len(part["halo_glob"])
-        return sps.csr_matrix(
-            (part["vals"], part["cols"], part["indptr"]),
-            shape=(counts_p, nloc),
-        )
-
-    rows_pp0 = max(int(ownership.counts.max()), 1)
-    lvl_parts = {
-        p: dict(
-            A=as_csr(local_parts[p], int(ownership.counts[p]), rows_pp0),
-            halo_glob=np.asarray(
-                local_parts[p]["halo_glob"], dtype=np.int64
-            ),
-        )
-        for p in my_parts
-    }
+    lvl_parts = init_lvl_parts(local_parts, ownership, my_parts)
     lvl_own: Ownership = ownership
     levels: List[DistLevel] = []
 
@@ -477,67 +553,9 @@ def build_distributed_hierarchy_local(
         lvl_parts = new_parts
         lvl_own = own_c
 
-    # deepest distributed level (operator only; smoothed, no transfer).
-    # Materialize its owner/local_of arrays — O(tail size), bounded by
-    # consolidate_rows — for the cycle's consolidation gather maps.
-    counts_L = lvl_own.counts
-    rows_pp_L = max(int(counts_L.max()), 1)
-    A_last = _finalize_level(
-        lvl_parts_to_parts(lvl_parts), lvl_own, comm,
-        proc_grid=proc_grid if not levels else None,
-    )
-    owner_L, local_L = lvl_own.materialize()
-    A_last.owner = owner_L
-    A_last.local_of = local_L
-    levels.append(DistLevel(A=A_last))
-
-    # consolidated tail: allgather the last level's rows into one host
-    # matrix in GLOBAL coarse numbering (reference glue_matrices).
-    # O(tail nnz) per part — bounded by the consolidation threshold.
-    tail_local: Dict[int, Any] = {}
-    for p in my_parts:
-        m = lvl_parts[p]["A"].tocoo()
-        hg = lvl_parts[p]["halo_glob"]
-        col_to_g = np.zeros(m.shape[1], dtype=np.int64)
-        g_rows = lvl_own.global_rows(p)
-        col_to_g[: counts_L[p]] = g_rows
-        if len(hg):
-            col_to_g[rows_pp_L: rows_pp_L + len(hg)] = hg
-        tail_local[p] = (
-            g_rows[m.row], col_to_g[m.col], m.data,
-        )
-    gathered = comm.allgather(tail_local, kind="tail-glue")
-    rows = [t[0] for t in gathered]
-    cols = [t[1] for t in gathered]
-    vals = [t[2] for t in gathered]
-    ng_L = lvl_own.n_global
-    tail = sps.csr_matrix(
-        (
-            np.concatenate(vals) if vals else np.zeros(0),
-            (
-                np.concatenate(rows) if rows else np.zeros(0, int),
-                np.concatenate(cols) if cols else np.zeros(0, int),
-            ),
-        ),
-        shape=(ng_L, ng_L),
-    )
-    tail.sum_duplicates()
-    tail.sort_indices()
-
-    stats = dict(
-        comm_total_bytes=comm.stats.total_bytes,
-        comm_max_msg_bytes=comm.stats.max_msg_bytes,
-        comm_rounds=len(comm.stats.rounds),
-        max_part_nnz=int(max_part_nnz),
-        max_part_rows=int(max_part_rows),
-        n_parts=n_parts,
-    )
-    return DistHierarchy(
-        levels=levels,
-        tail_matrix=tail,
-        tail_owner=owner_L,
-        tail_local_of=local_L,
-        setup_stats=stats,
+    return finish_distributed_hierarchy(
+        lvl_parts, lvl_own, comm, levels, proc_grid,
+        max_part_nnz, max_part_rows, my_parts,
     )
 
 

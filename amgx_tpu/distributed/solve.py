@@ -78,6 +78,51 @@ def exchange_halo(A: DistributedMatrix, shard, x_loc, axis):
     return pool[hsp, hpos]
 
 
+def exchange_halo_reverse(A: DistributedMatrix, shard, y_own, y_halo,
+                          axis):
+    """Accumulating reverse exchange (reference add_from_halo,
+    distributed_comms.h:138): each shard's HALO-slot partials are sent
+    back to the owning shard and ADDED into its owned slots.  This is
+    the transpose of exchange_halo — classical restriction R = P^T
+    scatters partial coarse sums into halo slots, which must fold back
+    into their owners' rows.
+
+    ``y_own``: [rows] owned partials; ``y_halo``: [max_halo] halo-slot
+    partials.  Returns y_own with remote contributions added.
+    """
+    if A.uses_ppermute:
+        send_idx_d, halo_dir, halo_pos = shard["ex"]
+        for d, perm in enumerate(A.perms):
+            ms = send_idx_d[d].shape[0]
+            # pack: this shard's halo partials for direction d land at
+            # their position in the (src, dst) id list; others drop
+            # into a spill slot
+            buf = jnp.zeros((ms + 1,), y_own.dtype)
+            idx = jnp.where(halo_dir == d, halo_pos, ms)
+            buf = buf.at[idx].add(y_halo)
+            inv = [(dst, src) for (src, dst) in perm]
+            recv = jax.lax.ppermute(buf[:ms], axis, perm=inv)
+            # unpack: the owner adds received partials at the same
+            # B2L gather indices the forward exchange packs from.
+            # INVARIANT: padding positions of send_idx_d are 0 and the
+            # matching recv slots are provably 0 (y_halo padding only
+            # ever receives zero-valued scatter contributions, and buf
+            # slots beyond a pair's id count are never written), so
+            # row 0 accumulates only zeros from padding.
+            y_own = y_own.at[send_idx_d[d]].add(recv)
+        return y_own
+    send_idx, hsp, hpos = shard["ex"]
+    pool = jax.lax.all_gather(y_halo, axis)  # [N, max_halo]
+    hsp_all = jax.lax.all_gather(hsp, axis)  # [N, max_halo]
+    hpos_all = jax.lax.all_gather(hpos, axis)
+    me = jax.lax.axis_index(axis)
+    ms = send_idx.shape[0]
+    contrib = jnp.zeros((ms + 1,), y_own.dtype)
+    idx = jnp.where(hsp_all == me, hpos_all, ms)
+    contrib = contrib.at[idx.reshape(-1)].add(pool.reshape(-1))
+    return y_own.at[send_idx].add(contrib[:ms])
+
+
 def make_local_spmv(A: DistributedMatrix, axis):
     """Shard-local y = (A x)_loc with halo exchange over `axis`.
 
