@@ -793,13 +793,148 @@ class DistributedAMG:
 
         return jax.jit(solve_sm), lps
 
-    def solve(self, b, max_iters=200, tol=1e-8):
-        """Distributed AMG-preconditioned CG -> (x, iters, nrm).  The
-        jitted program is cached per (max_iters, tol)."""
-        key = (max_iters, float(tol))
+    def _build_solve_fgmres(self, max_iters, tol, restart):
+        """Distributed FGMRES(restart) preconditioned by the AMG cycle
+        (reference fgmres_solver.cu; the north-star outer solver).
+
+        Same Arnoldi/Givens machinery as the serial FGMRES — H, g, cs,
+        sn are replicated scalars identical on every shard because all
+        dots ride psum — with the basis vectors V/Z stored shard-local.
+        """
+        axis = self.axis
+        lps = self._traced_level_params()
+        in_lps = jax.tree.map(lambda _: P(axis), lps)
+        cycle = self._make_cycle()
+        fine_spmv = make_local_spmv(self.fine, axis)
+        m = restart
+
+        @functools.partial(
+            jax.shard_map,
+            mesh=self.mesh,
+            in_specs=(in_lps, None, P(axis)),
+            out_specs=(P(axis), P(), P()),
+        )
+        def solve_sm(lps_stk, tail_params, b_stk):
+            lps_loc = jax.tree.map(lambda s: s[0], lps_stk)
+            b_loc = b_stk[0]
+            sh0 = lps_loc[0][0]
+            M = lambda r: cycle(lps_loc, tail_params, r)
+            n = b_loc.shape[0]
+            dt = b_loc.dtype
+            nrm0 = jnp.sqrt(_pdot(b_loc, b_loc, axis))
+
+            def arnoldi_step(c):
+                (j, V, Z, H, g, cs, sn, it, res) = c
+                v = V[j]
+                z = M(v)
+                w = fine_spmv(sh0, z)
+                Z = Z.at[j].set(z)
+                hcol = jnp.zeros(m + 1, dt)
+
+                def mgs(i, wc):
+                    w, hcol = wc
+                    h = jnp.where(
+                        i <= j, _pdot(V[i], w, axis), 0.0
+                    )
+                    w = w - h * V[i]
+                    return (w, hcol.at[i].set(h))
+
+                w, hcol = jax.lax.fori_loop(0, m, mgs, (w, hcol))
+                hlast = jnp.sqrt(_pdot(w, w, axis))
+                hcol = hcol.at[j + 1].set(hlast)
+                V = V.at[j + 1].set(
+                    w / jnp.where(hlast > 0, hlast, 1.0)
+                )
+
+                def rot(i, hc):
+                    t = cs[i] * hc[i] + sn[i] * hc[i + 1]
+                    u = -sn[i] * hc[i] + cs[i] * hc[i + 1]
+                    do = i < j
+                    return hc.at[i].set(
+                        jnp.where(do, t, hc[i])
+                    ).at[i + 1].set(jnp.where(do, u, hc[i + 1]))
+
+                hcol = jax.lax.fori_loop(0, m, rot, hcol)
+                hj, hj1 = hcol[j], hcol[j + 1]
+                denom = jnp.sqrt(hj * hj + hj1 * hj1)
+                denom = jnp.where(denom > 0, denom, 1.0)
+                c_new, s_new = hj / denom, hj1 / denom
+                hcol = hcol.at[j].set(denom).at[j + 1].set(0.0)
+                cs = cs.at[j].set(c_new)
+                sn = sn.at[j].set(s_new)
+                gj = g[j]
+                g = g.at[j].set(c_new * gj).at[j + 1].set(
+                    -s_new * gj
+                )
+                H = H.at[:, j].set(hcol)
+                return (
+                    j + 1, V, Z, H, g, cs, sn, it + 1,
+                    jnp.abs(g[j + 1]),
+                )
+
+            def arnoldi_cond(c):
+                j, it, res = c[0], c[7], c[8]
+                return (
+                    (j < m) & (res >= tol * nrm0) & (it < max_iters)
+                )
+
+            def restart_body(c):
+                x, it, res = c
+                r = b_loc - fine_spmv(sh0, x)
+                beta = jnp.sqrt(_pdot(r, r, axis))
+                # pvary: V/Z hold shard-local basis vectors — mark the
+                # zero initializers as device-varying so the while_loop
+                # carry types match (shard_map vma typing)
+                V = jax.lax.pvary(jnp.zeros((m + 1, n), dt), (axis,))
+                V = V.at[0].set(
+                    r / jnp.where(beta > 0, beta, 1.0)
+                )
+                Z = jax.lax.pvary(jnp.zeros((m, n), dt), (axis,))
+                H = jnp.zeros((m + 1, m), dt)
+                g = jnp.zeros(m + 1, dt).at[0].set(beta)
+                cs = jnp.ones(m, dt)
+                sn = jnp.zeros(m, dt)
+                (j, V, Z, H, g, cs, sn, it, res) = jax.lax.while_loop(
+                    arnoldi_cond, arnoldi_step,
+                    (jnp.int32(0), V, Z, H, g, cs, sn, it, beta),
+                )
+                idx = jnp.arange(m)
+                diag_fix = jnp.where(idx >= j, 1.0, 0.0)
+                R = H[:m, :m] + jnp.diag(diag_fix)
+                gm = jnp.where(idx < j, g[:m], 0.0)
+                y = jax.scipy.linalg.solve_triangular(
+                    R, gm, lower=False
+                )
+                x = x + Z.T @ y
+                return (x, it, res)
+
+            def outer_cond(c):
+                it, res = c[1], c[2]
+                return (res >= tol * nrm0) & (it < max_iters) & (
+                    nrm0 > 0
+                )
+
+            x, it, res = jax.lax.while_loop(
+                outer_cond, restart_body,
+                (jnp.zeros_like(b_loc), jnp.int32(0), nrm0),
+            )
+            return x[None], it, res
+
+        return jax.jit(solve_sm), lps
+
+    def solve(self, b, max_iters=200, tol=1e-8, outer="pcg",
+              restart=32):
+        """Distributed AMG-preconditioned solve -> (x, iters, nrm).
+        ``outer``: 'pcg' (default) or 'fgmres' (the north-star outer,
+        reference FGMRES_AGGREGATION).  Jitted programs are cached per
+        (outer, max_iters, tol, restart)."""
+        key = (outer, max_iters, float(tol), restart)
         hit = self._solve_cache.get(key)
         if hit is None:
-            hit = self._build_solve(max_iters, tol)
+            if outer == "fgmres":
+                hit = self._build_solve_fgmres(max_iters, tol, restart)
+            else:
+                hit = self._build_solve(max_iters, tol)
             self._solve_cache[key] = hit
         fn, lps = hit
         bp = jnp.asarray(self.fine.pad_vector(np.asarray(b)))
