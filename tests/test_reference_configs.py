@@ -25,7 +25,11 @@ CONFIG_DIR = "/root/reference/src/configs"
 # "a regression in preconditioner quality would pass CI today"); the
 # assertion allows +-1 iteration of float-level drift.
 REPRESENTATIVE = {
-    "FGMRES_AGGREGATION.json": 11,
+    # Re-pinned r4: the original pin of 11 was recorded without running
+    # the test (it already measured 6 at the pinning commit fdb803d, so
+    # no post-pin regression occurred).  6 is the verified count for
+    # FGMRES(10)+aggregation-AMG/MULTICOLOR_DILU on the 12^3 Poisson.
+    "FGMRES_AGGREGATION.json": 6,
     "AMG_CLASSICAL_PMIS.json": 11,
     "PCG_CLASSICAL_V_JACOBI.json": 11,
     "AMG_CLASSICAL_CG.json": 16,
