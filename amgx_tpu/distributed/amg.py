@@ -42,34 +42,189 @@ from amgx_tpu.distributed.solve import (
 from amgx_tpu.core.profiling import named_scope, trace_range
 
 
-def _local_colors(A):
-    """Distance-1 greedy coloring of each shard's LOCAL coupling graph
-    (halo columns excluded), stacked [N, rows] with padding rows -1.
-    Returns (colors, num_colors)."""
+def _level_is_sharded(A) -> bool:
+    """True when the level's stacked arrays are multi-process sharded
+    ``jax.Array``s (per-rank assembly) rather than host numpy."""
+    return not isinstance(A.ell_cols, np.ndarray)
+
+
+def _host_part_blocks(A):
+    """{p: (ell_cols_p, ell_vals_p, diag_p, n_owned_p)} host views of
+    the parts this process holds: every part for numpy-stacked levels,
+    the addressable shards for multi-process sharded levels — smoother
+    metadata stays O(global / n_processes) per process."""
+    if not _level_is_sharded(A):
+        return {
+            p: (
+                A.ell_cols[p], A.ell_vals[p], A.diag[p],
+                int(A.n_owned[p]) if A.n_owned is not None
+                else A.ell_cols.shape[1],
+            )
+            for p in range(A.n_parts)
+        }
+    by_field = []
+    for arr in (A.ell_cols, A.ell_vals, A.diag):
+        by_field.append(
+            {
+                s.index[0].start: np.asarray(s.data)[0]
+                for s in arr.addressable_shards
+            }
+        )
+    cols_by, vals_by, diag_by = by_field
+    return {
+        p: (
+            cols_by[p], vals_by[p], diag_by[p],
+            int(A.n_owned[p]) if A.n_owned is not None
+            else cols_by[p].shape[0],
+        )
+        for p in cols_by
+    }
+
+
+def _part_colors(cols_p, vals_p, nr):
+    """Distance-1 greedy coloring of ONE shard's LOCAL coupling graph
+    (halo columns excluded); padding rows -1.  Returns (colors, nc)."""
     from amgx_tpu.ops.coloring import greedy_coloring
 
-    cols = np.asarray(A.ell_cols)
-    vals = np.asarray(A.ell_vals)
-    n_parts, rows, w = cols.shape
-    out = np.full((n_parts, rows), -1, dtype=np.int32)
+    rows, w = cols_p.shape
+    out = np.full(rows, -1, dtype=np.int32)
     nc = 1
-    for p in range(n_parts):
-        nr = int(A.n_owned[p]) if A.n_owned is not None else rows
-        rid = np.broadcast_to(
-            np.arange(rows, dtype=np.int64)[:, None], (rows, w)
-        )
-        em = (vals[p] != 0) & (cols[p] < rows) & (cols[p] != rid)
-        counts = em[:nr].sum(axis=1)
-        indptr = np.concatenate([[0], np.cumsum(counts)])
-        indices = cols[p][:nr][em[:nr]].astype(np.int64)
-        if nr:
-            c = greedy_coloring(indptr, indices, nr)
-            out[p, :nr] = c
-            nc = max(nc, int(c.max()) + 1)
+    rid = np.broadcast_to(
+        np.arange(rows, dtype=np.int64)[:, None], (rows, w)
+    )
+    em = (vals_p != 0) & (cols_p < rows) & (cols_p != rid)
+    counts = em[:nr].sum(axis=1)
+    indptr = np.concatenate([[0], np.cumsum(counts)])
+    indices = cols_p[:nr][em[:nr]].astype(np.int64)
+    if nr:
+        c = greedy_coloring(indptr, indices, nr)
+        out[:nr] = c
+        nc = int(c.max()) + 1
     return out, nc
 
 
-def _local_dilu(A, colors, nc):
+def _local_colors(A, comm=None, mesh=None, blocks=None,
+                  build_stacked=True):
+    """Per-shard local colorings stacked [N, rows] (numpy, or sharded
+    ``jax.Array``s in the per-rank assembly); num_colors is a
+    comm-wide consensus so every process traces the same sweep
+    structure.  Returns (colors, num_colors, host_colors_by_part);
+    ``build_stacked=False`` skips the stacked array (DILU needs only
+    the host colorings — the factor slices encode the sweep)."""
+    if blocks is None:
+        blocks = _host_part_blocks(A)
+    per = {}
+    nc_local = {}
+    for p, (cols_p, vals_p, _d, nr) in blocks.items():
+        per[p], nc_local[p] = _part_colors(cols_p, vals_p, nr)
+    if not _level_is_sharded(A):
+        stacked = (
+            np.stack([per[p] for p in range(A.n_parts)])
+            if build_stacked else None
+        )
+        return stacked, max(max(nc_local.values(), default=1), 1), per
+    nc = max(max(comm.allgather(nc_local, kind="colors-nc")), 1)
+    if not build_stacked:
+        return None, nc, per
+    from amgx_tpu.distributed.multihost import stack_parts_sharded
+
+    return (
+        stack_parts_sharded(
+            per, mesh, A.n_parts,
+            shape=(A.rows_per_part,), dtype=np.int32,
+        ),
+        nc,
+        per,
+    )
+
+
+def _part_dilu(cols_p, vals_p, nr, cp, nc, rows_pp):
+    """One shard's DILU factor split per color:
+    [c] -> dict(rows, einv, L=(row,col,val), U=(row,col,val))."""
+    w = cols_p.shape[1]
+    rid = np.repeat(np.arange(rows_pp), w).reshape(rows_pp, w)
+    keep = (vals_p != 0) & (cols_p < nr) & (rid < nr)
+    Al = sps.csr_matrix(
+        (vals_p[keep], (rid[keep], cols_p[keep])),
+        shape=(nr, nr),
+    )
+    d = np.asarray(Al.diagonal())
+    # pairwise products p_ij = a_ij * a_ji on the symmetric-
+    # intersection pattern (Hadamard with the transpose)
+    Pm = Al.multiply(Al.T.tocsr()).tocsr()
+    E = d.copy()
+    for c in range(1, nc):
+        rows_c = np.nonzero(cp[:nr] == c)[0]
+        if not len(rows_c):
+            continue
+        lower = (cp[:nr] >= 0) & (cp[:nr] < c)
+        invE = np.where(
+            lower & (E != 0), 1.0 / np.where(E != 0, E, 1.0), 0.0
+        )
+        E[rows_c] = d[rows_c] - Pm[rows_c] @ invE
+    einv = np.where(E != 0, 1.0 / np.where(E != 0, E, 1.0), 0.0)
+
+    Alc = Al.tocoo()
+    row_color = cp[:nr][Alc.row]
+    col_color = cp[:nr][Alc.col]
+    shard_cols = []
+    for c in range(nc):
+        rows_c = np.nonzero(cp[:nr] == c)[0]
+        sel = row_color == c
+        r_of = np.full(nr, -1, dtype=np.int64)
+        r_of[rows_c] = np.arange(len(rows_c))
+        ent_r = r_of[Alc.row[sel]]
+        ent_c = Alc.col[sel]
+        ent_v = Alc.data[sel]
+        low = col_color[sel] < c  # rows here all have color c
+        off = ent_c != Alc.row[sel]  # strictly off-diagonal
+        shard_cols.append(
+            dict(
+                rows=rows_c,
+                einv=einv[rows_c],
+                L=(ent_r[off & low], ent_c[off & low],
+                   ent_v[off & low]),
+                U=(ent_r[off & ~low], ent_c[off & ~low],
+                   ent_v[off & ~low]),
+            )
+        )
+    return shard_cols
+
+
+def _pack_dilu_color(e, rc_max, wl, wu, rows_pp, dtype):
+    """Pack one shard's color slice into fixed-shape arrays
+    (ridx, Lc, Lv, Uc, Uv, einv); pads point at the spill slot
+    ``rows_pp`` with zero values/Einv."""
+
+    def pack(trip, n_rows_c, width):
+        er, ec, ev = trip
+        cols = np.full((n_rows_c, width), rows_pp, dtype=np.int32)
+        vals = np.zeros((n_rows_c, width), dtype=dtype)
+        if len(er):
+            order = np.argsort(er, kind="stable")
+            er, ec, ev = er[order], ec[order], ev[order]
+            pos = np.arange(len(er)) - np.searchsorted(er, er)
+            cols[er, pos] = ec
+            vals[er, pos] = ev
+        return cols, vals
+
+    k = len(e["rows"])
+    ridx = np.full((rc_max,), rows_pp, dtype=np.int32)
+    einv = np.zeros((rc_max,), dtype=dtype)
+    Lc = np.full((rc_max, wl), rows_pp, dtype=np.int32)
+    Lv = np.zeros((rc_max, wl), dtype=dtype)
+    Uc = np.full((rc_max, wu), rows_pp, dtype=np.int32)
+    Uv = np.zeros((rc_max, wu), dtype=dtype)
+    ridx[:k] = e["rows"]
+    einv[:k] = e["einv"]
+    lc, lv = pack(e["L"], max(k, 1), wl)
+    uc, uv = pack(e["U"], max(k, 1), wu)
+    Lc[:k], Lv[:k] = lc[:k], lv[:k]
+    Uc[:k], Uv[:k] = uc[:k], uv[:k]
+    return ridx, Lc, Lv, Uc, Uv, einv
+
+
+def _local_dilu(A, colors_by_p, nc, comm=None, mesh=None, blocks=None):
     """Per-shard DILU factor + per-color compact L/U ELL slices
     (reference multicolor_dilu_solver.cu, the workhorse smoother).
 
@@ -84,117 +239,84 @@ def _local_dilu(A, colors, nc):
     application costs O(nnz) total — each stored entry is touched by
     exactly one forward and one backward stage.
 
-    Returns a tuple (one entry per color) of stacked arrays
-    ``(ridx, Lc, Lv, Uc, Uv, Einv)``; row/column pads point at the
-    spill slot ``rows_pp`` with zero values/Einv.
+    ``colors_by_p`` holds this process's parts' host colorings; the
+    per-color slice shapes (rc_max, wl, wu) are a comm-wide consensus
+    so every process traces identical sweeps.  Returns a tuple (one
+    entry per color) of stacked (numpy or mesh-sharded)
+    ``(ridx, Lc, Lv, Uc, Uv, Einv)`` arrays.
     """
-    ell_cols = np.asarray(A.ell_cols)
-    ell_vals = np.asarray(A.ell_vals)
-    n_parts, rows_pp, w = ell_cols.shape
-    per = []  # [p][c] -> dict
-    for p in range(n_parts):
-        nr = int(A.n_owned[p]) if A.n_owned is not None else rows_pp
-        cp = colors[p]
-        rid = np.repeat(np.arange(rows_pp), w).reshape(rows_pp, w)
-        keep = (
-            (ell_vals[p] != 0) & (ell_cols[p] < nr) & (rid < nr)
+    if blocks is None:
+        blocks = _host_part_blocks(A)
+    rows_pp = A.rows_per_part
+    n_parts = A.n_parts
+    per = {}
+    dtype = np.dtype(A.ell_vals.dtype)
+    for p, (cols_p, vals_p, _d, nr) in blocks.items():
+        per[p] = _part_dilu(
+            cols_p, vals_p, nr, colors_by_p[p], nc, rows_pp
         )
-        Al = sps.csr_matrix(
-            (
-                ell_vals[p][keep],
-                (rid[keep], ell_cols[p][keep]),
-            ),
-            shape=(nr, nr),
-        )
-        d = np.asarray(Al.diagonal())
-        # pairwise products p_ij = a_ij * a_ji on the symmetric-
-        # intersection pattern (Hadamard with the transpose)
-        Pm = Al.multiply(Al.T.tocsr()).tocsr()
-        E = d.copy()
-        for c in range(1, nc):
-            rows_c = np.nonzero(cp[:nr] == c)[0]
-            if not len(rows_c):
-                continue
-            lower = (cp[:nr] >= 0) & (cp[:nr] < c)
-            invE = np.where(
-                lower & (E != 0), 1.0 / np.where(E != 0, E, 1.0), 0.0
-            )
-            E[rows_c] = d[rows_c] - Pm[rows_c] @ invE
-        einv = np.where(E != 0, 1.0 / np.where(E != 0, E, 1.0), 0.0)
 
-        Alc = Al.tocoo()
-        row_color = cp[:nr][Alc.row]
-        col_color = cp[:nr][Alc.col]
-        shard_cols = []
+    def widths_of(shard_cols):
+        out = []
         for c in range(nc):
-            rows_c = np.nonzero(cp[:nr] == c)[0]
-            sel = row_color == c
-            r_of = np.full(nr, -1, dtype=np.int64)
-            r_of[rows_c] = np.arange(len(rows_c))
-            ent_r = r_of[Alc.row[sel]]
-            ent_c = Alc.col[sel]
-            ent_v = Alc.data[sel]
-            low = col_color[sel] < c  # rows here all have color c
-            off = ent_c != Alc.row[sel]  # strictly off-diagonal
-            shard_cols.append(
-                dict(
-                    rows=rows_c,
-                    einv=einv[rows_c],
-                    L=(ent_r[off & low], ent_c[off & low],
-                       ent_v[off & low]),
-                    U=(ent_r[off & ~low], ent_c[off & ~low],
-                       ent_v[off & ~low]),
-                )
+            e = shard_cols[c]
+            wl = (
+                int(np.bincount(e["L"][0]).max())
+                if len(e["L"][0]) else 0
             )
-        per.append(shard_cols)
+            wu = (
+                int(np.bincount(e["U"][0]).max())
+                if len(e["U"][0]) else 0
+            )
+            out.append((len(e["rows"]), wl, wu))
+        return out
 
-    def pack(trip, n_rows_c, width):
-        er, ec, ev = trip
-        cols = np.full((n_rows_c, width), rows_pp, dtype=np.int32)
-        vals = np.zeros((n_rows_c, width), dtype=ell_vals.dtype)
-        if len(er):
-            order = np.argsort(er, kind="stable")
-            er, ec, ev = er[order], ec[order], ev[order]
-            pos = np.arange(len(er)) - np.searchsorted(er, er)
-            cols[er, pos] = ec
-            vals[er, pos] = ev
-        return cols, vals
-
+    wloc = {p: widths_of(per[p]) for p in per}
+    if _level_is_sharded(A):
+        gathered = comm.allgather(wloc, kind="dilu-widths")
+    else:
+        gathered = [wloc[p] for p in range(n_parts)]
     meta = []
     for c in range(nc):
-        rc_max = max(max(len(per[p][c]["rows"]) for p in range(n_parts)), 1)
-        wl = max(
-            max(
-                (np.bincount(per[p][c]["L"][0]).max()
-                 if len(per[p][c]["L"][0]) else 0)
-                for p in range(n_parts)
-            ),
-            1,
-        )
-        wu = max(
-            max(
-                (np.bincount(per[p][c]["U"][0]).max()
-                 if len(per[p][c]["U"][0]) else 0)
-                for p in range(n_parts)
-            ),
-            1,
-        )
-        ridx = np.full((n_parts, rc_max), rows_pp, dtype=np.int32)
-        einv = np.zeros((n_parts, rc_max), dtype=ell_vals.dtype)
-        Lc = np.full((n_parts, rc_max, wl), rows_pp, dtype=np.int32)
-        Lv = np.zeros((n_parts, rc_max, wl), dtype=ell_vals.dtype)
-        Uc = np.full((n_parts, rc_max, wu), rows_pp, dtype=np.int32)
-        Uv = np.zeros((n_parts, rc_max, wu), dtype=ell_vals.dtype)
-        for p in range(n_parts):
-            e = per[p][c]
-            k = len(e["rows"])
-            ridx[p, :k] = e["rows"]
-            einv[p, :k] = e["einv"]
-            lc, lv = pack(e["L"], max(k, 1), wl)
-            uc, uv = pack(e["U"], max(k, 1), wu)
-            Lc[p, :k], Lv[p, :k] = lc[:k], lv[:k]
-            Uc[p, :k], Uv[p, :k] = uc[:k], uv[:k]
-        meta.append((ridx, Lc, Lv, Uc, Uv, einv))
+        rc_max = max(max(g[c][0] for g in gathered), 1)
+        wl = max(max(g[c][1] for g in gathered), 1)
+        wu = max(max(g[c][2] for g in gathered), 1)
+        packed = {
+            p: _pack_dilu_color(
+                per[p][c], rc_max, wl, wu, rows_pp, dtype
+            )
+            for p in per
+        }
+        if not _level_is_sharded(A):
+            meta.append(
+                tuple(
+                    np.stack([packed[p][i] for p in range(n_parts)])
+                    for i in range(6)
+                )
+            )
+        else:
+            from amgx_tpu.distributed.multihost import (
+                stack_parts_sharded,
+            )
+
+            shapes = (
+                ((rc_max,), np.int32),       # ridx
+                ((rc_max, wl), np.int32),    # Lc
+                ((rc_max, wl), dtype),       # Lv
+                ((rc_max, wu), np.int32),    # Uc
+                ((rc_max, wu), dtype),       # Uv
+                ((rc_max,), dtype),          # einv
+            )
+            meta.append(
+                tuple(
+                    stack_parts_sharded(
+                        {p: packed[p][i] for p in packed},
+                        mesh, n_parts,
+                        shape=shapes[i][0], dtype=shapes[i][1],
+                    )
+                    for i in range(6)
+                )
+            )
     return tuple(meta)
 
 
@@ -258,10 +380,27 @@ class DistributedAMG:
         """Per-process entry (reference per-rank upload + setup_v2):
         ``local_parts[p]`` is multihost.local_part_from_rows output for
         the parts this process drives; the global matrix is never
-        materialized.  Setup traffic rides the comm fabric
-        (distributed.comm.default_comm when None)."""
+        materialized.  Setup traffic rides the comm fabric; when
+        ``comm`` is None under a multi-process runtime the fabric's
+        part striping follows the MESH placement (part p lives on
+        flattened mesh device p — the device-assembly invariant)."""
         from amgx_tpu.distributed.partition import OffsetOwnership
 
+        if comm is None:
+            import jax as _jax
+
+            n_parts = int(mesh.devices.size)
+            if _jax.process_count() > 1:
+                from amgx_tpu.distributed.comm import AllgatherComm
+                from amgx_tpu.distributed.multihost import (
+                    addressable_parts,
+                )
+
+                comm = AllgatherComm(n_parts, addressable_parts(mesh))
+            else:
+                from amgx_tpu.distributed.comm import LoopbackComm
+
+                comm = LoopbackComm(n_parts)
         return cls(
             None, mesh, cfg=cfg, scope=scope,
             consolidate_rows=consolidate_rows,
@@ -334,6 +473,7 @@ class DistributedAMG:
                         local_parts, ownership, self.cfg, self.scope,
                         comm=comm,
                         consolidate_rows=self.consolidate_rows,
+                        mesh=self.mesh,
                     )
                 )
             else:
@@ -346,6 +486,7 @@ class DistributedAMG:
                     comm=comm,
                     consolidate_rows=self.consolidate_rows,
                     grade_lower=self.grade_lower,
+                    mesh=self.mesh,
                 )
         elif algorithm == "CLASSICAL":
             from amgx_tpu.distributed.classical import (
@@ -381,6 +522,16 @@ class DistributedAMG:
         self.tail_amg = tail_amg
         self._tail_cycle = tail_amg.make_cycle()
         self._tail_params = tail_amg.apply_params()
+        if _level_is_sharded(self.fine):
+            # replicated device copies for the multi-process jit (host
+            # numpy can't be auto-committed across processes)
+            from jax.sharding import NamedSharding
+
+            repl = NamedSharding(self.mesh, P())
+            self._tail_params_dev = jax.tree.map(
+                lambda a: jax.device_put(np.asarray(a), repl),
+                self._tail_params,
+            )
 
         # stacked [N, rows_pp_L] global ids of the deepest level's owned
         # slots (consolidation gather/scatter maps; padding -> id 0 with
@@ -417,29 +568,51 @@ class DistributedAMG:
             if len(self.h.levels) == 1
             else self.h.levels[:-1]
         )
+        comm = self.h.comm
+        mesh = self.mesh
         self._level_smooth = []
         self._level_colors = []
         for lvl in ship:
             A = lvl.A
             colors = None
             if self.smoother_kind == "cheby":
-                ev = np.abs(np.asarray(A.ell_vals)).sum(axis=-1)
-                d = np.abs(np.asarray(A.diag))
-                ratio = np.where(d > 0, ev / np.maximum(d, 1e-300), 0.0)
+                # Gershgorin bound per part; the level-wide max is a
+                # comm consensus in the per-rank assembly
+                lmax_loc = {}
+                for p, (_c, vals_p, diag_p, _nr) in (
+                    _host_part_blocks(A).items()
+                ):
+                    ev = np.abs(vals_p).sum(axis=-1)
+                    d = np.abs(diag_p)
+                    ratio = np.where(
+                        d > 0, ev / np.maximum(d, 1e-300), 0.0
+                    )
+                    lmax_loc[p] = float(ratio.max()) if ratio.size else 0.0
                 if self.cheby_mode == 3:
                     lmax, lmin = self.cheby_user_max, self.cheby_user_min
                 else:
-                    lmax = max(float(ratio.max()), 1e-12)
+                    if _level_is_sharded(A):
+                        lmax = max(
+                            comm.allgather(lmax_loc, kind="cheby-lmax")
+                        )
+                    else:
+                        lmax = max(lmax_loc.values(), default=0.0)
+                    lmax = max(float(lmax), 1e-12)
                     lmin = self.cheby_user_min * lmax
                 self._level_smooth.append(
                     ("cheby", (float(lmax), float(lmin)))
                 )
             elif self.smoother_kind == "mcgs":
-                colors, ncolors = _local_colors(A)
+                colors, ncolors, _ = _local_colors(A, comm, mesh)
                 self._level_smooth.append(("mcgs", ncolors))
             elif self.smoother_kind == "dilu":
-                lcolors, ncolors = _local_colors(A)
-                colors = _local_dilu(A, lcolors, ncolors)
+                blocks = _host_part_blocks(A)
+                _, ncolors, host_colors = _local_colors(
+                    A, comm, mesh, blocks=blocks, build_stacked=False
+                )
+                colors = _local_dilu(
+                    A, host_colors, ncolors, comm, mesh, blocks=blocks
+                )
                 self._level_smooth.append(("dilu", ncolors))
             else:
                 self._level_smooth.append((self.smoother_kind, None))
@@ -922,6 +1095,38 @@ class DistributedAMG:
 
         return jax.jit(solve_sm), lps
 
+    def _pad_vector_sharded(self, b):
+        """Replicated host b -> stacked [N, rows] sharded one part per
+        mesh device (the per-rank analogue of pad_vector: each process
+        materializes only its parts' slices)."""
+        from amgx_tpu.distributed.multihost import (
+            addressable_parts,
+            stack_parts_sharded,
+        )
+
+        A = self.fine
+        offs = np.concatenate([[0], np.cumsum(A.n_owned)]).astype(
+            np.int64
+        )
+        per = {}
+        for p in addressable_parts(self.mesh):
+            buf = np.zeros((A.rows_per_part,), dtype=b.dtype)
+            buf[: A.n_owned[p]] = b[offs[p]: offs[p + 1]]
+            per[p] = buf
+        return stack_parts_sharded(per, self.mesh, A.n_parts)
+
+    def _unpad_vector_sharded(self, x):
+        """Sharded stacked x -> global host vector: each process reads
+        its addressable shards; the parts ride one comm allgather
+        (matched SPMD round on every process)."""
+        A = self.fine
+        loc = {}
+        for s in x.addressable_shards:
+            p = s.index[0].start
+            loc[p] = np.asarray(s.data)[0][: A.n_owned[p]]
+        parts = self.h.comm.allgather(loc, kind="solve-x")
+        return np.concatenate(parts)
+
     def solve(self, b, max_iters=200, tol=1e-8, outer="pcg",
               restart=32):
         """Distributed AMG-preconditioned solve -> (x, iters, nrm).
@@ -937,6 +1142,10 @@ class DistributedAMG:
                 hit = self._build_solve(max_iters, tol)
             self._solve_cache[key] = hit
         fn, lps = hit
+        if _level_is_sharded(self.fine):
+            bp = self._pad_vector_sharded(np.asarray(b))
+            x, it, nrm = fn(lps, self._tail_params_dev, bp)
+            return (self._unpad_vector_sharded(x), int(it), float(nrm))
         bp = jnp.asarray(self.fine.pad_vector(np.asarray(b)))
         x, it, nrm = fn(lps, self._tail_params, bp)
         return (

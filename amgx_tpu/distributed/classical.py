@@ -26,8 +26,11 @@ moves as three targeted exchanges instead of a second structural ring:
     one-ring fine nodes with global coarse columns (reference
     exchange_halo_rows_P) for the Galerkin product.
 
-Interpolation is distance-1 (D1) — row-local given ghost C/F flags and
-coarse ids.  The partial RAP rows for remote coarse points ship to
+Interpolation is D1 (row-local given ghost C/F flags and coarse ids)
+or D2/standard (reference interpolators/distance2.cu — the halo F
+rows' strong-C and sign-restricted F->C data ride one further
+targeted exchange, `_d2_rows_payload`).  The partial RAP rows for
+remote coarse points ship to
 their owners and are sparse-added in part order (exchange_RAP_ext +
 csr_RAP_sparse_add).  Unlike the aggregation path, P couples shards,
 so the solve-side transfers communicate: prolongation does a coarse
@@ -51,7 +54,7 @@ from amgx_tpu.distributed.hierarchy import (
     DistHierarchy,
     DistLevel,
     _finalize_level,
-    _pad_ell_blocks,
+    _stack_level_blocks,
     finish_distributed_hierarchy,
     init_lvl_parts,
     lvl_parts_to_parts,
@@ -347,6 +350,201 @@ def _direct_interpolation_local(
     return P, ucols
 
 
+def _d2_rows_payload(A_o, S_o, li, colinfo_o):
+    """Owner-side D2 payload for requested local rows ``li``: each
+    row's strong->C entries and sign-restricted (all) F->C entries,
+    both in GLOBAL coarse ids (reference exchange of one-ring row
+    structure feeding distance2.cu).  Rows are CSR-packed:
+    (sc_indptr, sc_gc, sc_v, ng_indptr, ng_gc, ng_v)."""
+    cf_col, gc_col = colinfo_o
+    nli = len(li)
+    A_sub = A_o[li].tocsr()
+    S_sub = S_o[li].astype(bool)
+    diag_sub = np.asarray(A_o.diagonal())[li]
+
+    sc = A_sub.multiply(S_sub).tocoo()
+    m = cf_col[sc.col] == 1
+    sc_rows, sc_gc, sc_v = sc.row[m], gc_col[sc.col[m]], sc.data[m]
+    sc_indptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(sc_rows, minlength=nli))]
+    ).astype(np.int64)
+
+    ac = A_sub.tocoo()
+    mm = (cf_col[ac.col] == 1) & (ac.data * diag_sub[ac.row] < 0)
+    ng_rows, ng_gc, ng_v = ac.row[mm], gc_col[ac.col[mm]], ac.data[mm]
+    ng_indptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(ng_rows, minlength=nli))]
+    ).astype(np.int64)
+    return (sc_indptr, sc_gc, sc_v, ng_indptr, ng_gc, ng_v)
+
+
+def _collect_d2_rows(halo_glob, cf_col, rows_pp, lvl_own, answers):
+    """Requester-side reassembly of the D2 payloads: {halo slot ->
+    (gc_ids, vals)} for strong-C rows (d2_sc) and sign-restricted
+    F->C rows (d2_ng) of the part's F halo nodes."""
+    d2_sc, d2_ng = {}, {}
+    if not len(halo_glob):
+        return d2_sc, d2_ng
+    fh_mask = cf_col[rows_pp: rows_pp + len(halo_glob)] == 0
+    fh = halo_glob[fh_mask]
+    if not len(fh):
+        return d2_sc, d2_ng
+    owners = lvl_own.owner_of(fh)
+    for o, (sc_ip, sc_gc, sc_v, ng_ip, ng_gc, ng_v) in (
+        answers.items()
+    ):
+        ids = fh[owners == o]  # request order (fetch_by_owner aligns)
+        for k, g in enumerate(ids):
+            slot = rows_pp + int(
+                np.searchsorted(halo_glob, g)
+            )
+            d2_sc[slot] = (
+                sc_gc[sc_ip[k]: sc_ip[k + 1]],
+                sc_v[sc_ip[k]: sc_ip[k + 1]],
+            )
+            d2_ng[slot] = (
+                ng_gc[ng_ip[k]: ng_ip[k + 1]],
+                ng_v[ng_ip[k]: ng_ip[k + 1]],
+            )
+    return d2_sc, d2_ng
+
+
+def _standard_interpolation_local(
+    A_p, S_p, counts_p, cf_p, cf_col, gc_col, rows_pp,
+    d2_sc, d2_ng, nc_global,
+):
+    """Distance-2 'standard' interpolation of one part's owned rows
+    (reference interpolators/distance2.cu; serial twin
+    amg.classical.standard_interpolation — same formulas, with the
+    rows of off-part strong F neighbours supplied by ``d2_sc``/
+    ``d2_ng`` in global coarse ids):
+
+      w_ij = -( a_ij 1[j in C_i^s] +
+                sum_{k in F_i^s} a_ik a_kj / d_ik ) / ã_ii
+      d_ik = sum_{l in C_i^ext} (A_FC_neg)_kl
+
+    Returns (P compact csr counts_p x len(ucols), ucols global ids).
+    """
+    Ab = A_p.tocsr()
+    nloc = Ab.shape[1]
+    A_str = Ab.multiply(S_p.astype(bool)).tocsr()
+    cmask_col = cf_col == 1
+    fmask = cf_p == 0
+    fidx = np.nonzero(fmask)[0]
+    cidx = np.nonzero(cf_p == 1)[0]
+    nf = len(fidx)
+    if nf == 0:
+        ucols = gc_col[cidx]
+        P = sps.csr_matrix(
+            (np.ones(len(cidx)), (cidx, np.arange(len(cidx)))),
+            shape=(counts_p, max(len(cidx), 1)),
+        )
+        return P, ucols
+    diag = np.asarray(Ab.diagonal())  # owned slot i == owned row i
+
+    # strong rows of F points, split C-slot / F-slot (self excluded)
+    coo = A_str[fidx].tocoo()
+    is_c = cmask_col[coo.col]
+    is_self = coo.col == fidx[coo.row]
+    ff = (~is_c) & (~is_self)
+    fc = is_c
+    SFF = sps.csr_matrix(
+        (coo.data[ff], (coo.row[ff], coo.col[ff])), shape=(nf, nloc)
+    )
+    AsFC = sps.csr_matrix(
+        (coo.data[fc], (coo.row[fc], gc_col[coo.col[fc]])),
+        shape=(nf, nc_global),
+    )
+    AsFC.sum_duplicates()
+
+    # NEG / SC rows per local slot: owned slots from the local block,
+    # halo slots from the fetched payloads
+    ac = Ab.tocoo()
+    negm = cmask_col[ac.col] & (ac.data * diag[ac.row] < 0)
+    neg_r = [ac.row[negm]]
+    neg_c = [gc_col[ac.col[negm]]]
+    neg_v = [ac.data[negm]]
+    st = A_str.tocoo()
+    scm = cmask_col[st.col]
+    sc_r = [st.row[scm]]
+    sc_c = [gc_col[st.col[scm]]]
+    sc_v = [st.data[scm]]
+    for slot, (g, v) in d2_ng.items():
+        neg_r.append(np.full(len(g), slot, dtype=np.int64))
+        neg_c.append(np.asarray(g, dtype=np.int64))
+        neg_v.append(np.asarray(v))
+    for slot, (g, v) in d2_sc.items():
+        sc_r.append(np.full(len(g), slot, dtype=np.int64))
+        sc_c.append(np.asarray(g, dtype=np.int64))
+        sc_v.append(np.asarray(v))
+    NEG = sps.csr_matrix(
+        (
+            np.concatenate(neg_v),
+            (np.concatenate(neg_r), np.concatenate(neg_c)),
+        ),
+        shape=(nloc, nc_global),
+    )
+    SC = sps.csr_matrix(
+        (
+            np.concatenate(sc_v),
+            (np.concatenate(sc_r), np.concatenate(sc_c)),
+        ),
+        shape=(nloc, nc_global),
+    )
+
+    # extended pattern T_i = C_i^s  ∪  ∪_{k in F_i^s} C_k^s
+    SFFb = (SFF != 0).astype(np.float64)
+    T = (
+        ((AsFC != 0).astype(np.float64) + SFFb @ (SC != 0)) != 0
+    ).astype(np.float64).tocsr()
+
+    # denominators d_ik on the strong F-F edges
+    E = (T @ NEG.T).tocsr()  # nf x nloc
+    sff = SFF.tocoo()
+    if sff.nnz:
+        d_vals = np.asarray(E[sff.row, sff.col]).ravel()
+        with np.errstate(divide="ignore", invalid="ignore"):
+            b_vals = np.where(d_vals != 0, sff.data / d_vals, 0.0)
+        B = sps.csr_matrix(
+            (b_vals, (sff.row, sff.col)), shape=(nf, nloc)
+        )
+    else:
+        d_vals = np.zeros(0)
+        B = sps.csr_matrix((nf, nloc))
+
+    Wnum = (AsFC + B @ NEG).multiply(T).tocsr()
+
+    # modified diagonal ã_ii = a_ii + weak row sum + undistributable
+    row_total = (
+        np.asarray(Ab.sum(axis=1)).ravel()[fidx] - diag[fidx]
+    )
+    strong_sum = np.bincount(
+        coo.row[ff | fc], weights=coo.data[ff | fc], minlength=nf
+    )
+    weak_sum = row_total - strong_sum
+    undistributable = np.bincount(
+        sff.row, weights=np.where(d_vals == 0, sff.data, 0.0),
+        minlength=nf,
+    ) if sff.nnz else np.zeros(nf)
+    atil = diag[fidx] + weak_sum + undistributable
+    atil = np.where(atil != 0, atil, 1.0)
+    Wnum = sps.diags_array(-1.0 / atil) @ Wnum
+
+    # assemble compact P over the union of used global coarse ids
+    Wcoo = Wnum.tocoo()
+    gcols_all = np.concatenate([Wcoo.col, gc_col[cidx]])
+    ucols = np.unique(gcols_all)
+    rows = np.concatenate([fidx[Wcoo.row], cidx])
+    cols = np.searchsorted(ucols, gcols_all)
+    vals = np.concatenate([Wcoo.data, np.ones(len(cidx))])
+    P = sps.csr_matrix(
+        (vals, (rows, cols)), shape=(counts_p, max(len(ucols), 1))
+    )
+    P.sum_duplicates()
+    P.sort_indices()
+    return P, ucols
+
+
 def build_distributed_classical_hierarchy_local(
     local_parts: Dict[int, dict],
     ownership: Ownership,
@@ -356,6 +554,7 @@ def build_distributed_classical_hierarchy_local(
     max_levels: int = 20,
     consolidate_rows: int = 4096,
     proc_grid=None,
+    mesh=None,
 ) -> DistHierarchy:
     """Distributed classical-AMG setup loop from per-process blocks
     (reference setup_v2 + classical_amg_level.cu distributed flow)."""
@@ -376,12 +575,13 @@ def build_distributed_classical_hierarchy_local(
     trunc = float(cfg.get("interp_truncation_factor", scope))
     max_el = int(cfg.get("interp_max_elements", scope))
     interp = str(cfg.get("interpolator", scope)).upper()
-    if interp not in ("D1",):
+    use_d2 = interp in ("D2", "STD", "STANDARD")
+    if interp not in ("D1",) and not use_d2:
         import warnings
 
         warnings.warn(
             f"distributed classical interpolator {interp}: using D1 "
-            "(distance-1 is the distributed roster)"
+            "(D1 and D2/standard are the distributed roster)"
         )
 
     lvl_parts = init_lvl_parts(local_parts, ownership, my_parts)
@@ -452,8 +652,8 @@ def build_distributed_classical_hierarchy_local(
             kind="halo-cf",
         )
 
-        # ---- D1 interpolation of owned rows ------------------------
-        P_parts = {}  # p -> (P csr compact, global coarse col ids)
+        # ---- per-part local column info (cf / coarse id per slot) --
+        colinfo = {}
         for p in my_parts:
             nloc = lvl_parts[p]["A"].shape[1]
             cf_col = np.zeros(nloc, dtype=np.int8)
@@ -471,10 +671,58 @@ def build_distributed_classical_hierarchy_local(
                     gch[m] = v[1]
                 cf_col[rows_pp: rows_pp + len(hg)] = cfh
                 gc_col[rows_pp: rows_pp + len(hg)] = gch
-            P, ucols = _direct_interpolation_local(
-                lvl_parts[p]["A"], S_parts[p], int(counts[p]),
-                cf[p], cf_col, gc_col,
+            colinfo[p] = (cf_col, gc_col)
+
+        # ---- D2: fetch halo F rows' strong-C and sign-restricted
+        # F->C data in GLOBAL coarse ids (the second-ring structural
+        # content of reference distance2.cu, ridden as one targeted
+        # exchange instead of a second halo ring) -------------------
+        halo_d2 = {}
+        if use_d2:
+            reqs2 = {}
+            for p in my_parts:
+                hg = lvl_parts[p]["halo_glob"]
+                if not len(hg):
+                    continue
+                cf_col, _gc = colinfo[p]
+                fh = hg[cf_col[rows_pp: rows_pp + len(hg)] == 0]
+                if not len(fh):
+                    continue
+                owners = lvl_own.owner_of(fh)
+                reqs2[p] = {
+                    int(o): fh[owners == o] for o in np.unique(owners)
+                }
+
+            def d2_payload(o, ids):
+                return _d2_rows_payload(
+                    lvl_parts[o]["A"], S_parts[o],
+                    lvl_own.local_of_ids(ids), colinfo[o],
+                )
+
+            halo_d2 = fetch_by_owner(
+                comm, reqs2, d2_payload, kind="halo-d2rows"
             )
+
+        # ---- interpolation of owned rows ---------------------------
+        P_parts = {}  # p -> (P csr compact, global coarse col ids)
+        for p in my_parts:
+            cf_col, gc_col = colinfo[p]
+            if use_d2:
+                hg = lvl_parts[p]["halo_glob"]
+                d2_sc, d2_ng = _collect_d2_rows(
+                    hg, cf_col, rows_pp, lvl_own,
+                    halo_d2.get(p, {}),
+                )
+                P, ucols = _standard_interpolation_local(
+                    lvl_parts[p]["A"], S_parts[p], int(counts[p]),
+                    cf[p], cf_col, gc_col, rows_pp,
+                    d2_sc, d2_ng, nc_global,
+                )
+            else:
+                P, ucols = _direct_interpolation_local(
+                    lvl_parts[p]["A"], S_parts[p], int(counts[p]),
+                    cf[p], cf_col, gc_col,
+                )
             if trunc < 1.0 or max_el >= 0:
                 P = truncate_interp(P, trunc, max_el)
             P_parts[p] = (P.tocsr(), ucols)
@@ -655,8 +903,9 @@ def build_distributed_classical_hierarchy_local(
         A_dev = _finalize_level(
             lvl_parts_to_parts(lvl_parts), lvl_own, comm,
             proc_grid=proc_grid if len(levels) == 0 else None,
+            mesh=mesh,
         )
-        P_local = []
+        P_local = {}
         for p in sorted(my_parts):
             P_own, ucols_own = P_parts[p]
             halo_c = p_halo_cache[p]
@@ -671,16 +920,16 @@ def build_distributed_classical_hierarchy_local(
                 halo_c, ucols_own[~owned_m]
             )
             coo = P_own.tocoo()
-            P_local.append(
-                sps.csr_matrix(
-                    (coo.data, (coo.row, slot[coo.col])),
-                    shape=(
-                        int(counts[p]),
-                        rows_pp_c + len(halo_c),
-                    ),
-                )
+            P_local[p] = sps.csr_matrix(
+                (coo.data, (coo.row, slot[coo.col])),
+                shape=(
+                    int(counts[p]),
+                    rows_pp_c + len(halo_c),
+                ),
             )
-        P_cols, P_vals = _pad_ell_blocks(P_local, rows_pp)
+        P_cols, P_vals = _stack_level_blocks(
+            P_local, rows_pp, comm, mesh
+        )
         levels.append(
             DistLevel(
                 A=A_dev, P_cols=P_cols, P_vals=P_vals,
@@ -696,7 +945,7 @@ def build_distributed_classical_hierarchy_local(
     # aggregation builder
     return finish_distributed_hierarchy(
         lvl_parts, lvl_own, comm, levels, proc_grid,
-        max_part_nnz, max_part_rows, my_parts,
+        max_part_nnz, max_part_rows, my_parts, mesh=mesh,
     )
 
 

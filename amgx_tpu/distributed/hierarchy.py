@@ -117,6 +117,10 @@ class DistHierarchy:
     # per-process setup accounting: comm traffic + peak per-part sizes
     # (the O(global/N) memory-contract evidence)
     setup_stats: Optional[dict] = None
+    # the setup comm fabric, kept for post-setup collective rounds
+    # (smoother-metadata consensus, solution gather) — every process
+    # must keep issuing matched rounds (SPMD)
+    comm: Any = None
 
 
 def _local_aggregate(A_pp: sps.csr_matrix, cfg, scope) -> np.ndarray:
@@ -127,26 +131,53 @@ def _local_aggregate(A_pp: sps.csr_matrix, cfg, scope) -> np.ndarray:
     return select_aggregates(A_pp, cfg, scope)[0]
 
 
-def _pad_ell_blocks(mats, rows_pad):
-    """Stack per-shard CSR blocks as padded ELL [N, rows_pad, w]."""
-    n_parts = len(mats)
-    w = 1
-    for m in mats:
-        lens = np.diff(m.indptr)
-        if lens.size:
-            w = max(w, int(lens.max()))
-    dtype = mats[0].dtype if mats else np.float64
-    cols = np.zeros((n_parts, rows_pad, w), dtype=np.int32)
-    vals = np.zeros((n_parts, rows_pad, w), dtype=dtype)
-    for p, m in enumerate(mats):
+def _stack_level_blocks(blocks_by_p, rows_pad, comm, mesh=None):
+    """Stack per-part CSR transfer blocks (P/R) as padded ELL
+    [N, rows_pad, w].
+
+    The ELL width w is a comm-wide consensus (one scalar allgather) so
+    every process traces identical static shapes.  All parts local ->
+    stacked numpy; subset of parts -> per-part ``jax.Array``s sharded
+    over ``mesh`` (the per-rank assembly shape).
+    """
+    n_parts = comm.n_parts
+    meta = comm.allgather(
+        {
+            p: (int(np.diff(m.indptr).max(initial=0)),
+                np.dtype(m.dtype).str)
+            for p, m in blocks_by_p.items()
+        },
+        kind="transfer-width",
+    )
+    w = max(max(m[0] for m in meta), 1)
+    dtype = np.dtype(meta[0][1])
+    per = {}
+    for p, m in blocks_by_p.items():
+        cols = np.zeros((rows_pad, w), dtype=np.int32)
+        vals = np.zeros((rows_pad, w), dtype=dtype)
         lens = np.diff(m.indptr)
         rid = np.repeat(np.arange(m.shape[0]), lens)
         pos = np.arange(m.indices.shape[0]) - m.indptr[rid].astype(
             np.int64
         )
-        cols[p, rid, pos] = m.indices
-        vals[p, rid, pos] = m.data
-    return cols, vals
+        cols[rid, pos] = m.indices
+        vals[rid, pos] = m.data
+        per[p] = (cols, vals)
+    if len(blocks_by_p) == n_parts:
+        return (
+            np.stack([per[p][0] for p in range(n_parts)]),
+            np.stack([per[p][1] for p in range(n_parts)]),
+        )
+    from amgx_tpu.distributed.multihost import stack_parts_sharded
+
+    return (
+        stack_parts_sharded(
+            {p: c for p, (c, _) in per.items()}, mesh, n_parts
+        ),
+        stack_parts_sharded(
+            {p: v for p, (_, v) in per.items()}, mesh, n_parts
+        ),
+    )
 
 
 def _grade_groups(ncs, grade_lower):
@@ -210,22 +241,31 @@ def _finalize_level(
     own: Ownership,
     comm: LoopbackComm,
     proc_grid=None,
+    mesh=None,
 ) -> DistributedMatrix:
     """Exchange plan + stacked device arrays for one level.
 
     Single-process (Loopback): every part is local, so the stacked
-    [N, rows, w] numpy arrays are assembled directly.  The exchange
-    plan itself is built from O(boundary) halo-id lists only — the
-    multi-process device assembly (sharded jax.Arrays, one part per
-    addressable device, multihost.sharded_partition's stack shape)
-    plugs in here without touching the setup logic above it.
+    [N, rows, w] numpy arrays are assembled directly.  Multi-process
+    (this process drives a subset of parts): each process assembles
+    per-part ``jax.Array``s for its own parts only, sharded one part
+    per device of ``mesh`` — the reference's per-rank level assembly
+    (amg.cu:425-660 setup_v2 builds every coarse level per rank).
     """
     n_parts = own.n_parts
     if len(parts_by_p) != n_parts:
-        raise NotImplementedError(
-            "multi-process device assembly of hierarchy levels is not "
-            "wired yet: drive all parts from one process (Loopback) "
-            "or assemble via multihost.sharded_partition"
+        if mesh is None:
+            raise ValueError(
+                "process drives a subset of parts but no mesh was "
+                "supplied for sharded device assembly (pass mesh= "
+                "through the builder / DistributedAMG.from_local_parts)"
+            )
+        from amgx_tpu.distributed.multihost import (
+            assemble_level_sharded,
+        )
+
+        return assemble_level_sharded(
+            parts_by_p, own, comm, mesh, proc_grid=proc_grid
         )
     parts = [parts_by_p[p] for p in range(n_parts)]
     dm = finalize_partition(
@@ -267,7 +307,7 @@ def init_lvl_parts(local_parts, ownership: Ownership, my_parts):
 
 def finish_distributed_hierarchy(
     lvl_parts, lvl_own: Ownership, comm, levels, proc_grid,
-    max_part_nnz: int, max_part_rows: int, my_parts,
+    max_part_nnz: int, max_part_rows: int, my_parts, mesh=None,
 ) -> DistHierarchy:
     """Shared tail of both distributed builders: finalize the deepest
     level (materializing its small owner maps for the cycle's
@@ -279,6 +319,7 @@ def finish_distributed_hierarchy(
     A_last = _finalize_level(
         lvl_parts_to_parts(lvl_parts), lvl_own, comm,
         proc_grid=proc_grid if not levels else None,
+        mesh=mesh,
     )
     owner_L, local_L = lvl_own.materialize()
     A_last.owner = owner_L
@@ -327,6 +368,7 @@ def finish_distributed_hierarchy(
         tail_owner=owner_L,
         tail_local_of=local_L,
         setup_stats=stats,
+        comm=comm,
     )
 
 
@@ -340,6 +382,7 @@ def build_distributed_hierarchy_local(
     consolidate_rows: int = _CONSOLIDATE_ROWS,
     grade_lower: int = _GRADE_LOWER,
     proc_grid=None,
+    mesh=None,
 ) -> DistHierarchy:
     """The distributed setup loop from per-process local blocks
     (reference per-rank setup_v2, amg.cu:425-660).
@@ -538,11 +581,15 @@ def build_distributed_hierarchy_local(
         A_dev = _finalize_level(
             lvl_parts_to_parts(lvl_parts), lvl_own, comm,
             proc_grid=proc_grid if len(levels) == 0 else None,
+            mesh=mesh,
         )
-        P_list = [P_blocks[p] for p in sorted(P_blocks)]
-        P_cols, P_vals = _pad_ell_blocks(P_list, rows_pp)
-        R_list = [P_blocks[p].T.tocsr() for p in sorted(P_blocks)]
-        R_cols, R_vals = _pad_ell_blocks(R_list, rows_pp_c)
+        P_cols, P_vals = _stack_level_blocks(
+            P_blocks, rows_pp, comm, mesh
+        )
+        R_blocks = {p: P_blocks[p].T.tocsr() for p in P_blocks}
+        R_cols, R_vals = _stack_level_blocks(
+            R_blocks, rows_pp_c, comm, mesh
+        )
         levels.append(
             DistLevel(
                 A=A_dev, P_cols=P_cols, P_vals=P_vals,
@@ -555,7 +602,7 @@ def build_distributed_hierarchy_local(
 
     return finish_distributed_hierarchy(
         lvl_parts, lvl_own, comm, levels, proc_grid,
-        max_part_nnz, max_part_rows, my_parts,
+        max_part_nnz, max_part_rows, my_parts, mesh=mesh,
     )
 
 

@@ -173,24 +173,6 @@ def partition_from_local_parts(
     )
 
 
-def _offset_lookups(part_offsets):
-    """(owner_fn, local_fn) computing ownership analytically from the
-    partition offsets — O(1) state, no global-length arrays (the point
-    of the multi-host path)."""
-    part_offsets = np.asarray(part_offsets, dtype=np.int64)
-
-    def owner_fn(ids):
-        return (
-            np.searchsorted(part_offsets, np.asarray(ids), side="right")
-            - 1
-        ).astype(np.int32)
-
-    def local_fn(ids):
-        ids = np.asarray(ids, dtype=np.int64)
-        return (ids - part_offsets[owner_fn(ids)]).astype(np.int32)
-
-    return owner_fn, local_fn
-
 
 def sharded_partition(
     local_parts: dict,
@@ -213,17 +195,21 @@ def sharded_partition(
     (distributed_manager.cu loadDistributedMatrix*) where each rank
     uploads only its block and halo plumbing is exchanged
     (distributed_arranger.h create_B2L et al.).
+
+    Thin wrapper over :func:`assemble_level_sharded` (the same
+    assembly serves every hierarchy level): validates the fine-level
+    contract (uniform contiguous blocks, consistent rows_pp) and
+    builds the comm fabric matching the mesh placement.
     """
     import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from amgx_tpu.distributed.comm import AllgatherComm, LoopbackComm
+    from amgx_tpu.distributed.partition import OffsetOwnership
 
     part_offsets = np.asarray(part_offsets, dtype=np.int64)
     n_parts = part_offsets.shape[0] - 1
-    n = int(part_offsets[-1])
     counts = (part_offsets[1:] - part_offsets[:-1]).astype(np.int64)
     rows_pp = int(counts.max())
-    axis = mesh.axis_names[0]
     devices = mesh.devices.reshape(-1)
     if len(devices) != n_parts:
         raise ValueError(
@@ -243,83 +229,197 @@ def sharded_partition(
                 f"part {p} localized with rows_pp={got}, assembly "
                 f"expects {rows_pp}: halo column ids would be wrong"
             )
-
-    # ---- allgather the per-part metadata (halo ids, ELL width) ------
-    # O(boundary) ints per part; everything downstream of this point is
-    # process-replicated plan state.
-    local_meta = {
-        p: dict(
-            halo_glob=np.asarray(part["halo_glob"], dtype=np.int64),
-            w=int(np.diff(part["indptr"]).max(initial=0)),
-            dtype=np.dtype(part["vals"].dtype).str,
-        )
-        for p, part in local_parts.items()
-    }
-    meta = _allgather_part_meta(local_meta, n_parts)
-
-    owner_fn, local_fn = _offset_lookups(part_offsets)
-    dm, fb = build_exchange_plan(
-        [meta[p]["halo_glob"] for p in range(n_parts)],
-        owner_fn,
-        local_fn,
-        n_parts,
+    if jax.process_count() > 1:
+        comm = AllgatherComm(n_parts, sorted(local_parts))
+    else:
+        comm = LoopbackComm(n_parts)
+    return assemble_level_sharded(
+        local_parts, OffsetOwnership(part_offsets), comm, mesh,
+        proc_grid=proc_grid,
     )
 
-    # ---- per-part device arrays, stacked as sharded jax.Arrays ------
+
+def _part_boundary_count(part, count_p, rows_pp) -> int:
+    """Number of owned rows referencing halo columns (>= rows_pp) in
+    one localized part dict."""
+    indptr = np.asarray(part["indptr"])
+    cols = np.asarray(part["cols"])
+    if cols.size == 0:
+        return 0
+    lens = np.diff(indptr)[:count_p]
+    rid = np.repeat(np.arange(count_p), lens)
+    hal = cols[: int(indptr[count_p])] >= rows_pp
+    return int(np.unique(rid[hal]).size)
+
+
+def stack_parts_sharded(
+    per_part: dict, mesh, n_parts, dtype=None, shape=None
+):
+    """Stack per-part arrays into one [n_parts, ...] ``jax.Array``
+    sharded one part per device of ``mesh``'s flattened device list.
+
+    ``per_part[p]`` must be supplied for exactly the parts whose mesh
+    device is addressable from this process (jax.Array invariant:
+    every addressable shard needs a leaf).  All parts must share one
+    shape+dtype; a process never materializes another part's data —
+    the per-process memory stays O(global / n_processes).  A process
+    addressing no parts passes an empty dict with explicit
+    ``shape``+``dtype`` (the global array metadata must still agree).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devices = mesh.devices.reshape(-1)
+    axis = mesh.axis_names[0]
+    if per_part:
+        some = np.asarray(next(iter(per_part.values())))
+        if shape is None:
+            shape = some.shape
+        if dtype is None:
+            dtype = some.dtype
+    elif shape is None or dtype is None:
+        raise ValueError(
+            "a process holding no parts must pass explicit shape and "
+            "dtype so the global array metadata agrees across processes"
+        )
+    leaves = [
+        jax.device_put(
+            np.ascontiguousarray(np.asarray(per_part[p]))[None],
+            devices[p],
+        )
+        for p in sorted(per_part)
+    ]
+    return jax.make_array_from_single_device_arrays(
+        (n_parts,) + tuple(shape),
+        NamedSharding(mesh, P(axis)),
+        leaves,
+        dtype=np.dtype(dtype),
+    )
+
+
+def addressable_parts(mesh) -> list:
+    """Part indices whose mesh device is addressable by this process
+    (part p <-> flattened mesh device p — the assembly convention)."""
+    import jax
+
+    pid = jax.process_index()
+    return [
+        p
+        for p, d in enumerate(mesh.devices.reshape(-1))
+        if d.process_index == pid
+    ]
+
+
+def assemble_level_sharded(
+    parts_by_p: dict, own, comm, mesh, proc_grid=None
+):
+    """Multi-process device assembly of ONE hierarchy level.
+
+    The per-rank analogue of ``finalize_partition`` (reference: each
+    rank assembles only its own level-matrix block, amg.cu:425-660
+    setup_v2 + distributed_manager.cu reorder/B2L plumbing): this
+    process materializes device arrays for ``parts_by_p``'s parts
+    only; the exchange plan is built replicated from the allgathered
+    O(boundary) halo-id lists riding the setup ``comm`` fabric.  Every
+    stacked field of the returned :class:`DistributedMatrix` is a
+    ``jax.Array`` sharded one part per mesh device — drop-in for the
+    shard_map solve path.
+
+    Bit-parity contract: per-part blocks are produced by the same
+    ``part_ell_arrays`` / ``part_interior_windowed`` helpers as the
+    single-process path, so shard p's slice equals the Loopback
+    build's ``ell_*[p]`` exactly (asserted by the multiprocess test).
+    """
+    import jax
+
     from amgx_tpu.distributed.partition import (
         part_ell_arrays,
         part_interior_windowed,
         tiled_ell_wanted,
     )
 
-    w = max(max(meta[p]["w"] for p in range(n_parts)), 1)
-    # dtype from the gathered meta so a process owning no parts (all
-    # its mesh devices remote) still agrees on array dtypes
-    dtype = np.dtype(meta[0]["dtype"])
-
-    per_dev = {}
-    for p, part in local_parts.items():
-        ec, ev, dg = part_ell_arrays(part, rows_pp, w, dtype)
-        is_bnd = (ec >= rows_pp).any(axis=1)
-        own = np.zeros(rows_pp, dtype=bool)
-        own[: counts[p]] = True
-        per_dev[p] = dict(
-            ell_cols=ec, ell_vals=ev, diag=dg,
-            own_mask=own, int_mask=own & ~is_bnd,
+    if not own.offset_blocks:
+        raise ValueError(
+            "sharded level assembly needs analytic offset-block "
+            "ownership (OffsetOwnership); arbitrary partition vectors "
+            "must assemble single-process"
+        )
+    n_parts = own.n_parts
+    counts = np.asarray(own.counts, dtype=np.int64)
+    rows_pp = max(int(counts.max()), 1)
+    mine = addressable_parts(mesh)
+    if sorted(parts_by_p) != mine:
+        raise ValueError(
+            f"process drives parts {sorted(parts_by_p)} but addresses "
+            f"mesh devices of parts {mine}: the comm striping must "
+            "match the mesh placement (one part per mesh device)"
         )
 
-    # ---- Pallas windowed tiling of the interior rows (TPU) ----------
-    # built per local part; the static window width W must agree across
-    # shards, so the per-part widths ride a second (scalar) allgather.
+    # ---- replicated plan from allgathered O(boundary) metadata ------
+    local_meta = {
+        p: dict(
+            halo_glob=np.asarray(part["halo_glob"], dtype=np.int64),
+            w=int(np.diff(part["indptr"]).max(initial=0)),
+            dtype=np.dtype(part["vals"].dtype).str,
+            nb=int(_part_boundary_count(part, counts[p], rows_pp)),
+        )
+        for p, part in parts_by_p.items()
+    }
+    meta = comm.allgather(local_meta, kind="level-meta")
+    dm_plan, fb = build_exchange_plan(
+        [meta[p]["halo_glob"] for p in range(n_parts)],
+        own.owner_of,
+        own.local_of_ids,
+        n_parts,
+    )
+    w = max(max(meta[p]["w"] for p in range(n_parts)), 1)
+    max_nb = max(meta[p]["nb"] for p in range(n_parts))
+    dtype = np.dtype(meta[0]["dtype"])
+
+    from amgx_tpu.distributed.partition import pack_boundary_rows
+
+    # ---- per-part device blocks (same helpers as single-process) ----
+    per_dev = {}
+    for p, part in parts_by_p.items():
+        ec, ev, dg = part_ell_arrays(part, rows_pp, w, dtype)
+        is_bnd = (ec >= rows_pp).any(axis=1)
+        own_m = np.zeros(rows_pp, dtype=bool)
+        own_m[: counts[p]] = True
+        per_dev[p] = dict(
+            ell_cols=ec, ell_vals=ev, diag=dg,
+            own_mask=own_m, int_mask=own_m & ~is_bnd,
+            bnd_rows=pack_boundary_rows(
+                [np.nonzero(own_m & is_bnd)[0]], rows_pp, max_nb
+            )[0],
+        )
+
+    # windowed interior tiling: static width W must agree across
+    # shards, so the local widths ride one scalar allgather
     wwidth = None
     if tiled_ell_wanted(dtype):
-        for p, part in local_parts.items():
-            built = part_interior_windowed(
+        for p, part in parts_by_p.items():
+            per_dev[p]["wtile"] = part_interior_windowed(
                 part, per_dev[p]["ell_cols"], per_dev[p]["ell_vals"],
                 per_dev[p]["int_mask"], rows_pp, counts[p],
             )
-            per_dev[p]["wtile"] = built
-        wmeta = _allgather_part_meta(
+        widths = comm.allgather(
             {
-                p: dict(W=-1 if per_dev[p]["wtile"] is None
-                        else per_dev[p]["wtile"][3])
-                for p in local_parts
+                p: (-1 if per_dev[p]["wtile"] is None
+                    else int(per_dev[p]["wtile"][3]))
+                for p in parts_by_p
             },
-            n_parts,
+            kind="wtile-width",
         )
-        widths = [wmeta[p]["W"] for p in range(n_parts)]
         if all(W >= 0 for W in widths):
             wwidth = int(max(widths))
-            for p in local_parts:
+            for p in parts_by_p:
                 tc, tv, bs, _ = per_dev[p]["wtile"]
                 per_dev[p]["ell_wcols"] = tc
                 per_dev[p]["ell_wvals"] = tv
                 per_dev[p]["ell_wbase"] = bs
 
-    # global shapes/dtypes derived WITHOUT local leaves: a process whose
-    # addressable mesh devices own no parts passes an empty leaf list
-    # (make_array_from_single_device_arrays accepts it with an explicit
-    # dtype) and still constructs the same global arrays.
+    # explicit shapes/dtypes so a process holding no parts (its mesh
+    # devices all remote) still constructs agreeing global arrays
     from amgx_tpu.ops.pallas_well import _LANE, _ROW_TILE, _SUB
 
     nt = -(-rows_pp // _ROW_TILE)
@@ -329,24 +429,31 @@ def sharded_partition(
         "diag": ((rows_pp,), dtype),
         "own_mask": ((rows_pp,), np.bool_),
         "int_mask": ((rows_pp,), np.bool_),
+        "bnd_rows": ((max(max_nb, 1),), np.int32),
         "ell_wcols": ((nt, _SUB, w * _LANE), np.int32),
         "ell_wvals": ((nt, _SUB, w * _LANE), dtype),
         "ell_wbase": ((nt,), np.int32),
     }
 
     def stack(key):
-        shp, dt = spec[key]
-        leaves = [
-            jax.device_put(per_dev[p][key][None], devices[p])
-            for p in sorted(per_dev)
-        ]
-        return jax.make_array_from_single_device_arrays(
-            (n_parts,) + shp, NamedSharding(mesh, P(axis)), leaves,
-            dtype=np.dtype(dt),
+        shp_dt = spec.get(key)
+        return stack_parts_sharded(
+            {p: per_dev[p][key] for p in per_dev}, mesh, n_parts,
+            shape=None if shp_dt is None else shp_dt[0],
+            dtype=None if shp_dt is None else shp_dt[1],
+        )
+
+    # plan arrays are replicated numpy [N, ...]; ship each part's row
+    # to its device so the traced lps pytree is fully sharded
+    def stack_plan(arr):
+        arr = np.asarray(arr)
+        return stack_parts_sharded(
+            {p: arr[p] for p in per_dev}, mesh, n_parts,
+            shape=arr.shape[1:], dtype=arr.dtype,
         )
 
     return DistributedMatrix(
-        n_global=n,
+        n_global=int(own.n_global),
         n_parts=n_parts,
         rows_per_part=rows_pp,
         ell_cols=stack("ell_cols"),
@@ -354,22 +461,27 @@ def sharded_partition(
         diag=stack("diag"),
         int_mask=stack("int_mask"),
         own_mask=stack("own_mask"),
+        bnd_rows=stack("bnd_rows"),
         ell_wcols=None if wwidth is None else stack("ell_wcols"),
         ell_wvals=None if wwidth is None else stack("ell_wvals"),
         ell_wbase=None if wwidth is None else stack("ell_wbase"),
         ell_wwidth=wwidth,
-        perms=None if dm is None else dm["perms"],
-        send_idx_d=None if dm is None else dm["send_idx_d"],
-        halo_dir=None if dm is None else dm["halo_dir"],
-        halo_pos=None if dm is None else dm["halo_pos"],
-        send_idx=fb["send_idx"],
-        halo_src_part=fb["halo_src_part"],
-        halo_src_pos=fb["halo_src_pos"],
+        perms=None if dm_plan is None else dm_plan["perms"],
+        send_idx_d=(
+            None if dm_plan is None
+            else tuple(stack_plan(s) for s in dm_plan["send_idx_d"])
+        ),
+        halo_dir=(
+            None if dm_plan is None else stack_plan(dm_plan["halo_dir"])
+        ),
+        halo_pos=(
+            None if dm_plan is None else stack_plan(dm_plan["halo_pos"])
+        ),
+        send_idx=stack_plan(fb["send_idx"]),
+        halo_src_part=stack_plan(fb["halo_src_part"]),
+        halo_src_pos=stack_plan(fb["halo_src_pos"]),
         max_send=fb["max_send"],
         max_halo=fb["max_halo"],
-        # owner/local_of stay None (the owner=None pad/unpad layout
-        # assumes uniform contiguous blocks — validated here; carrying
-        # the O(N) arrays would defeat the per-process memory bound)
         owner=None,
         local_of=None,
         n_owned=counts.astype(np.int32),
@@ -386,45 +498,3 @@ def _uniform_blocks(part_offsets, rows_pp) -> bool:
     return bool(np.array_equal(po, expect))
 
 
-def _allgather_part_meta(local_meta: dict, n_parts: int) -> list:
-    """Exchange per-part metadata dicts across processes.
-
-    Single-process (all parts local): a passthrough.  Multi-process:
-    rides ``jax.experimental.multihost_utils.broadcast_one_to_all``-
-    style process allgather of the pickled lists — O(boundary) bytes.
-    """
-    import jax
-
-    if jax.process_count() == 1:
-        missing = [p for p in range(n_parts) if p not in local_meta]
-        if missing:
-            raise ValueError(
-                f"single-process assembly needs all {n_parts} parts; "
-                f"missing {missing}"
-            )
-        return [local_meta[p] for p in range(n_parts)]
-    # multi-process: EVERY process enters the collective, parts or not
-    # (a process whose addressable mesh devices own no parts still
-    # participates with an empty payload)
-    import pickle
-
-    from jax.experimental import multihost_utils
-
-    payload = np.frombuffer(
-        pickle.dumps({p: m for p, m in local_meta.items()}),
-        dtype=np.uint8,
-    )
-    # pad to the max payload size (allgather needs uniform shapes)
-    sizes = multihost_utils.process_allgather(
-        np.array([payload.size], dtype=np.int64)
-    ).reshape(-1)
-    buf = np.zeros(int(sizes.max()), dtype=np.uint8)
-    buf[: payload.size] = payload
-    gathered = multihost_utils.process_allgather(buf)
-    meta: dict = {}
-    for row, size in zip(np.asarray(gathered), sizes):
-        meta.update(pickle.loads(np.asarray(row)[: int(size)].tobytes()))
-    missing = [p for p in range(n_parts) if p not in meta]
-    if missing:
-        raise ValueError(f"no process supplied parts {missing}")
-    return [meta[p] for p in range(n_parts)]

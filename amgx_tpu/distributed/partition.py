@@ -70,6 +70,14 @@ class DistributedMatrix:
     # to the shared ELL arrays (no second operator copy, no scatter).
     int_mask: Optional[np.ndarray] = None  # [N, rows] bool
     own_mask: Optional[np.ndarray] = None  # [N, rows] bool (non-pad)
+    # compacted boundary row list [N, max_nb] (pad -> rows, the spill
+    # slot): the boundary pass gathers/computes/scatter-adds ONLY these
+    # O(surface) rows, which (a) avoids the masked full-size second
+    # pass and (b) keeps the interior partial product in a fusion with
+    # NO dependence on the halo permutes, so XLA's latency-hiding
+    # scheduler can overlap it with the exchange
+    # (ci/check_overlap_hlo.py asserts this on the compiled HLO)
+    bnd_rows: Optional[np.ndarray] = None  # [N, max_nb] int32
     # windowed-tiled ELL arrays of the INTERIOR rows (ops.pallas_well
     # layout, stacked on the shard axis): the interior pass reads only
     # x_loc, so on TPU it rides the Pallas windowed kernel while the
@@ -118,6 +126,19 @@ class DistributedMatrix:
                 [vp[p, : self.n_owned[p]] for p in range(self.n_parts)]
             )
         return vp[self.owner, self.local_of]
+
+
+def pack_boundary_rows(rows_by_part, rows_pp, max_nb=None):
+    """Stack per-part boundary-row index lists as [N, max_nb] int32,
+    padding with the spill slot ``rows_pp`` (the boundary scatter-add
+    targets a length rows_pp+1 buffer whose last slot is discarded)."""
+    if max_nb is None:
+        max_nb = max((len(r) for r in rows_by_part), default=0)
+    max_nb = max(int(max_nb), 1)
+    out = np.full((len(rows_by_part), max_nb), rows_pp, dtype=np.int32)
+    for p, r in enumerate(rows_by_part):
+        out[p, : len(r)] = r
+    return out
 
 
 def part_interior_windowed(
@@ -673,13 +694,18 @@ def finalize_partition(
 
     # ---- interior/boundary split masks (latency hiding) -------------
     # rows whose every stored column is local (< rows_pp) are interior
-    int_mask = own_mask = None
+    int_mask = own_mask = bnd_rows = None
     if split:
         is_bnd = (ell_cols >= rows_pp).any(axis=2)  # [N, rows]
         own_mask = np.zeros((n_parts, rows_pp), dtype=bool)
         for p in range(n_parts):
             own_mask[p, : counts[p]] = True
         int_mask = own_mask & ~is_bnd
+        bnd_rows = pack_boundary_rows(
+            [np.nonzero(own_mask[p] & is_bnd[p])[0]
+             for p in range(n_parts)],
+            rows_pp,
+        )
 
     # ---- Pallas windowed tiling of the interior rows (TPU) ----------
     wcols = wvals = wbase = None
@@ -700,6 +726,7 @@ def finalize_partition(
         diag=diag,
         int_mask=int_mask,
         own_mask=own_mask,
+        bnd_rows=bnd_rows,
         ell_wcols=wcols,
         ell_wvals=wvals,
         ell_wbase=wbase,

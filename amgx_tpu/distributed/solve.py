@@ -33,6 +33,7 @@ def _shard_params(A: DistributedMatrix):
         out["split"] = (
             jnp.asarray(A.int_mask),
             jnp.asarray(A.own_mask),
+            None if A.bnd_rows is None else jnp.asarray(A.bnd_rows),
         )
     if A.ell_wcols is not None:
         from amgx_tpu.ops.pallas_well import pallas_well_supported
@@ -141,7 +142,7 @@ def make_local_spmv(A: DistributedMatrix, axis):
     def spmv(shard, x_loc):
         ell_cols, ell_vals = shard["ell"]
         if "split" in shard:
-            int_mask, own_mask = shard["split"]
+            int_mask, own_mask, bnd_rows = shard["split"]
             halo = exchange_halo(A, shard, x_loc, axis)
             if use_wtile:
                 # interior pass on the Pallas windowed kernel: interior
@@ -157,14 +158,33 @@ def make_local_spmv(A: DistributedMatrix, axis):
                 )
             else:
                 # XLA interior pass: columns clamped into the local
-                # range (the clamp only touches boundary rows, which
-                # the mask zeroes) — no dependence on the permute
-                # results, so it overlaps
+                # range (the clamp only touches boundary rows, whose
+                # contribution comes from the compact pass below) — no
+                # dependence on the permute results, so it lands in a
+                # fusion XLA can schedule DURING the exchange
+                # (ci/check_overlap_hlo.py asserts the dataflow)
                 nloc = x_loc.shape[0]
                 lc = jnp.minimum(ell_cols, nloc - 1)
                 yi = jnp.where(
                     int_mask, jnp.sum(ell_vals * x_loc[lc], axis=-1), 0
                 )
+            if bnd_rows is not None:
+                # compact boundary pass (reference multiply.cu:95-110
+                # boundary-rows kernel): gather the O(surface) boundary
+                # rows, compute against [x_loc, halo], scatter-add into
+                # a spill-padded copy of yi.  Structurally unfusable
+                # with the interior reduce -> overlap-safe, and the
+                # second pass costs O(nb*w) instead of O(rows*w).
+                xf = jnp.concatenate([x_loc, halo])
+                yb = jnp.sum(
+                    ell_vals[bnd_rows] * xf[ell_cols[bnd_rows]],
+                    axis=-1,
+                )
+                y = jnp.concatenate(
+                    [yi, jnp.zeros((1,), yi.dtype)]
+                )
+                y = y.at[bnd_rows].add(yb)
+                return y[: x_loc.shape[0]]
             xf = jnp.concatenate([x_loc, halo])
             yb = jnp.where(
                 own_mask & ~int_mask,

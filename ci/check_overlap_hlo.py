@@ -1,0 +1,225 @@
+"""Latency-hiding evidence for the distributed SpMV (VERDICT r3 #8).
+
+The reference overlaps interior SpMV with the in-flight halo exchange
+(multiply.cu:95-110 exchange_halo_split_gather -> interior -> finish ->
+boundary).  The TPU analogue relies on XLA's scheduler placing the
+independent interior pass between ``collective-permute-start`` and
+``-done``; that is only POSSIBLE if the compiled HLO keeps the interior
+partial product free of any (transitive) dependence on the permutes.
+This checker compiles the sharded SpMV on a CPU mesh and verifies that
+dataflow property mechanically:
+
+  * >=1 ``collective-permute`` exists (the halo exchange),
+  * >=1 flop-carrying instruction (a width-dimension ``reduce``, or a
+    fusion calling one) has NO transitive dependence on any permute —
+    the interior pass, schedulable during the exchange,
+  * >=1 flop-carrying instruction DOES depend on the permutes — the
+    boundary pass,
+  * the ROOT consumes both.
+
+With a masked full-size boundary pass XLA output-fuses
+interior+boundary+add into a single fusion whose operands include both
+permutes — interior work then cannot start until the exchange
+completes (observed before round 4; an ``optimization_barrier`` did
+not survive the CPU pipeline either).  The fix is STRUCTURAL: the
+boundary pass is compacted to the O(surface) ``bnd_rows`` list
+(gather -> compute -> scatter-add, ``make_local_spmv``), which keeps
+the interior reduce in its own permute-free fusion; this script run
+under CI keeps it that way.  (The CPU backend does not split permutes
+into start/done pairs — that is a TPU-scheduler feature — so the
+checkable contract here is dependence structure, not the final
+schedule; the TPU schedule is validated on hardware when the tunnel
+allows.)
+
+Usage: python ci/check_overlap_hlo.py [--write PATH]
+"""
+
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def compiled_spmv_hlo() -> str:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from amgx_tpu.distributed.partition import partition_matrix
+    from amgx_tpu.distributed.solve import _shard_params, make_local_spmv
+    from amgx_tpu.io.poisson import poisson_3d_7pt
+
+    A = poisson_3d_7pt(16).to_scipy()
+    D = partition_matrix(A, 8)
+    assert D.uses_ppermute and D.int_mask is not None
+    mesh = Mesh(np.array(jax.devices()[:8]), ("x",))
+    spmv = make_local_spmv(D, "x")
+    sh = _shard_params(D)
+
+    @jax.jit
+    @jax.shard_map(
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P("x"), sh), P("x")),
+        out_specs=P("x"),
+    )
+    def f(shard, xs):
+        loc = jax.tree.map(lambda s: s[0], shard)
+        return spmv(loc, xs[0])[None]
+
+    xs = jnp.zeros((8, D.rows_per_part))
+    return f.lower(sh, xs).compile().as_text()
+
+
+_INSTR = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w\.\-]+)\s*=\s*\S+\s+"
+    r"(?P<op>[\w\-]+)\((?P<args>.*)$"
+)
+
+
+def parse_computations(txt):
+    """{comp_name: {instr: (op, [operands], line)}} plus fusion->called
+    computation map and each computation's ROOT."""
+    comps, fus_calls, roots = {}, {}, {}
+    cur = None
+    for line in txt.splitlines():
+        mhead = re.match(r"^(%[\w\.\-]+|ENTRY\s+%[\w\.\-]+)\s*\(", line)
+        if mhead and "=" not in line.split("(")[0]:
+            cur = mhead.group(1).replace("ENTRY", "").strip().lstrip("%")
+            comps[cur] = {}
+            continue
+        m = _INSTR.match(line)
+        if not m or cur is None:
+            continue
+        name, op = m.group("name"), m.group("op")
+        operands = re.findall(r"%([\w\.\-]+)", m.group("args"))
+        # operands regex also catches calls=%comp etc.; keep only names
+        # defined in some computation later — filtered during traversal
+        comps[cur][name] = (op, operands, line)
+        if "ROOT" in line:
+            roots[cur] = name
+        cm = re.search(r"calls=%([\w\.\-]+)", line)
+        if cm:
+            fus_calls[name] = cm.group(1)
+    return comps, fus_calls, roots
+
+
+def analyze(txt):
+    comps, fus_calls, roots = parse_computations(txt)
+    entry = None
+    for line in txt.splitlines():
+        m = re.match(r"^ENTRY\s+%?([\w\.\-]+)", line)
+        if m:
+            entry = m.group(1)
+    assert entry and entry in comps, f"entry {entry} not parsed"
+    instrs = comps[entry]
+
+    def has_wide_reduce(comp_name, seen=None):
+        """A float reduce over the trailing (ELL-width) dim lives in
+        this computation or one it calls."""
+        seen = seen or set()
+        if comp_name in seen or comp_name not in comps:
+            return False
+        seen.add(comp_name)
+        for name, (op, _ops, line) in comps[comp_name].items():
+            if op == "reduce" and re.search(
+                r"f(32|64)\[\d+\]\{", line
+            ) and "dimensions={1}" in line:
+                return True
+            called = re.search(r"calls=%([\w\.\-]+)", line)
+            if called and has_wide_reduce(called.group(1), seen):
+                return True
+        return False
+
+    permutes = {
+        n for n, (op, _o, _l) in instrs.items()
+        if op == "collective-permute"
+    }
+    assert permutes, "no collective-permute in compiled HLO"
+
+    tainted = {}
+
+    def is_tainted(name, stack=()):
+        if name in tainted:
+            return tainted[name]
+        if name in permutes:
+            tainted[name] = True
+            return True
+        if name not in instrs or name in stack:
+            return False
+        t = any(
+            is_tainted(o, stack + (name,))
+            for o in instrs[name][1]
+            if o in instrs
+        )
+        tainted[name] = t
+        return t
+
+    compute_carrying = {
+        n
+        for n, (op, _o, _l) in instrs.items()
+        if op == "fusion" and has_wide_reduce(fus_calls.get(n, ""))
+    }
+    # plus width-dimension reduce instructions directly in entry
+    for n, (op, _o, line) in instrs.items():
+        if op == "reduce" and "dimensions={1}" in line:
+            compute_carrying.add(n)
+    assert compute_carrying, "no flop-carrying reduce found in entry"
+
+    interior = {n for n in compute_carrying if not is_tainted(n)}
+    boundary = {n for n in compute_carrying if is_tainted(n)}
+
+    root = roots[entry]
+    reach = set()
+
+    def inputs_of(name, seen):
+        if name in seen or name not in instrs:
+            return
+        seen.add(name)
+        for o in instrs[name][1]:
+            inputs_of(o, seen)
+
+    inputs_of(root, reach)
+    interior_used = interior & reach
+    boundary_used = boundary & reach
+    return dict(
+        n_permutes=len(permutes),
+        interior=sorted(interior_used),
+        boundary=sorted(boundary_used),
+        ok=bool(interior_used and boundary_used),
+    )
+
+
+def main():
+    txt = compiled_spmv_hlo()
+    res = analyze(txt)
+    if "--write" in sys.argv:
+        path = sys.argv[sys.argv.index("--write") + 1]
+        with open(path, "w") as f:
+            f.write(
+                "// distributed SpMV compiled HLO (CPU mesh, 8 shards)\n"
+                f"// overlap dataflow check: {res}\n\n"
+            )
+            f.write(txt)
+    print("overlap-dataflow:", res)
+    assert res["ok"], (
+        "interior pass is fused into / depends on the halo exchange — "
+        f"latency hiding impossible: {res}"
+    )
+
+
+if __name__ == "__main__":
+    main()

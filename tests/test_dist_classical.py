@@ -114,6 +114,107 @@ def test_distributed_classical_iters_match_serial():
     assert abs(it_dist - it_serial) <= 2, (it_dist, it_serial)
 
 
+D2_CFG = CLASSICAL_CFG.replace(
+    '"interpolator": "D1"', '"interpolator": "D2"'
+)
+
+
+def test_distributed_d2_galerkin_matches_global():
+    """Distributed D2 (standard) interpolation: the distributed coarse
+    operator equals the serial standard-interpolation Galerkin product
+    (reference interpolators/distance2.cu) — transitively pins the
+    distributed P to the serial one."""
+    import scipy.sparse as sps
+
+    from amgx_tpu.amg.classical import (
+        pmis_select,
+        standard_interpolation,
+        strength_ahat,
+    )
+
+    Asp = poisson_3d_7pt(10).to_scipy().tocsr()
+    cfg = AMGConfig.from_string(D2_CFG)
+    h = build_distributed_classical_hierarchy(
+        Asp, 4, cfg, "amg", consolidate_rows=32
+    )
+    S = strength_ahat(Asp, 0.25, 1.1)
+    cf = pmis_select(S)
+    P = standard_interpolation(Asp, S, cf)
+    Ac_serial = (P.T @ Asp @ P).tocsr()
+
+    lvl1 = h.levels[1].A
+    assert lvl1.n_global == Ac_serial.shape[0]
+    rows, cols, vals = [], [], []
+    ec, ev = np.asarray(lvl1.ell_cols), np.asarray(lvl1.ell_vals)
+    rows_pp = lvl1.rows_per_part
+    offs = np.concatenate([[0], np.cumsum(lvl1.n_owned)])
+    for p in range(lvl1.n_parts):
+        for r in range(int(lvl1.n_owned[p])):
+            for k in range(ec.shape[2]):
+                v = ev[p, r, k]
+                if v == 0:
+                    continue
+                c = int(ec[p, r, k])
+                rows.append(offs[p] + r)
+                if c < rows_pp:
+                    cols.append(offs[p] + c)
+                else:
+                    src = int(lvl1.halo_src_part[p, c - rows_pp])
+                    pos = int(lvl1.halo_src_pos[p, c - rows_pp])
+                    cols.append(
+                        offs[src] + int(lvl1.send_idx[src, pos])
+                    )
+                vals.append(v)
+    Ac_dist = sps.csr_matrix(
+        (vals, (rows, cols)), shape=Ac_serial.shape
+    )
+    d = abs(Ac_dist - Ac_serial)
+    assert d.max() < 1e-10 * max(abs(Ac_serial).max(), 1)
+
+
+def test_distributed_d2_iters_match_serial():
+    """AMG-PCG with interpolator=D2 on the 8-way mesh converges within
+    +-2 iterations of the serial D2 solve (VERDICT r3 next #5's
+    acceptance bar) and emits no D1-fallback warning."""
+    import json
+
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.solvers import create_solver
+
+    Asp = poisson_3d_7pt(16).to_scipy().tocsr()
+    n = Asp.shape[0]
+    b = poisson_rhs(n)
+
+    amg_scope = json.loads(D2_CFG)["solver"]
+    pcg_cfg = AMGConfig.from_string(json.dumps({
+        "config_version": 2,
+        "solver": {
+            "scope": "main", "solver": "PCG", "max_iters": 100,
+            "tolerance": 1e-08, "convergence": "RELATIVE_INI",
+            "norm": "L2", "monitor_residual": 1,
+            "preconditioner": amg_scope,
+        },
+    }))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = create_solver(pcg_cfg, "default")
+        s.setup(SparseMatrix.from_scipy(Asp))
+        res = s.solve(b)
+    it_serial = int(res.iters)
+    assert int(res.status) == 0
+
+    cfg = AMGConfig.from_string(D2_CFG)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # no D1 fallback
+        sd = DistributedAMG(
+            Asp, mesh1d(8), cfg=cfg, scope="amg", consolidate_rows=256
+        )
+    x, it_dist, _ = sd.solve(b, max_iters=100, tol=1e-8)
+    rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
+    assert rel < 1e-7
+    assert abs(it_dist - it_serial) <= 2, (it_dist, it_serial)
+
+
 def test_distributed_classical_galerkin_matches_global():
     """Distributed RAP (halo P-rows + partial-row exchange) equals the
     global R A P up to the coarse permutation."""

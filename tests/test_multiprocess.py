@@ -1,0 +1,256 @@
+"""True multi-process hierarchy assembly + solve (VERDICT r3 missing #1).
+
+The reference builds every coarse level per rank (amg.cu:425-660
+setup_v2, distributed_manager.cu:1040-1345); the TPU analogue is
+``DistributedAMG.from_local_parts`` run one process per device group:
+each process localizes only its row block, the setup math exchanges
+O(boundary) payloads over the AllgatherComm fabric, and
+``_finalize_level`` assembles per-part ``jax.Array``s sharded over the
+multi-process mesh (multihost.assemble_level_sharded).
+
+Harness: the reference simulates N partitions inside one process for CI
+(SURVEY §4); here we go further and launch a REAL 2-process
+``jax.distributed`` CPU cluster (2 local devices each -> a 4-device
+global mesh), then assert
+
+  * every sharded level's device arrays are BIT-IDENTICAL to the
+    single-process Loopback build of the same partition (each worker
+    rebuilds the Loopback hierarchy on host numpy and compares its
+    addressable shards), and
+  * the multi-process solve converges with the iteration count of the
+    single-process solve (computed by the parent), and the returned
+    global solution satisfies the residual contract.
+
+Run as a script, this file is the worker body (``--worker``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_N = 12            # 12^3 Poisson
+_PARTS = 4
+_NPROC = 2
+_CONS = 128        # consolidate below this -> >=3 sharded levels
+_TOL = 1e-8
+
+
+def _free_port() -> int:
+    """An OS-assigned free port for the jax.distributed coordinator so
+    concurrent runs (CI jobs, dryrun + pytest overlap) don't collide."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+_DILU_CFG = """{
+  "config_version": 2,
+  "solver": {"scope": "amg", "solver": "AMG",
+             "algorithm": "AGGREGATION", "selector": "SIZE_2",
+             "smoother": {"scope": "sm", "solver": "MULTICOLOR_DILU",
+                          "relaxation_factor": 0.9,
+                          "monitor_residual": 0},
+             "presweeps": 1, "postsweeps": 1, "max_iters": 1,
+             "cycle": "V", "coarse_solver": "DENSE_LU_SOLVER",
+             "monitor_residual": 0}}"""
+
+
+def _problem():
+    from amgx_tpu.io.poisson import poisson_3d_7pt, poisson_rhs
+
+    A = poisson_3d_7pt(_N).to_scipy().tocsr()
+    A.sort_indices()
+    n = A.shape[0]
+    b = np.asarray(poisson_rhs(n), dtype=np.float64)
+    rows_pp = -(-n // _PARTS)
+    offsets = np.minimum(
+        np.arange(_PARTS + 1, dtype=np.int64) * rows_pp, n
+    )
+    return A, b, offsets
+
+
+def _local_parts_for(A, offsets, parts):
+    from amgx_tpu.distributed.multihost import local_part_from_rows
+
+    out = {}
+    for p in parts:
+        lo, hi = int(offsets[p]), int(offsets[p + 1])
+        blk = A[lo:hi]
+        out[p] = local_part_from_rows(
+            blk.indptr, blk.indices, blk.data, offsets, p
+        )
+    return out
+
+
+def _cfg():
+    from amgx_tpu.config.amg_config import AMGConfig
+
+    return AMGConfig.from_string(_DILU_CFG), "amg"
+
+
+def _dist_amg(local_parts, offsets, mesh, comm=None):
+    from amgx_tpu.distributed.amg import DistributedAMG
+
+    cfg, scope = _cfg()
+    return DistributedAMG.from_local_parts(
+        local_parts, offsets, mesh, cfg=cfg, scope=scope,
+        consolidate_rows=_CONS, grade_lower=0, comm=comm,
+    )
+
+
+def _host_block(arr, p):
+    """Part p's slice of a stacked field: numpy index or addressable
+    shard of a multi-process sharded jax.Array."""
+    if isinstance(arr, np.ndarray):
+        return np.asarray(arr[p])
+    for s in arr.addressable_shards:
+        if s.index[0].start == p:
+            return np.asarray(s.data)[0]
+    raise KeyError(f"part {p} not addressable")
+
+
+def _level_fields(lvl):
+    A = lvl.A
+    fields = dict(
+        ell_cols=A.ell_cols, ell_vals=A.ell_vals, diag=A.diag,
+        int_mask=A.int_mask, own_mask=A.own_mask,
+        halo_dir=A.halo_dir, halo_pos=A.halo_pos,
+        send_idx=A.send_idx,
+        P_cols=lvl.P_cols, P_vals=lvl.P_vals,
+        R_cols=lvl.R_cols, R_vals=lvl.R_vals,
+    )
+    if A.send_idx_d is not None:
+        for d, s in enumerate(A.send_idx_d):
+            fields[f"send_idx_d{d}"] = s
+    return {k: v for k, v in fields.items() if v is not None}
+
+
+def _worker(pid, port):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=_NPROC,
+        process_id=pid,
+    )
+    jax.config.update("jax_enable_x64", True)
+    from jax.sharding import Mesh
+
+    from amgx_tpu.distributed.comm import LoopbackComm
+    from amgx_tpu.distributed.hierarchy import (
+        build_distributed_hierarchy_local,
+    )
+    from amgx_tpu.distributed.multihost import addressable_parts
+    from amgx_tpu.distributed.partition import OffsetOwnership
+
+    expected_iters = int(sys.argv[2])
+
+    devs = jax.devices()
+    assert len(devs) == _PARTS, (len(devs), _PARTS)
+    mesh = Mesh(np.array(devs), ("parts",))
+    mine = addressable_parts(mesh)
+    assert len(mine) == _PARTS // _NPROC
+
+    A, b, offsets = _problem()
+
+    # ---- multi-process build: only this process's row blocks --------
+    amg = _dist_amg(_local_parts_for(A, offsets, mine), offsets, mesh)
+    assert len(amg.h.levels) >= 4, len(amg.h.levels)  # >=3 sharded + deepest
+
+    # ---- Loopback reference: all parts, host numpy, same entry ------
+    cfg, scope = _cfg()
+    ref = build_distributed_hierarchy_local(
+        _local_parts_for(A, offsets, range(_PARTS)),
+        OffsetOwnership(offsets), cfg, scope,
+        comm=LoopbackComm(_PARTS),
+        consolidate_rows=_CONS, grade_lower=0,
+    )
+    assert len(ref.levels) == len(amg.h.levels)
+
+    # ---- bit-identical levels ---------------------------------------
+    for l, (got_l, ref_l) in enumerate(zip(amg.h.levels, ref.levels)):
+        got_f = _level_fields(got_l)
+        ref_f = _level_fields(ref_l)
+        assert sorted(got_f) == sorted(ref_f), (
+            l, sorted(got_f), sorted(ref_f)
+        )
+        for k in got_f:
+            for p in mine:
+                g = _host_block(got_f[k], p)
+                r = _host_block(ref_f[k], p)
+                assert g.shape == r.shape, (l, k, p, g.shape, r.shape)
+                assert np.array_equal(g, r), (l, k, p)
+    # consolidated tail matrix is replicated plan state
+    assert (amg.h.tail_matrix != ref.tail_matrix).nnz == 0
+
+    # ---- solve: converges with the single-process iteration count --
+    x, it, nrm = amg.solve(b, max_iters=100, tol=_TOL)
+    rel = float(np.linalg.norm(b - A @ x) / np.linalg.norm(b))
+    assert rel < _TOL * 50, rel
+    assert it == expected_iters, (it, expected_iters)
+    print(f"WORKER{pid}_OK levels={len(amg.h.levels)} it={it} "
+          f"rel={rel:.3e}", flush=True)
+
+
+def test_multiprocess_hierarchy_and_solve():
+    """Parent: compute the single-process iteration count, then launch
+    the 2-process cluster and require both workers' full checks."""
+    import jax
+
+    from jax.sharding import Mesh
+
+    A, b, offsets = _problem()
+    devs = jax.devices()[:_PARTS]
+    mesh = Mesh(np.array(devs), ("parts",))
+    amg = _dist_amg(
+        _local_parts_for(A, offsets, range(_PARTS)), offsets, mesh
+    )
+    x, it, nrm = amg.solve(b, max_iters=100, tol=_TOL)
+    rel = float(np.linalg.norm(b - A @ x) / np.linalg.norm(b))
+    assert rel < _TOL * 50, rel
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = (
+        repo + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count="
+        f"{_PARTS // _NPROC}"
+    )
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", str(it), str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(_NPROC)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER{pid}_OK" in out, out
+
+
+if __name__ == "__main__":
+    # argv: --worker <expected_iters> <pid> <port>
+    assert sys.argv[1] == "--worker"
+    _worker(int(sys.argv[3]), int(sys.argv[4]))
