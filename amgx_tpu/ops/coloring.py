@@ -6,9 +6,15 @@ drives masked color-sweeps, so what matters is (a) a valid distance-1
 coloring, (b) determinism, (c) few colors, and (d) for downwind-aware
 smoothing, a color order that follows the flow.  Implemented:
 
-  * GREEDY / SERIAL_GREEDY_BFS / GREEDY_RECOLOR: deterministic
-    natural-order greedy — the determinism_flag path.
-  * MIN_MAX / PARALLEL_GREEDY / MULTI_HASH / ROUND_ROBIN: hash-based
+  * GREEDY / SERIAL_GREEDY_BFS: deterministic natural-order greedy —
+    the determinism_flag path.
+  * MULTI_HASH: the reference's multi-hash round scheme
+    (multi_hash.cu colorRowsMultiHashKernel — num_hash independent
+    hash functions per round, strict-extremum candidates, i%possible
+    selection), vectorized.
+  * GREEDY_RECOLOR: multi-hash first coloring + iterated
+    class-parallel palette shrinking (greedy_recolor.cu recolor pass).
+  * MIN_MAX / PARALLEL_GREEDY / ROUND_ROBIN: hash-based
     parallel-style MIS coloring (min_max.cu structure).
   * MIN_MAX_2RING / GREEDY_MIN_MAX_2RING: the same algorithms on the
     distance-2 (squared) graph — same-color rows are then independent
@@ -137,16 +143,141 @@ def _compact_colors(colors):
     return remap[colors]
 
 
+def _mix_hash(a, seed):
+    """The reference's integer mix (multi_hash.cu:hash), vectorized on
+    uint32 with wraparound."""
+    a = (np.asarray(a, dtype=np.uint64) ^ np.uint64(seed)) & np.uint64(
+        0xFFFFFFFF
+    )
+
+    def u32(x):
+        return x & np.uint64(0xFFFFFFFF)
+
+    a = u32(a + np.uint64(0x7ED55D16) + u32(a << np.uint64(12)))
+    a = u32((a ^ np.uint64(0xC761C23C)) + (a >> np.uint64(19)))
+    a = u32(a + np.uint64(0x165667B1) + u32(a << np.uint64(5)))
+    a = u32((a ^ np.uint64(0xD3A2646C)) + u32(a << np.uint64(9)))
+    a = u32(a + np.uint64(0xFD7046C5) + u32(a << np.uint64(3)))
+    a = u32((a ^ np.uint64(0xB55A4F09)) + (a >> np.uint64(16)))
+    return a
+
+
+def multi_hash_coloring(
+    indptr, indices, n, num_hash=8, seed=0, max_rounds=64
+) -> np.ndarray:
+    """MULTI_HASH coloring (reference multi_hash.cu
+    colorRowsMultiHashKernel): each round runs ``num_hash`` independent
+    hash functions; a vertex that is a strict local max (min) among
+    its uncolored neighbours under hash t may take color
+    ``next_color + 2t`` (``+2t+1``), and among its candidate colors it
+    picks the ``i % n_candidates``-th — up to 2*num_hash independent
+    classes colored per round.  Deterministic."""
+    colors = np.full(n, -1, dtype=np.int32)
+    row_ids = np.repeat(np.arange(n), np.diff(indptr))
+    keep = (indices != row_ids) & (indices < n)
+    rows, cols = row_ids[keep], indices[keep]
+    # hashes for every vertex x hash fn: [n, K] (round-invariant)
+    hv = np.stack(
+        [
+            _mix_hash(np.arange(n), seed + 1043 * int(t))
+            for t in range(num_hash)
+        ],
+        axis=1,
+    )
+    next_color = 0
+    for _ in range(max_rounds):
+        un = colors < 0
+        if not un.any():
+            break
+        ae = un[rows] & un[cols]
+        r, c = rows[ae], cols[ae]
+        # not_max[i,t]: some active neighbour j has h_t(i) <= h_t(j)
+        not_max = np.zeros((n, num_hash), dtype=bool)
+        not_min = np.zeros((n, num_hash), dtype=bool)
+        le = hv[r] <= hv[c]
+        ge = hv[r] >= hv[c]
+        np.logical_or.at(not_max, r, le)
+        np.logical_or.at(not_min, r, ge)
+        # candidate slots in reference order: per t, min (2t) then
+        # max (2t+1), offset by next_color
+        cand = np.zeros((n, 2 * num_hash), dtype=bool)
+        cand[:, 0::2] = ~not_min
+        cand[:, 1::2] = ~not_max
+        cand[~un] = False
+        possible = cand.sum(axis=1)
+        pick = np.nonzero(un & (possible > 0))[0]
+        if len(pick):
+            col_id = pick % possible[pick]
+            cum = np.cumsum(cand[pick], axis=1)
+            slot = np.argmax(
+                (cum == (col_id + 1)[:, None]) & cand[pick], axis=1
+            )
+            colors[pick] = next_color + slot.astype(np.int32)
+        next_color += 2 * num_hash
+    # anything left (pathological): greedy-fix
+    for i in np.nonzero(colors < 0)[0]:
+        neigh = indices[indptr[i]: indptr[i + 1]]
+        used = set(colors[neigh[neigh < n]].tolist())
+        ccc = 0
+        while ccc in used:
+            ccc += 1
+        colors[i] = ccc
+    return _compact_colors(colors)
+
+
+def recolor_min_colors(
+    indptr, indices, n, colors, max_passes=4
+) -> np.ndarray:
+    """Iterated class-parallel recoloring (the palette-shrinking pass
+    of reference greedy_recolor.cu): members of one color class are
+    mutually non-adjacent, so the whole class simultaneously jumps to
+    its smallest neighbour-free color.  Classes are processed from the
+    highest color down; freed colors are only reclaimed on the next
+    pass (conservative, keeps validity invariant)."""
+    colors = np.asarray(colors, dtype=np.int32).copy()
+    row_ids = np.repeat(np.arange(n), np.diff(indptr))
+    keep = (indices != row_ids) & (indices < n)
+    rows, cols = row_ids[keep], indices[keep]
+    for _ in range(max_passes):
+        changed = False
+        nc = int(colors.max()) + 1
+        if nc <= 1:
+            break
+        used = np.zeros((n, nc), dtype=bool)
+        used[rows, colors[cols]] = True
+        for col in range(nc - 1, 0, -1):
+            mem = np.nonzero(colors == col)[0]
+            if not len(mem):
+                continue
+            free = ~used[mem]
+            free[:, col:] = False  # only strictly smaller colors
+            has = free.any(axis=1)
+            if not has.any():
+                continue
+            tgt = mem[has]
+            colors[tgt] = np.argmax(free[has], axis=1).astype(np.int32)
+            # incremental neighbour update (old colors stay marked —
+            # conservative)
+            flag = np.zeros(n, dtype=bool)
+            flag[tgt] = True
+            sel = flag[cols]
+            used[rows[sel], colors[cols[sel]]] = True
+            changed = True
+        if not changed:
+            break
+    return _compact_colors(colors)
+
+
 _SCHEME_ALIASES = {
     "MIN_MAX": "MIN_MAX",
     "MIN_MAX_2RING": "MIN_MAX_2RING",
     "GREEDY_MIN_MAX_2RING": "GREEDY_2RING",
     "PARALLEL_GREEDY": "MIN_MAX",
     "ROUND_ROBIN": "MIN_MAX",
-    "MULTI_HASH": "MIN_MAX",
+    "MULTI_HASH": "MULTI_HASH",
     "UNIFORM": "UNIFORM",
     "SERIAL_GREEDY_BFS": "GREEDY",
-    "GREEDY_RECOLOR": "GREEDY",
+    "GREEDY_RECOLOR": "GREEDY_RECOLOR",
     "LOCALLY_DOWNWIND": "LOCALLY_DOWNWIND",
     "GREEDY": "GREEDY",
 }
@@ -184,6 +315,13 @@ def color_matrix(A, scheme="MIN_MAX", deterministic=False) -> np.ndarray:
                 np.int32
             )
         return greedy_coloring(indptr, indices, n)
+    if algo == "MULTI_HASH":
+        return multi_hash_coloring(indptr, indices, n)
+    if algo == "GREEDY_RECOLOR":
+        # reference greedy_recolor.cu: fast multi-hash first coloring,
+        # then iterated class-parallel palette shrinking
+        first = multi_hash_coloring(indptr, indices, n)
+        return recolor_min_colors(indptr, indices, n, first)
     if deterministic or algo == "GREEDY":
         return greedy_coloring(indptr, indices, n)
     return min_max_coloring(indptr, indices, n)

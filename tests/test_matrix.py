@@ -135,10 +135,57 @@ def test_coloring_schemes_valid():
         "LOCALLY_DOWNWIND",
         "MIN_MAX_2RING",
         "GREEDY_MIN_MAX_2RING",
+        "MULTI_HASH",
+        "GREEDY_RECOLOR",
     ):
         colors = color_matrix(A, scheme)
         assert validate_coloring(ip, ix, colors), scheme
         assert colors.min() == 0
+
+
+def test_multi_hash_and_recolor_semantics():
+    """MULTI_HASH and GREEDY_RECOLOR are real schemes (reference
+    multi_hash.cu, greedy_recolor.cu), not aliases: multi-hash is
+    deterministic and colors many classes per round; the recolor pass
+    never increases and typically shrinks the palette while keeping
+    the coloring valid (valid_coloring.cu contract)."""
+    import numpy as np
+
+    from amgx_tpu.io.poisson import poisson_2d_5pt
+    from amgx_tpu.ops.coloring import (
+        multi_hash_coloring,
+        recolor_min_colors,
+        validate_coloring,
+    )
+
+    A = poisson_2d_5pt(20)
+    ip = np.asarray(A.row_offsets)
+    ix = np.asarray(A.col_indices)
+    n = A.n_rows
+    mh = multi_hash_coloring(ip, ix, n)
+    assert validate_coloring(ip, ix, mh)
+    assert np.array_equal(mh, multi_hash_coloring(ip, ix, n))
+    rc = recolor_min_colors(ip, ix, n, mh)
+    assert validate_coloring(ip, ix, rc)
+    assert rc.max() <= mh.max()
+    # 5-pt Poisson is bipartite (2-colorable); the recolor pass should
+    # land close to optimal from the multi-hash start
+    assert rc.max() + 1 <= 4, int(rc.max() + 1)
+
+    # random unstructured graph: validity + palette shrink hold too
+    rng = np.random.default_rng(7)
+    import scipy.sparse as sps
+
+    m = 300
+    G = sps.random(m, m, density=0.03, random_state=rng)
+    G = ((G + G.T) != 0).tocsr()
+    G.setdiag(1)
+    G = G.tocsr()
+    mh2 = multi_hash_coloring(G.indptr, G.indices, m)
+    assert validate_coloring(G.indptr, G.indices, mh2)
+    rc2 = recolor_min_colors(G.indptr, G.indices, m, mh2)
+    assert validate_coloring(G.indptr, G.indices, rc2)
+    assert rc2.max() <= mh2.max()
 
 
 def test_two_ring_coloring_independent_in_square():
