@@ -619,6 +619,363 @@ def lvl_parts_to_parts(lvl_parts):
     }
 
 
+def _block_coo_reduce(rows, cols, blocks, dtype=None):
+    """Canonicalize a block COO triple: lexsort by (row, col), sum
+    duplicate blocks (np.add.reduceat in stable key order — the
+    deterministic part-order sum the scalar path gets from csr adds).
+    Returns (rows, cols, blocks) with unique sorted keys."""
+    if len(rows) == 0:
+        b = blocks.shape[1] if blocks.ndim == 3 else 1
+        return (
+            np.zeros(0, np.int64), np.zeros(0, np.int64),
+            np.zeros((0, b, b), dtype or np.float64),
+        )
+    order = np.lexsort((cols, rows))
+    rows, cols, blocks = rows[order], cols[order], blocks[order]
+    key_new = np.empty(len(rows), dtype=bool)
+    key_new[0] = True
+    key_new[1:] = (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])
+    starts = np.nonzero(key_new)[0]
+    out = np.add.reduceat(blocks, starts, axis=0)
+    return rows[starts], cols[starts], out
+
+
+def _block_parts_to_parts(lvl_parts):
+    """Block level state -> the localized dicts _finalize_level expects
+    (vals carry the (nnzb, b, b) blocks; part_ell_arrays is
+    block-aware)."""
+    return {
+        p: dict(
+            indptr=d["indptr"],
+            cols=np.asarray(d["cols"], dtype=np.int32),
+            vals=d["vals"],
+            halo_glob=d["halo_glob"],
+        )
+        for p, d in lvl_parts.items()
+    }
+
+
+def build_distributed_hierarchy_block(
+    Asp: sps.csr_matrix,
+    n_parts: int,
+    block_size: int,
+    cfg,
+    scope: str,
+    grid=None,
+    owner=None,
+    comm: Optional[LoopbackComm] = None,
+    max_levels: int = 20,
+    consolidate_rows: int = _CONSOLIDATE_ROWS,
+    grade_lower: int = _GRADE_LOWER,
+) -> DistHierarchy:
+    """Distributed aggregation AMG on a BLOCK matrix (reference
+    distributed block path: aggregation treats block rows as graph
+    nodes, aggregation_amg_level.cu; transfers are aggregate maps ⊗
+    I_b, so the coarse operator blocks are member-block sums).
+
+    Same per-part structure as the scalar builder: aggregation runs on
+    the part's condensed (Frobenius-norm) graph, halo coarse ids ride
+    the comm fabric, partial coarse BLOCK rows route to their graded
+    leaders and reduce in deterministic key order.  Device levels are
+    block ELL ([N, rows, w, b, b]); the consolidated tail expands to
+    scalar (the replicated tail AMG scalarizes block operators, like
+    the serial hierarchy).
+
+    MAINTENANCE NOTE: the grading / coarse-numbering / halo-fetch /
+    RAP-routing protocol below mirrors build_distributed_hierarchy_local
+    step for step (only the value-combine differs: _block_coo_reduce
+    vs scipy csr sums) — a change to the collective protocol in either
+    builder must be applied to BOTH until the loop is parametrized on
+    a value-combine callback."""
+    from amgx_tpu.distributed.partition import block_csr_arrays
+
+    b = int(block_size)
+    if comm is None:
+        from amgx_tpu.distributed.comm import default_comm
+
+        comm = default_comm(n_parts)
+    indptr_g, bcols_g, bvals_g = block_csr_arrays(Asp, b)
+    n = indptr_g.shape[0] - 1
+    if owner is None:
+        # grid/owner describe BLOCK rows (reference block partition
+        # vectors are block-row granular)
+        owner, proc_grid = partition_rows(n, n_parts, grid)
+    else:
+        owner = np.asarray(owner, dtype=np.int32)
+        proc_grid = None
+    ownership = ArrayOwnership(owner, n_parts=n_parts)
+    rows_pp0 = max(int(ownership.counts.max()), 1)
+    my_parts = list(comm.my_parts)
+
+    from amgx_tpu.distributed.partition import gather_row_entries
+
+    lvl_parts = {}
+    for p in my_parts:
+        ent, lens = gather_row_entries(
+            indptr_g, ownership.global_rows(p)
+        )
+        lptr = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+        loc = localize_columns(
+            lptr, bcols_g[ent], bvals_g[ent], owner,
+            ownership.local_arr, p, rows_pp0,
+        )
+        lvl_parts[p] = dict(
+            indptr=loc["indptr"], cols=loc["cols"], vals=loc["vals"],
+            halo_glob=loc["halo_glob"],
+        )
+    lvl_own: Ownership = ownership
+    levels: List[DistLevel] = []
+    max_part_nnz = 0
+    max_part_rows = 0
+
+    def cond_csr(d, counts_p):
+        """Condensed Frobenius-norm scalar csr of one block part."""
+        nloc = rows_pp_cur + len(d["halo_glob"])
+        fro = np.sqrt((d["vals"] ** 2).sum(axis=(1, 2)))
+        return sps.csr_matrix(
+            (fro, d["cols"], d["indptr"]), shape=(counts_p, nloc)
+        )
+
+    while (
+        lvl_own.n_global > consolidate_rows and len(levels) < max_levels
+    ):
+        counts = lvl_own.counts
+        rows_pp_cur = max(int(counts.max()), 1)
+        aggs: Dict[int, np.ndarray] = {}
+        ncs_local: Dict[int, int] = {}
+        for p in my_parts:
+            A_pp = cond_csr(lvl_parts[p], int(counts[p]))[
+                :, : counts[p]
+            ].tocsr()
+            agg = _local_aggregate(A_pp, cfg, scope)
+            aggs[p] = agg
+            ncs_local[p] = int(agg.max()) + 1 if agg.size else 0
+            max_part_nnz = max(
+                max_part_nnz, lvl_parts[p]["vals"].shape[0]
+            )
+            max_part_rows = max(max_part_rows, int(counts[p]))
+        ncs = np.asarray(
+            comm.allgather(ncs_local, kind="coarse-counts"),
+            dtype=np.int64,
+        )
+        nc_global = int(ncs.sum())
+        if nc_global >= lvl_own.n_global or nc_global == 0:
+            break
+
+        graded = _grade_groups(ncs, grade_lower)
+        if graded is not None:
+            lead_of, moff, perms_down, is_leader = graded
+            bridge = (perms_down, is_leader)
+        else:
+            lead_of = np.arange(n_parts, dtype=np.int32)
+            moff = np.zeros(n_parts, dtype=np.int64)
+            bridge = None
+        nc_lead = np.zeros(n_parts, dtype=np.int64)
+        for p in range(n_parts):
+            nc_lead[lead_of[p]] += ncs[p]
+        coffsets = np.concatenate([[0], np.cumsum(nc_lead)])
+        own_c = OffsetOwnership(coffsets)
+        cbase = coffsets[lead_of] + moff
+
+        P_blocks = {
+            p: sps.csr_matrix(
+                (
+                    np.ones(counts[p], dtype=bvals_g.dtype),
+                    (np.arange(counts[p]), moff[p] + aggs[p]),
+                ),
+                shape=(int(counts[p]), int(nc_lead[lead_of[p]])),
+            )
+            for p in my_parts
+        }
+
+        # halo coarse ids from their owners (O(boundary))
+        requests: Dict[int, Dict[int, np.ndarray]] = {}
+        for p in my_parts:
+            hg = lvl_parts[p]["halo_glob"]
+            if not len(hg):
+                continue
+            owners = lvl_own.owner_of(hg)
+            requests[p] = {
+                int(o): hg[owners == o] for o in np.unique(owners)
+            }
+        answers = fetch_by_owner(
+            comm,
+            requests,
+            lambda o, ids: (
+                cbase[o] + aggs[o][lvl_own.local_of_ids(ids)]
+            ).astype(np.int64),
+            kind="halo-agg",
+        )
+
+        # partial coarse BLOCK rows: Ac_IJ = sum of member blocks
+        partial_rap: Dict[int, Dict[int, tuple]] = {}
+        for p in my_parts:
+            d = lvl_parts[p]
+            nloc = rows_pp_cur + len(d["halo_glob"])
+            col_to_gc = np.zeros(nloc, dtype=np.int64)
+            col_to_gc[: counts[p]] = cbase[p] + aggs[p]
+            hg = d["halo_glob"]
+            if len(hg):
+                hvals = np.empty(len(hg), dtype=np.int64)
+                owners = lvl_own.owner_of(hg)
+                for o, vals in answers.get(p, {}).items():
+                    hvals[owners == o] = vals
+                col_to_gc[rows_pp_cur: rows_pp_cur + len(hg)] = hvals
+            lens = np.diff(d["indptr"])
+            rid = np.repeat(
+                np.arange(int(counts[p]), dtype=np.int64), lens
+            )
+            crow = moff[p] + aggs[p][rid]  # leader-local coarse row
+            ccol = col_to_gc[d["cols"]]
+            r2, c2, blk = _block_coo_reduce(
+                crow, ccol, d["vals"], bvals_g.dtype
+            )
+            partial_rap.setdefault(int(lead_of[p]), {})[p] = (
+                r2, c2, blk
+            )
+
+        outbox = {}
+        for L, by_src in partial_rap.items():
+            for src, trip in by_src.items():
+                if L in my_parts:
+                    continue
+                outbox[(src, L)] = trip
+        inbox = comm.alltoall(outbox, kind="rap-ext")
+        rap: Dict[int, tuple] = {}
+        for L in my_parts:
+            if nc_lead[L] == 0:
+                continue
+            by_src = dict(partial_rap.get(L, {}))
+            for (src, dst), trip in inbox.items():
+                if dst == L:
+                    by_src[src] = trip
+            if not by_src:
+                continue
+            rr = np.concatenate(
+                [by_src[s][0] for s in sorted(by_src)]
+            )
+            cc = np.concatenate(
+                [by_src[s][1] for s in sorted(by_src)]
+            )
+            bb = np.concatenate(
+                [by_src[s][2] for s in sorted(by_src)]
+            )
+            rap[L] = _block_coo_reduce(rr, cc, bb, bvals_g.dtype)
+
+        # owned-first renumber of the coarse block level
+        rows_pp_c = max(int(own_c.counts.max()), 1)
+        new_parts = {}
+        for p in my_parts:
+            trip = rap.get(p)
+            if trip is None:
+                trip = (
+                    np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros((0, b, b), bvals_g.dtype),
+                )
+            gr, gc, blk = trip
+            is_owned = own_c.owner_of(gc) == p
+            cols, halo_glob = halo_localize(
+                gc, is_owned,
+                own_c.local_of_ids(gc[is_owned]), rows_pp_c,
+            )
+            nr_c = int(own_c.counts[p])
+            indptr_c = np.concatenate(
+                [[0], np.cumsum(np.bincount(
+                    gr, minlength=nr_c
+                ))]
+            ).astype(np.int64)
+            new_parts[p] = dict(
+                indptr=indptr_c, cols=cols, vals=blk,
+                halo_glob=halo_glob,
+            )
+
+        A_dev = _finalize_level(
+            _block_parts_to_parts(lvl_parts), lvl_own, comm,
+            proc_grid=proc_grid if len(levels) == 0 else None,
+        )
+        P_cols, P_vals = _stack_level_blocks(
+            P_blocks, rows_pp_cur, comm, None
+        )
+        R_blocks = {p: P_blocks[p].T.tocsr() for p in P_blocks}
+        R_cols, R_vals = _stack_level_blocks(
+            R_blocks, rows_pp_c, comm, None
+        )
+        levels.append(
+            DistLevel(
+                A=A_dev, P_cols=P_cols, P_vals=P_vals,
+                R_cols=R_cols, R_vals=R_vals, bridge=bridge,
+            )
+        )
+        lvl_parts = new_parts
+        lvl_own = own_c
+
+    # deepest level + scalar-expanded consolidated tail
+    counts_L = lvl_own.counts
+    rows_pp_L = max(int(counts_L.max()), 1)
+    A_last = _finalize_level(
+        _block_parts_to_parts(lvl_parts), lvl_own, comm,
+        proc_grid=proc_grid if not levels else None,
+    )
+    owner_L, local_L = lvl_own.materialize()
+    A_last.owner = owner_L
+    A_last.local_of = local_L
+    levels.append(DistLevel(A=A_last))
+
+    tail_local = {}
+    for p in my_parts:
+        d = lvl_parts[p]
+        hg = d["halo_glob"]
+        col_to_g = np.zeros(
+            rows_pp_L + len(hg), dtype=np.int64
+        )
+        g_rows = lvl_own.global_rows(p)
+        col_to_g[: counts_L[p]] = g_rows
+        if len(hg):
+            col_to_g[rows_pp_L: rows_pp_L + len(hg)] = hg
+        lens = np.diff(d["indptr"])
+        rid = np.repeat(np.arange(int(counts_L[p])), lens)
+        # expand blocks to scalar entries
+        gi = g_rows[rid]
+        gj = col_to_g[d["cols"]]
+        bi, bj = np.meshgrid(np.arange(b), np.arange(b), indexing="ij")
+        srow = (gi[:, None, None] * b + bi[None]).ravel()
+        scol = (gj[:, None, None] * b + bj[None]).ravel()
+        sval = d["vals"].ravel()
+        tail_local[p] = (srow, scol, sval)
+    gathered = comm.allgather(tail_local, kind="tail-glue")
+    ng_L = lvl_own.n_global
+    tail = sps.csr_matrix(
+        (
+            np.concatenate([t[2] for t in gathered]),
+            (
+                np.concatenate([t[0] for t in gathered]),
+                np.concatenate([t[1] for t in gathered]),
+            ),
+        ),
+        shape=(ng_L * b, ng_L * b),
+    )
+    tail.sum_duplicates()
+    tail.sort_indices()
+    tail.eliminate_zeros()
+
+    stats = dict(
+        comm_total_bytes=comm.stats.total_bytes,
+        comm_max_msg_bytes=comm.stats.max_msg_bytes,
+        comm_rounds=len(comm.stats.rounds),
+        max_part_nnz=int(max_part_nnz),
+        max_part_rows=int(max_part_rows),
+        n_parts=comm.n_parts,
+    )
+    return DistHierarchy(
+        levels=levels,
+        tail_matrix=tail,
+        tail_owner=owner_L,
+        tail_local_of=local_L,
+        setup_stats=stats,
+        comm=comm,
+    )
+
+
 def build_distributed_hierarchy(
     Asp: sps.csr_matrix,
     n_parts: int,

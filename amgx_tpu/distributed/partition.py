@@ -45,10 +45,16 @@ class DistributedMatrix:
     n_global: int
     n_parts: int
     rows_per_part: int  # padded uniform local row count
-    # ELL storage (local columns: 0..rows-1 owned, rows.. halo slots)
+    # ELL storage (local columns: 0..rows-1 owned, rows.. halo slots).
+    # Block matrices (reference BSR, multiply.cu:49-71 bsrmv dispatch)
+    # append the block dims: ell_vals [N, rows, w, b, b], diag
+    # [N, rows, b, b], vectors [N, rows, b] — halo exchange and the
+    # partition plan operate at BLOCK-row granularity (messages carry
+    # b-vectors), as the reference's distributed manager does.
     ell_cols: np.ndarray  # [N, rows, w] int32
-    ell_vals: np.ndarray  # [N, rows, w]
-    diag: np.ndarray  # [N, rows]
+    ell_vals: np.ndarray  # [N, rows, w] or [N, rows, w, b, b]
+    diag: np.ndarray  # [N, rows] or [N, rows, b, b]
+    block_size: int = 1
     # --- neighbor (ppermute) exchange: per direction d ---
     # perms[d]: list[(src, dst)] device pairs; send_idx[d]: [N, ms_d]
     # local indices to pack; each shard's halo is filled from the
@@ -101,14 +107,23 @@ class DistributedMatrix:
         return self.perms is not None
 
     def pad_vector(self, v):
-        """Global vector (n_global,) -> stacked padded [N, rows].
+        """Global vector (n_global*b,) -> stacked padded [N, rows[, b]].
 
         ``owner is None`` means contiguous-by-offset ownership (the
         per-process layout): part p owns global rows
         [offs[p], offs[p+1]) with offs = cumsum(n_owned) — correct for
         non-uniform blocks too, unlike a flat reshape."""
+        b = self.block_size
         v = np.asarray(v)
-        out = np.zeros((self.n_parts, self.rows_per_part), dtype=v.dtype)
+        if b > 1:
+            v = v.reshape(-1, b)
+            out = np.zeros(
+                (self.n_parts, self.rows_per_part, b), dtype=v.dtype
+            )
+        else:
+            out = np.zeros(
+                (self.n_parts, self.rows_per_part), dtype=v.dtype
+            )
         if self.owner is None:
             offs = np.concatenate(
                 [[0], np.cumsum(self.n_owned)]
@@ -122,10 +137,12 @@ class DistributedMatrix:
     def unpad_vector(self, vp):
         vp = np.asarray(vp)
         if self.owner is None:
-            return np.concatenate(
+            flat = np.concatenate(
                 [vp[p, : self.n_owned[p]] for p in range(self.n_parts)]
             )
-        return vp[self.owner, self.local_of]
+        else:
+            flat = vp[self.owner, self.local_of]
+        return flat.reshape(-1) if self.block_size > 1 else flat
 
 
 def pack_boundary_rows(rows_by_part, rows_pp, max_nb=None):
@@ -202,13 +219,22 @@ def tiled_ell_wanted(dtype) -> bool:
 def part_ell_arrays(part, rows_pp, w, dtype):
     """One shard's padded ELL block + diagonal — the per-shard slice of
     the stacked arrays (bit-parity-critical: both assembly paths, the
-    global partitioner and the multi-host one, fill through here)."""
+    global partitioner and the multi-host one, fill through here).
+    Block parts (``vals`` of shape (nnzb, b, b)) produce block ELL
+    arrays (rows, w, b, b) and block diagonals (rows, b, b)."""
     indptr, cols, vals = part["indptr"], part["cols"], part["vals"]
+    vals = np.asarray(vals)
     nr = indptr.shape[0] - 1
+    bshape = vals.shape[1:]  # () scalar, (b, b) block
     ell_cols = np.zeros((rows_pp, w), dtype=np.int32)
-    ell_vals = np.zeros((rows_pp, w), dtype=dtype)
+    ell_vals = np.zeros((rows_pp, w) + bshape, dtype=dtype)
     # padding rows get unit diagonal so smoothers stay finite there
-    diag = np.ones((rows_pp,), dtype=dtype)
+    if bshape:
+        diag = np.broadcast_to(
+            np.eye(bshape[0], dtype=dtype), (rows_pp,) + bshape
+        ).copy()
+    else:
+        diag = np.ones((rows_pp,), dtype=dtype)
     diag[:nr] = 0.0
     lens = np.diff(indptr)
     row_ids = np.repeat(np.arange(nr), lens)
@@ -275,19 +301,81 @@ def partition_rows(n, n_parts, grid=None, proc_grid=None):
     return (bx + px * (by + py * bz)).astype(np.int32), proc_grid
 
 
+def gather_row_entries(indptr, rsel):
+    """Entry ids of CSR rows ``rsel``, vectorized (repeat/cumsum — no
+    per-row Python loop; this sits on the setup hot path)."""
+    indptr = np.asarray(indptr, dtype=np.int64)
+    rsel = np.asarray(rsel, dtype=np.int64)
+    lens = (indptr[rsel + 1] - indptr[rsel]).astype(np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64), lens
+    starts = np.repeat(indptr[rsel], lens)
+    offs = np.arange(total, dtype=np.int64) - np.repeat(
+        np.concatenate([[0], np.cumsum(lens)[:-1]]), lens
+    )
+    return starts + offs, lens
+
+
+def block_csr_arrays(Asp, block_size):
+    """Scalar CSR (n*b square) -> block-row CSR arrays
+    (indptr, block col indices, values (nnzb, b, b)) — the host-side
+    BSR view the block partition consumes (reference block-CSR
+    matrix.h:65 layout)."""
+    b = int(block_size)
+    bsr = sps.bsr_matrix(Asp.tocsr(), blocksize=(b, b))
+    bsr.sort_indices()
+    return (
+        bsr.indptr.astype(np.int64),
+        bsr.indices.astype(np.int64),
+        np.asarray(bsr.data),
+    )
+
+
 def partition_matrix(
     Asp: sps.csr_matrix,
     n_parts: int,
     grid=None,
     proc_grid=None,
     owner=None,
+    block_size: int = 1,
 ) -> DistributedMatrix:
     """Partition + owned-first renumber + halo/exchange maps.
 
     ``grid``/``proc_grid`` opt into the px×py×pz slab partition;
     ``owner`` supplies an arbitrary precomputed partition vector
-    (reference partition-vector upload path).
+    (reference partition-vector upload path).  ``block_size`` b > 1
+    partitions at BLOCK-row granularity (reference distributed block
+    path): ``Asp`` is the scalar (n*b square) matrix, ``owner``/
+    ``grid`` describe block rows, and the device arrays carry b×b
+    blocks.
     """
+    if block_size > 1:
+        indptr, bcols, bvals = block_csr_arrays(Asp, block_size)
+        n = indptr.shape[0] - 1
+        if owner is None:
+            owner, proc_grid = partition_rows(
+                n, n_parts, grid, proc_grid
+            )
+        else:
+            owner = np.asarray(owner, dtype=np.int32)
+        local_of, counts, part_rows = local_numbering(owner, n_parts)
+        rows_pp = max(int(counts.max()), 1)
+        parts = []
+        for p in range(n_parts):
+            ent, lens = gather_row_entries(indptr, part_rows[p])
+            lptr = np.concatenate([[0], np.cumsum(lens)]).astype(
+                np.int64
+            )
+            parts.append(
+                localize_columns(
+                    lptr, bcols[ent], bvals[ent], owner,
+                    local_of, p, rows_pp,
+                )
+            )
+        return finalize_partition(
+            parts, owner, local_of, counts, n, n_parts, proc_grid
+        )
     n = Asp.shape[0]
     Asp = Asp.tocsr()
     Asp.sort_indices()
@@ -662,6 +750,8 @@ def finalize_partition(
     arrays; pad/unpad then require uniform contiguous blocks)."""
     rows_pp = max(int(counts.max()), 1)
     Adtype = parts[0]["vals"].dtype if parts else np.float64
+    bshape = np.asarray(parts[0]["vals"]).shape[1:] if parts else ()
+    block_size = bshape[0] if bshape else 1
 
     if owner_fn is None:
         owner_fn = lambda ids: owner[ids]
@@ -685,8 +775,8 @@ def finalize_partition(
         if lens.size:
             w = max(w, int(lens.max()))
     ell_cols = np.zeros((n_parts, rows_pp, w), dtype=np.int32)
-    ell_vals = np.zeros((n_parts, rows_pp, w), dtype=Adtype)
-    diag = np.zeros((n_parts, rows_pp), dtype=Adtype)
+    ell_vals = np.zeros((n_parts, rows_pp, w) + bshape, dtype=Adtype)
+    diag = np.zeros((n_parts, rows_pp) + bshape, dtype=Adtype)
     for p, part in enumerate(parts):
         ell_cols[p], ell_vals[p], diag[p] = part_ell_arrays(
             part, rows_pp, w, Adtype
@@ -710,7 +800,11 @@ def finalize_partition(
     # ---- Pallas windowed tiling of the interior rows (TPU) ----------
     wcols = wvals = wbase = None
     wwidth = None
-    if int_mask is not None and tiled_ell_wanted(Adtype):
+    if (
+        int_mask is not None
+        and block_size == 1
+        and tiled_ell_wanted(Adtype)
+    ):
         built = _build_interior_windowed(
             parts, ell_cols, ell_vals, int_mask, rows_pp, counts
         )
@@ -724,6 +818,7 @@ def finalize_partition(
         ell_cols=ell_cols,
         ell_vals=ell_vals,
         diag=diag,
+        block_size=block_size,
         int_mask=int_mask,
         own_mask=own_mask,
         bnd_rows=bnd_rows,

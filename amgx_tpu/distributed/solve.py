@@ -64,18 +64,25 @@ def _shard_params(A: DistributedMatrix):
 def exchange_halo(A: DistributedMatrix, shard, x_loc, axis):
     """halo values for x (reference exchange_halo_v2).  Runs inside
     shard_map; `shard` is the _shard_params dict with the leading
-    shard axis dropped."""
+    shard axis dropped.  Block vectors ([rows, b]) exchange whole
+    b-vectors per halo slot (reference block halo buffers)."""
+    blk = x_loc.ndim == 2
     if A.uses_ppermute:
         send_idx_d, halo_dir, halo_pos = shard["ex"]
-        halo = jnp.zeros((halo_pos.shape[0],), x_loc.dtype)
+        halo = jnp.zeros(
+            (halo_pos.shape[0],) + x_loc.shape[1:], x_loc.dtype
+        )
         for d, perm in enumerate(A.perms):
             buf = x_loc[send_idx_d[d]]
             recv = jax.lax.ppermute(buf, axis, perm=list(perm))
-            halo = jnp.where(halo_dir == d, recv[halo_pos], halo)
+            sel = halo_dir == d
+            if blk:
+                sel = sel[:, None]
+            halo = jnp.where(sel, recv[halo_pos], halo)
         return halo
     send_idx, hsp, hpos = shard["ex"]
     send = x_loc[send_idx]  # B2L gather
-    pool = jax.lax.all_gather(send, axis)  # [N, max_send]
+    pool = jax.lax.all_gather(send, axis)  # [N, max_send(, b)]
     return pool[hsp, hpos]
 
 
@@ -141,6 +148,40 @@ def make_local_spmv(A: DistributedMatrix, axis):
 
     def spmv(shard, x_loc):
         ell_cols, ell_vals = shard["ell"]
+        if A.block_size > 1:
+            # block SpMV (reference bsrmv, multiply.cu:49-71): one
+            # einsum contracts the b×b blocks — MXU-batched on TPU.
+            # Same interior/boundary overlap structure as scalar.
+            halo = exchange_halo(A, shard, x_loc, axis)
+            if "split" in shard:
+                int_mask, own_mask, bnd_rows = shard["split"]
+                nloc = x_loc.shape[0]
+                lc = jnp.minimum(ell_cols, nloc - 1)
+                yi = jnp.where(
+                    int_mask[:, None],
+                    jnp.einsum("rwij,rwj->ri", ell_vals, x_loc[lc]),
+                    0.0,
+                )
+                xf = jnp.concatenate([x_loc, halo])
+                if bnd_rows is not None:
+                    yb = jnp.einsum(
+                        "rwij,rwj->ri",
+                        ell_vals[bnd_rows],
+                        xf[ell_cols[bnd_rows]],
+                    )
+                    y = jnp.concatenate(
+                        [yi, jnp.zeros((1, yi.shape[1]), yi.dtype)]
+                    )
+                    y = y.at[bnd_rows].add(yb)
+                    return y[:nloc]
+                yb = jnp.where(
+                    (own_mask & ~int_mask)[:, None],
+                    jnp.einsum("rwij,rwj->ri", ell_vals, xf[ell_cols]),
+                    0.0,
+                )
+                return yi + yb
+            xf = jnp.concatenate([x_loc, halo])
+            return jnp.einsum("rwij,rwj->ri", ell_vals, xf[ell_cols])
         if "split" in shard:
             int_mask, own_mask, bnd_rows = shard["split"]
             halo = exchange_halo(A, shard, x_loc, axis)
@@ -200,7 +241,18 @@ def make_local_spmv(A: DistributedMatrix, axis):
 
 
 def _pdot(a, b, axis):
-    return jax.lax.psum(jnp.dot(a, b), axis)
+    # vdot flattens, so block vectors [rows, b] reduce correctly
+    return jax.lax.psum(jnp.vdot(a, b), axis)
+
+
+def _safe_block_inv(d):
+    """Batched b×b diagonal-block inverse with the scalar path's
+    zero-diagonal protection: singular blocks (inv -> inf/nan) fall
+    back to identity instead of poisoning the solve."""
+    inv = jnp.linalg.inv(d)
+    ok = jnp.isfinite(inv).all(axis=(-2, -1), keepdims=True)
+    eye = jnp.eye(d.shape[-1], dtype=d.dtype)
+    return jnp.where(ok, inv, eye)
 
 
 def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
@@ -211,10 +263,19 @@ def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
 
     def local_solve(sh, b_loc):
         diag = sh["diag"]
-        dinv = jnp.where(diag != 0, 1.0 / diag, 1.0)
+        if A.block_size > 1:
+            # block-Jacobi: batched b×b diagonal-block inverses
+            # (reference block_jacobi_solver.cu setup); padding rows
+            # carry identity blocks, and singular blocks fall back to
+            # identity (the scalar d==0 guard's block analogue)
+            dinv = _safe_block_inv(diag)
+            prec = lambda rr: jnp.einsum("rij,rj->ri", dinv, rr)
+        else:
+            dinv = jnp.where(diag != 0, 1.0 / diag, 1.0)
+            prec = lambda rr: dinv * rr
         x = jnp.zeros_like(b_loc)
         r = b_loc  # x0 = 0
-        z = dinv * r if preconditioned else r
+        z = prec(r) if preconditioned else r
         p = z
         rho = _pdot(r, z, axis)
         nrm0 = jnp.sqrt(_pdot(b_loc, b_loc, axis))
@@ -229,7 +290,7 @@ def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
             alpha = rho / _pdot(p, q, axis)
             x = x + alpha * p
             r = r - alpha * q
-            z = dinv * r if preconditioned else r
+            z = prec(r) if preconditioned else r
             rho_new = _pdot(r, z, axis)
             p = z + (rho_new / rho) * p
             nrm = jnp.sqrt(_pdot(r, r, axis))

@@ -133,3 +133,41 @@ def test_dist_amg_graded_consolidation():
         rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
         assert rel < 1e-7, rel
     assert abs(it1 - it2) <= 3, (it1, it2)
+
+
+def test_graded_collective_scope_is_active_tier():
+    """VERDICT r3 #7: collective bytes at graded levels scale with the
+    ACTIVE tier, not the full axis (reference sub-communicator scope,
+    glue.h:114,200).  The analytic model counts only listed ppermute
+    pairs; idle shards appear in none, and the tail glue is one O(ng)
+    psum per shard rather than an O(N*rows_pp) all_gather."""
+    Asp = poisson_3d_7pt(14).to_scipy()
+    s = DistributedAMG(
+        Asp, mesh1d(8), consolidate_rows=128, grade_lower=1200
+    )
+    stats = s.collective_stats()
+    lvls = stats["levels"]
+    assert lvls[0]["active_shards"] == 8
+    graded = [e for e in lvls if 0 < e["active_shards"] < 8]
+    assert graded, lvls  # a sub-mesh tier exists
+    fine = lvls[0]
+    for e in graded:
+        # per-level halo traffic shrinks at least proportionally to
+        # the active tier (fewer pairs AND smaller boundaries)
+        assert e["halo_bytes"] * 8 <= (
+            fine["halo_bytes"] * e["active_shards"]
+        ), (e, fine)
+    # single-leader levels exchange nothing
+    for e in lvls:
+        if e["active_shards"] == 1:
+            assert e["halo_bytes"] == 0, e
+    # tail glue is proportional to the tail size, not N * rows_pp
+    last = s.h.levels[-1].A
+    item = np.dtype(s.h.tail_matrix.data.dtype).itemsize
+    assert stats["tail_bytes_per_shard"] == (
+        s.h.tail_matrix.shape[0] * item
+    )
+    assert stats["tail_bytes_per_shard"] < (
+        last.n_parts * last.rows_per_part * item
+    ), (stats["tail_bytes_per_shard"], last.n_parts,
+        last.rows_per_part)

@@ -1,0 +1,216 @@
+"""Block (b>1) distributed layer (VERDICT r3 missing #2 / next #4).
+
+Reference parity: the distributed manager and SpMV are block-native
+throughout (multiply.cu:49-71 bsrmv dispatch, distributed block path
+in distributed_manager.cu); aggregation treats block rows as graph
+nodes (aggregation_amg_level.cu).  TPU shape: block ELL device arrays
+[N, rows, w, b, b], halo exchange at block-row granularity (messages
+carry b-vectors), einsum SpMV (MXU-batched blocks), batched
+block-Jacobi smoothing, aggregate-map ⊗ I_b transfers."""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+import scipy.sparse as sps
+from jax.sharding import Mesh
+
+from amgx_tpu.distributed.amg import DistributedAMG
+from amgx_tpu.distributed.partition import partition_matrix
+from amgx_tpu.distributed.solve import (
+    dist_pcg_jacobi,
+    dist_spmv_replicated_check,
+)
+from amgx_tpu.io.poisson import poisson_3d_7pt
+
+B_ = 4
+
+
+def mesh1d(n):
+    return Mesh(np.array(jax.devices()[:n]), ("x",))
+
+
+def block_poisson(n1d=8, coupled=True, seed=3):
+    """3D Poisson ⊗ B: SPD block system (b=4).  ``coupled`` uses a
+    dense SPD block (CFD-like intra-block coupling); otherwise I_b."""
+    L = poisson_3d_7pt(n1d).to_scipy().tocsr()
+    if coupled:
+        rng = np.random.default_rng(seed)
+        B = (
+            np.eye(B_)
+            + 0.2 * np.ones((B_, B_))
+            + np.diag(rng.random(B_))
+        )
+    else:
+        B = np.eye(B_)
+    return sps.kron(L, B, format="csr"), L.shape[0]
+
+
+def test_block_partition_spmv_exact():
+    A, n = block_poisson()
+    D = partition_matrix(A, 8, block_size=B_)
+    assert D.block_size == B_
+    assert D.ell_vals.shape[-2:] == (B_, B_)
+    assert D.diag.shape[-2:] == (B_, B_)
+    assert D.uses_ppermute
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(n * B_)
+    y = dist_spmv_replicated_check(D, x, mesh1d(8))
+    np.testing.assert_allclose(y, A @ x, rtol=1e-12)
+
+
+def test_block_pcg_iteration_parity():
+    """Distributed block-Jacobi PCG matches a serial numpy PCG with
+    the same block-diagonal preconditioner iteration-for-iteration."""
+    A, n = block_poisson()
+    D = partition_matrix(A, 8, block_size=B_)
+    rhs = np.ones(n * B_)
+    x, it, _ = dist_pcg_jacobi(D, rhs, mesh1d(8), max_iters=200,
+                               tol=1e-8)
+    rel = np.linalg.norm(rhs - A @ x) / np.linalg.norm(rhs)
+    assert rel < 1e-7, rel
+
+    Dblk = np.stack(
+        [A[i * B_:(i + 1) * B_, i * B_:(i + 1) * B_].toarray()
+         for i in range(n)]
+    )
+    Dinv = np.linalg.inv(Dblk)
+
+    def prec(r):
+        return np.einsum("rij,rj->ri", Dinv, r.reshape(n, B_)).ravel()
+
+    xk = np.zeros(n * B_)
+    r = rhs.copy()
+    z = prec(r)
+    p = z
+    rho = r @ z
+    nrm0 = np.linalg.norm(rhs)
+    its = 0
+    while its < 200 and np.linalg.norm(r) >= 1e-8 * nrm0:
+        q = A @ p
+        alpha = rho / (p @ q)
+        xk += alpha * p
+        r -= alpha * q
+        z = prec(r)
+        rho_new = r @ z
+        p = z + (rho_new / rho) * p
+        rho = rho_new
+        its += 1
+    assert abs(it - its) <= 1, (it, its)
+
+
+def test_block_amg_parity_with_serial_on_kron_identity():
+    """On L ⊗ I_b the block-row aggregation coincides with the serial
+    (scalar-expanded) aggregation per component, so the distributed
+    block AMG-PCG matches the serial AMG-PCG iteration count (+-2)."""
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.solvers import create_solver
+
+    A, n = block_poisson(12, coupled=False)
+    rhs = np.ones(n * B_)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        amg = DistributedAMG(
+            A, mesh1d(8), consolidate_rows=128, block_size=B_
+        )
+        x, it, _ = amg.solve(rhs, max_iters=100, tol=1e-8)
+    rel = np.linalg.norm(rhs - A @ x) / np.linalg.norm(rhs)
+    assert rel < 1e-6, rel
+    assert all(l.A.block_size == B_ for l in amg.h.levels)
+    assert len(amg.h.levels) >= 3
+
+    cfg = AMGConfig.from_string(
+        '{"config_version":2,"solver":{"scope":"main","solver":"PCG",'
+        '"max_iters":100,"tolerance":1e-08,'
+        '"convergence":"RELATIVE_INI","monitor_residual":1,'
+        '"preconditioner":{"scope":"amg","solver":"AMG",'
+        '"algorithm":"AGGREGATION","selector":"SIZE_2",'
+        '"smoother":{"scope":"jac","solver":"BLOCK_JACOBI",'
+        '"relaxation_factor":0.8,"monitor_residual":0},'
+        '"presweeps":1,"postsweeps":1,"max_iters":1,"cycle":"V",'
+        '"coarse_solver":"DENSE_LU_SOLVER","monitor_residual":0}}}'
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = create_solver(cfg, "default")
+        s.setup(SparseMatrix.from_scipy(A, block_size=B_))
+        res = s.solve(rhs)
+    assert int(res.status) == 0
+    assert abs(it - int(res.iters)) <= 2, (it, int(res.iters))
+
+
+def test_block_amg_beats_scalar_expansion_on_coupled_blocks():
+    """On a block-COUPLED system (dense SPD blocks) the block-row
+    aggregation hierarchy (reference semantics) converges far faster
+    than the serial scalar-expansion fallback — the reason AmgX is
+    block-native.  Pinned loosely: block path < half the scalarized
+    iteration count."""
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.solvers import create_solver
+
+    A, n = block_poisson(12, coupled=True)
+    rhs = np.ones(n * B_)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        amg = DistributedAMG(
+            A, mesh1d(8), consolidate_rows=128, block_size=B_
+        )
+        x, it, _ = amg.solve(rhs, max_iters=100, tol=1e-8)
+    rel = np.linalg.norm(rhs - A @ x) / np.linalg.norm(rhs)
+    assert rel < 1e-6, rel
+
+    cfg = AMGConfig.from_string(
+        '{"config_version":2,"solver":{"scope":"main","solver":"PCG",'
+        '"max_iters":200,"tolerance":1e-08,'
+        '"convergence":"RELATIVE_INI","monitor_residual":1,'
+        '"preconditioner":{"scope":"amg","solver":"AMG",'
+        '"algorithm":"AGGREGATION","selector":"SIZE_2",'
+        '"smoother":{"scope":"jac","solver":"BLOCK_JACOBI",'
+        '"relaxation_factor":0.8,"monitor_residual":0},'
+        '"presweeps":1,"postsweeps":1,"max_iters":1,"cycle":"V",'
+        '"coarse_solver":"DENSE_LU_SOLVER","monitor_residual":0}}}'
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = create_solver(cfg, "default")
+        s.setup(SparseMatrix.from_scipy(A, block_size=B_))
+        res = s.solve(rhs)
+    assert 2 * it < int(res.iters), (it, int(res.iters))
+
+
+def test_block_fgmres_outer():
+    """The FGMRES outer (the north-star solver) runs on block systems:
+    the Krylov basis follows the [rows, b] residual shape."""
+    A, n = block_poisson(8, coupled=True)
+    rhs = np.ones(n * B_)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        amg = DistributedAMG(
+            A, mesh1d(8), consolidate_rows=64, block_size=B_
+        )
+        x, it, _ = amg.solve(
+            rhs, max_iters=60, tol=1e-8, outer="fgmres"
+        )
+    rel = np.linalg.norm(rhs - A @ x) / np.linalg.norm(rhs)
+    assert rel < 1e-6, rel
+
+
+def test_block_shard_count_invariance():
+    """Partitioning does not change the block preconditioner quality:
+    the same iteration count on 2/4/8 shards."""
+    A, n = block_poisson(10, coupled=True)
+    rhs = np.ones(n * B_)
+    iters = []
+    for nparts in (2, 4, 8):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            amg = DistributedAMG(
+                A, mesh1d(nparts), consolidate_rows=64,
+                block_size=B_,
+            )
+            _, it, _ = amg.solve(rhs, max_iters=100, tol=1e-8)
+        iters.append(it)
+    assert max(iters) - min(iters) <= 2, iters
