@@ -13,6 +13,11 @@ from amgx_tpu.distributed.solve import (
     dist_pcg_jacobi,
     dist_spmv_replicated_check,
 )
+from amgx_tpu.distributed.eigen import (
+    dist_inverse_iteration,
+    dist_lanczos,
+    dist_power_iteration,
+)
 
 __all__ = [
     "DistributedMatrix",
@@ -20,4 +25,7 @@ __all__ = [
     "dist_cg",
     "dist_pcg_jacobi",
     "dist_spmv_replicated_check",
+    "dist_power_iteration",
+    "dist_lanczos",
+    "dist_inverse_iteration",
 ]

