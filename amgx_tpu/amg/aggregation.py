@@ -519,6 +519,96 @@ def _decompose_offset(off, nx, ny, nz, reach=3):
     return found[0]
 
 
+def _geo_rap_keys(block, decs):
+    """Static coarse-displacement keys of the geometric Galerkin
+    reduction, in a deterministic order shared with the device
+    program."""
+    bx, by, bz = block
+    keys = set()
+    for dx, dy, dz in decs:
+        for w in range(bz):
+            for v in range(by):
+                for u in range(bx):
+                    keys.add(
+                        ((u + dx) // bx, (v + dy) // by, (w + dz) // bz)
+                    )
+    return sorted(keys)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("grid", "block", "decs")
+)
+def _geo_rap_device(dia, grid, block, decs):
+    """Wrap check + windowed block reductions of the DIA diagonals as
+    one XLA program (the on-device face of geo_galerkin_dia — the
+    reference's csr_galerkin_product runs device-resident for the same
+    reason, csr_multiply.cu:207).
+
+    Returns (wrap_bad scalar, stacked coarse [n_keys, cz, cy, cx])
+    with keys ordered by _geo_rap_keys."""
+    nx, ny, nz = grid
+    bx, by, bz = block
+    cx, cy, cz = nx // bx, ny // by, nz // bz
+    fz = jax.lax.broadcasted_iota(jnp.int32, (nz, ny, nx), 0)
+    fy = jax.lax.broadcasted_iota(jnp.int32, (nz, ny, nx), 1)
+    fx = jax.lax.broadcasted_iota(jnp.int32, (nz, ny, nx), 2)
+    wrap_bad = jnp.bool_(False)
+    keys = _geo_rap_keys(block, decs)
+    accs = {
+        k: jnp.zeros((cz, cy, cx), dtype=dia.dtype) for k in keys
+    }
+    for ki, (dx, dy, dz) in enumerate(decs):
+        d3 = dia[ki].reshape(nz, ny, nx)
+        valid = (
+            (fx + dx >= 0) & (fx + dx < nx)
+            & (fy + dy >= 0) & (fy + dy < ny)
+            & (fz + dz >= 0) & (fz + dz < nz)
+        )
+        wrap_bad |= jnp.any(jnp.where(valid, 0.0, d3) != 0)
+        V = dia[ki].reshape(cz, bz, cy, by, cx, bx)
+        for w in range(bz):
+            DZ = (w + dz) // bz
+            for v in range(by):
+                DY = (v + dy) // by
+                for u in range(bx):
+                    DX = (u + dx) // bx
+                    accs[(DX, DY, DZ)] = (
+                        accs[(DX, DY, DZ)] + V[:, w, :, v, :, u]
+                    )
+    return wrap_bad, jnp.stack([accs[k] for k in keys])
+
+
+def _geo_rap_host(dia, grid, block, decs):
+    """Exact host-precision twin of :func:`_geo_rap_device` — used
+    when the device would downcast f64 (x64 disabled).  Same key
+    order (_geo_rap_keys), same math."""
+    nx, ny, nz = grid
+    bx, by, bz = block
+    cx, cy, cz = nx // bx, ny // by, nz // bz
+    fz, fy, fx = np.meshgrid(
+        np.arange(nz), np.arange(ny), np.arange(nx), indexing="ij"
+    )
+    keys = _geo_rap_keys(block, decs)
+    accs = {k: np.zeros((cz, cy, cx), dtype=dia.dtype) for k in keys}
+    for ki, (dx, dy, dz) in enumerate(decs):
+        valid = (
+            (fx + dx >= 0) & (fx + dx < nx)
+            & (fy + dy >= 0) & (fy + dy < ny)
+            & (fz + dz >= 0) & (fz + dz < nz)
+        )
+        if np.any(dia[ki].reshape(nz, ny, nx)[~valid] != 0):
+            return True, None
+        V = dia[ki].reshape(cz, bz, cy, by, cx, bx)
+        for w in range(bz):
+            DZ = (w + dz) // bz
+            for v in range(by):
+                DY = (v + dy) // by
+                for u in range(bx):
+                    DX = (u + dx) // bx
+                    accs[(DX, DY, DZ)] += V[:, w, :, v, :, u]
+    return False, np.stack([accs[k] for k in keys])
+
+
 def geo_galerkin_dia(Asp, grid, block):
     """Galerkin product R A P for piecewise-constant GEO aggregation on
     a stencil matrix — computed as dense reshape-reductions over the
@@ -560,38 +650,33 @@ def geo_galerkin_dia(Asp, grid, block):
     dia = np.zeros((offs_arr.shape[0], n), dtype=Asp.dtype)
     dia[k_all, rows_all] = Asp.data
 
-    # wrap detection: a genuine (dx,dy,dz) entry only exists at rows
-    # whose displaced position stays in-grid.  Periodic/wrap diagonals
-    # (e.g. +-(nx-1)) carry nonzeros at out-of-window rows — their
-    # geometric attribution would be wrong, so bail to sparse RAP.
-    fz, fy, fx = np.meshgrid(
-        np.arange(nz), np.arange(ny), np.arange(nx), indexing="ij"
+    # wrap detection + windowed block reductions run as ONE jitted
+    # device program (_geo_rap_device): on TPU the Galerkin reductions
+    # — the largest remaining setup stage — leave the host.  When the
+    # device would silently downcast f64 (x64 disabled, the usual TPU
+    # setting), keep the exact host reductions instead: the coarse
+    # operator's precision and the wrap check must not degrade.
+    decs = tuple(dec[int(off)] for off in offs_arr)
+    use_device = not (
+        np.dtype(Asp.dtype) == np.float64
+        and not jax.config.jax_enable_x64
     )
-    for ki, off in enumerate(offs_arr):
-        dx, dy, dz = dec[int(off)]
-        valid = (
-            (fx + dx >= 0) & (fx + dx < nx)
-            & (fy + dy >= 0) & (fy + dy < ny)
-            & (fz + dz >= 0) & (fz + dz < nz)
+    if use_device:
+        wrap_bad, stacked = _geo_rap_device(
+            jnp.asarray(dia), grid, (bx, by, bz), decs
         )
-        if np.any(dia[ki].reshape(nz, ny, nx)[~valid] != 0):
-            return None
-
-    coarse = {}
-    for ki, off in enumerate(offs_arr):
-        dx, dy, dz = dec[int(off)]
-        V = dia[ki].reshape(cz, bz, cy, by, cx, bx)
-        for w in range(bz):
-            DZ = (w + dz) // bz
-            for v in range(by):
-                DY = (v + dy) // by
-                for u in range(bx):
-                    DX = (u + dx) // bx
-                    acc = coarse.setdefault(
-                        (DX, DY, DZ),
-                        np.zeros((cz, cy, cx), dtype=Asp.dtype),
-                    )
-                    acc += V[:, w, :, v, :, u]
+        wrap_bad = bool(wrap_bad)
+        stacked = None if wrap_bad else np.asarray(stacked)
+    else:
+        wrap_bad, stacked = _geo_rap_host(
+            dia, grid, (bx, by, bz), decs
+        )
+    if wrap_bad:
+        # periodic/wrap diagonals (e.g. +-(nx-1)) carry nonzeros at
+        # out-of-window rows — geometric attribution would be wrong
+        return None
+    keys = _geo_rap_keys((bx, by, bz), decs)
+    coarse = {k: stacked[i] for i, k in enumerate(keys)}
 
     nc = cx * cy * cz
     Z, Y, X = np.meshgrid(
