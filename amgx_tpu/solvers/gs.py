@@ -35,7 +35,11 @@ class MulticolorGSSolver(Solver):
         self.deterministic = bool(cfg.get("determinism_flag", scope))
 
     def _setup_impl(self, A):
-        from amgx_tpu.solvers.dilu import _color_ell_slices
+        from amgx_tpu.solvers.dilu import (
+            _color_ell_slices,
+            _fori_sweep_wanted,
+            _stack_color_slices,
+        )
 
         A = scalarized(A, "MULTICOLOR_GS")
         colors = color_matrix(A, self.scheme, self.deterministic)
@@ -44,6 +48,26 @@ class MulticolorGSSolver(Solver):
         Asp = A.to_scipy().tocsr()
         slices = _color_ell_slices(Asp, rows_by_color)
         dinv = np.asarray(invert_diag(A))
+        n = A.n_rows
+        self._fori = _fori_sweep_wanted(nc, rows_by_color, slices)
+        if self._fori:
+            # stacked spill-padded slices -> one fori body (see
+            # dilu._FORI_MIN_COLORS: many-color deep hierarchies
+            # explode XLA compile time when unrolled)
+            rows_s, cols_s, vals_s = _stack_color_slices(
+                slices, rows_by_color, n
+            )
+            dinv_s = np.zeros(rows_s.shape, dtype=dinv.dtype)
+            for c, rows_c in enumerate(rows_by_color):
+                dinv_s[c, : len(rows_c)] = dinv[rows_c]
+            self._params = (
+                A,
+                (
+                    jnp.asarray(rows_s), jnp.asarray(cols_s),
+                    jnp.asarray(vals_s), jnp.asarray(dinv_s),
+                ),
+            )
+            return
         # params = (A, per-color slices): A first so the base monitored
         # loop's operator_of/spmv residual path keeps working
         self._params = (
@@ -60,9 +84,44 @@ class MulticolorGSSolver(Solver):
         )
 
     def make_step(self):
+        import jax
+
         omega = self.relaxation_factor
-        order = list(range(self.num_colors))
-        if self.symmetric:
+        nc = self.num_colors
+        symmetric = self.symmetric
+        if getattr(self, "_fori", False):
+            total = 2 * nc if symmetric else nc
+
+            def step(params, b, x):
+                rows_s, cols_s, vals_s, dinv_s = params[1]
+                n = x.shape[0]
+                x_ext = jnp.concatenate(
+                    [x, jnp.zeros((1,), x.dtype)]
+                )
+                b_ext = jnp.concatenate(
+                    [b, jnp.zeros((1,), b.dtype)]
+                )
+
+                def body(k, xe):
+                    c = jnp.where(k < nc, k, 2 * nc - 1 - k)
+                    rows_c = rows_s[c]
+                    # row sums include the diagonal term; dinv*(b-ax)+x
+                    # cancels it: dinv*(b-off-d*x)+x = dinv*(b-off)
+                    ax_c = jnp.sum(vals_s[c] * xe[cols_s[c]], axis=-1)
+                    gs = (
+                        dinv_s[c] * (b_ext[rows_c] - ax_c)
+                        + xe[rows_c]
+                    )
+                    return xe.at[rows_c].set(
+                        (1 - omega) * xe[rows_c] + omega * gs
+                    )
+
+                x_ext = jax.lax.fori_loop(0, total, body, x_ext)
+                return x_ext[:n]
+
+            return step
+        order = list(range(nc))
+        if symmetric:
             order = order + order[::-1]
 
         def step(params, b, x):

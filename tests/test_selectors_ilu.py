@@ -180,9 +180,16 @@ def test_dilu_linear_cost_structure():
     s = create_solver(_smoother("MULTICOLOR_DILU"), "default")
     s.setup(A)
     _A, Ls, Us, rows, _einv = s._params
-    stored = sum(
-        int((np.asarray(v) != 0).sum()) for _c, v in Ls
-    ) + sum(int((np.asarray(v) != 0).sum()) for _c, v in Us)
+    if getattr(s, "_fori", False):
+        # stacked spill-padded layout (many colors): same contract,
+        # padding slots are zero-valued
+        stored = int((np.asarray(Ls[1]) != 0).sum()) + int(
+            (np.asarray(Us[1]) != 0).sum()
+        )
+    else:
+        stored = sum(
+            int((np.asarray(v) != 0).sum()) for _c, v in Ls
+        ) + sum(int((np.asarray(v) != 0).sum()) for _c, v in Us)
     offdiag_nnz = A.nnz - A.n_rows
     assert stored == offdiag_nnz, (stored, offdiag_nnz)
 
@@ -317,3 +324,40 @@ def test_block_ilu_solves():
     x = np.asarray(res.x)
     rel = np.linalg.norm(rhs - A.to_scipy() @ x) / np.linalg.norm(rhs)
     assert rel < 1e-8, rel
+
+
+def test_fori_sweep_matches_unrolled():
+    """The stacked fori sweep and the unrolled per-color trace are the
+    SAME operation (padding contributes exact zeros): applying both
+    DILU and GS smoothers to the same residual must agree to float
+    tolerance, so neither branch can silently diverge."""
+    import jax.numpy as jnp
+
+    import amgx_tpu.solvers.dilu as dilu_mod
+    from amgx_tpu.io.poisson import poisson_2d_5pt
+
+    A = poisson_2d_5pt(16)
+    rng = np.random.default_rng(11)
+    r = jnp.asarray(rng.standard_normal(A.n_rows))
+
+    for name in ("MULTICOLOR_DILU", "MULTICOLOR_GS"):
+        s1 = create_solver(_smoother(name), "default")
+        s1.setup(A)
+        s2 = create_solver(_smoother(name), "default")
+        saved = dilu_mod._FORI_MIN_COLORS
+        dilu_mod._FORI_MIN_COLORS = 10**9  # force the unrolled branch
+        try:
+            s2.setup(A)
+        finally:
+            dilu_mod._FORI_MIN_COLORS = saved
+        assert getattr(s2, "_fori", False) is False
+        if not getattr(s1, "_fori", False):
+            continue  # coloring produced too few colors to compare
+        if name == "MULTICOLOR_DILU":
+            z1 = np.asarray(s1._apply_M_inv(s1._params, r))
+            z2 = np.asarray(s2._apply_M_inv(s2._params, r))
+        else:
+            x0 = jnp.zeros_like(r)
+            z1 = np.asarray(s1.make_step()(s1._params, r, x0))
+            z2 = np.asarray(s2.make_step()(s2._params, r, x0))
+        np.testing.assert_allclose(z1, z2, rtol=1e-13, atol=1e-13)
