@@ -127,6 +127,11 @@ register("interpolator", S, "D1", "", ("D1", "D2", "MULTIPASS", "EM"))
 register("energymin_interpolator", S, "EM", "")
 register("energymin_selector", S, "CR", "")
 register("selector", S, "PMIS", "coarse-grid selector")
+register("setup_location", S, "AUTO",
+         "classical setup placement: AUTO = device pipeline when the "
+         "config is covered (AHAT+PMIS+D1), HOST = scipy pipeline, "
+         "DEVICE = require the device pipeline",
+         ("AUTO", "HOST", "DEVICE"))
 register("aggressive_levels", I, 0, "aggressive-coarsening levels")
 register("aggressive_interpolator", S, "MULTIPASS", "")
 

@@ -1,0 +1,194 @@
+"""Opportunistic TPU hardware capture (VERDICT round-4 item #2).
+
+One self-contained shot: probe the axon tunnel in a subprocess (it
+hangs indefinitely when down — never touch jax.devices() in-process
+before the probe), then, if a TPU answers, measure
+
+  * pallas_dia  — numerics vs host reference + marginal SpMV seconds
+                  on a 64^3 7-point Poisson (DIA format),
+  * pallas_well — numerics vs host reference + marginal SpMV seconds
+                  on an RCM-windowed unstructured matrix,
+  * the XLA fallback DIA path for the same matrix (kernel-vs-XLA
+    delta on real hardware),
+
+and write a timestamped ``BENCH_tpu_<utc>.json`` at the repo root with
+``device: tpu``.  Exit codes: 0 = artifact written, 2 = tunnel down,
+3 = TPU answered but kernels unsupported (artifact still written with
+the XLA numbers).
+
+Driven by ``ci/tpu_capture_loop.sh`` which retries through the round.
+Perf contract being probed: the reference's tuned bsrmv path
+(/root/reference/src/amgx_cusparse.cu:49-102); BASELINE.json metric
+``spmv_gflops_per_chip``.
+"""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+
+def probe_tunnel(timeout_s=150):
+    code = (
+        "import amgx_tpu; amgx_tpu.initialize(); import jax; "
+        "d = jax.devices()[0]; "
+        "print('PROBE_OK', d.platform, getattr(d, 'device_kind', '?'))"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for ln in r.stdout.decode(errors="replace").splitlines():
+        if ln.startswith("PROBE_OK"):
+            toks = ln.split(maxsplit=2)
+            return {"platform": toks[1], "kind": toks[2] if len(toks) > 2 else "?"}
+    return None
+
+
+def _measure():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.io.poisson import poisson_3d_7pt
+    from amgx_tpu.ops import pallas_dia, pallas_well
+    from amgx_tpu.ops.reorder import maybe_reorder
+    from amgx_tpu.ops.spmv import spmv
+
+    dev = jax.devices()[0]
+    rec = {
+        "device": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+    }
+    rng = np.random.default_rng(0)
+
+    def marginal(fn, x0, n1=10, n2=60, reps=3):
+        """Marginal per-call seconds via two dependent chains."""
+        def chain(k):
+            @jax.jit
+            def run(x):
+                def body(i, x):
+                    return fn(x) * np.float32(0.125) + x0
+                return jax.lax.fori_loop(0, k, body, x)
+            return run
+        c1, c2 = chain(n1), chain(n2)
+        jax.device_get(c1(x0)); jax.device_get(c2(x0))  # compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter(); jax.device_get(c1(x0))
+            t1 = time.perf_counter(); jax.device_get(c2(x0))
+            t2 = time.perf_counter()
+            best = min(best, ((t2 - t1) - (t1 - t0)) / (n2 - n1))
+        return max(best, 1e-9)
+
+    # ---- DIA: 64^3 Poisson ----------------------------------------
+    A = poisson_3d_7pt(64, dtype=np.float32)
+    n, nnz = A.n_rows, A.nnz
+    x = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    dia_ok = bool(pallas_dia.pallas_dia_supported())
+    rec["pallas_dia_probe_ok"] = dia_ok
+    # host reference for numerics
+    ref = A.to_scipy() @ np.asarray(x)
+    if dia_ok and pallas_dia.dia_kernel_eligible(A):
+        y = np.asarray(pallas_dia.pallas_dia_spmv(A, x))
+        rec["pallas_dia_max_rel_err"] = float(
+            np.abs(y - ref).max() / (np.abs(ref).max() + 1e-30))
+        s = marginal(lambda v: pallas_dia.pallas_dia_spmv(A, v), x)
+        rec["pallas_dia_gflops"] = round(2.0 * nnz / s / 1e9, 2)
+        nd = len(A.dia_offsets)
+        bw = 4.0 * n * (nd + 2) / s
+        rec["pallas_dia_bytes_per_s"] = round(bw / 1e9, 1)
+    # XLA fallback on the same matrix
+    os.environ["AMGX_TPU_DISABLE_PALLAS_DIA"] = "1"
+    try:
+        s = marginal(lambda v: spmv(A, v), x)
+    finally:
+        os.environ.pop("AMGX_TPU_DISABLE_PALLAS_DIA", None)
+    rec["xla_dia_gflops"] = round(2.0 * nnz / s / 1e9, 2)
+
+    # roofline fraction against the device's HBM model
+    import bench
+    hbm = bench._hbm_bandwidth(dev)
+    rec["hbm_model_gbps"] = round(hbm / 1e9, 0)
+    if "pallas_dia_bytes_per_s" in rec:
+        rec["dia_fraction_of_hbm"] = round(
+            rec["pallas_dia_bytes_per_s"] * 1e9 / hbm, 3)
+
+    # ---- windowed-ELL: permuted Poisson + RCM ---------------------
+    sp = poisson_3d_7pt(40, dtype=np.float32).to_scipy().tocsr()
+    p = rng.permutation(sp.shape[0])
+    Au_raw = SparseMatrix.from_scipy(sp[p][:, p].tocsr(), dtype=np.float32)
+    Au, _ = maybe_reorder(Au_raw, "AUTO")
+    well_ok = bool(pallas_well.pallas_well_supported())
+    rec["pallas_well_probe_ok"] = well_ok
+    if well_ok and Au.ell_wcols is not None:
+        xu = jnp.asarray(
+            rng.standard_normal(Au.n_rows).astype(np.float32))
+        refu = Au.to_scipy() @ np.asarray(xu)
+        yu = np.asarray(pallas_well.pallas_well_spmv(Au, xu))
+        rec["pallas_well_max_rel_err"] = float(
+            np.abs(yu - refu).max() / (np.abs(refu).max() + 1e-30))
+        s = marginal(lambda v: pallas_well.pallas_well_spmv(Au, v), xu)
+        rec["pallas_well_gflops"] = round(2.0 * Au.nnz / s / 1e9, 2)
+        w = Au.ell_wwidth
+        rec["pallas_well_bytes_per_s"] = round(
+            4.0 * Au.n_rows * (2 * w + 2) / s / 1e9, 1)
+    return rec
+
+
+def main():
+    info = probe_tunnel()
+    if info is None or info["platform"] == "cpu":
+        print(f"tpu_capture: tunnel down ({info})", file=sys.stderr)
+        return 2
+    print(f"tpu_capture: TPU up: {info}", file=sys.stderr)
+    # run the measurement in a child so a kernel fault cannot wedge us
+    code = (
+        "import json, sys; sys.path.insert(0, %r); "
+        "from ci.tpu_capture import _measure; "
+        "print('CAP_JSON ' + json.dumps(_measure()))" % ROOT
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=900,
+            capture_output=True, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        print("tpu_capture: measurement timed out", file=sys.stderr)
+        return 2
+    sys.stderr.write(r.stderr.decode(errors="replace")[-4000:])
+    rec = None
+    for ln in r.stdout.decode(errors="replace").splitlines():
+        if ln.startswith("CAP_JSON "):
+            rec = json.loads(ln[len("CAP_JSON "):])
+    if rec is None:
+        print(f"tpu_capture: measurement failed rc={r.returncode}",
+              file=sys.stderr)
+        return 2
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    out = os.path.join(ROOT, f"BENCH_tpu_{stamp}.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(f"tpu_capture: wrote {out}", file=sys.stderr)
+    print(json.dumps(rec))
+    return 0 if rec.get("pallas_dia_probe_ok") else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

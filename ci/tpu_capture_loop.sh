@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Retry ci/tpu_capture.py through the round (VERDICT #2: capture
+# hardware numbers the moment a tunnel window opens).  Detached via
+# setsid; logs to ci/tpu_capture.log; stops after the first artifact
+# or after MAX_TRIES attempts.
+set -u
+cd "$(dirname "$0")/.."
+LOG=ci/tpu_capture.log
+MAX_TRIES=${MAX_TRIES:-24}
+SLEEP_S=${SLEEP_S:-1500}
+for i in $(seq 1 "$MAX_TRIES"); do
+  echo "[$(date -u +%FT%TZ)] attempt $i/$MAX_TRIES" >> "$LOG"
+  python ci/tpu_capture.py >> "$LOG" 2>&1
+  rc=$?
+  echo "[$(date -u +%FT%TZ)] attempt $i rc=$rc" >> "$LOG"
+  if [ "$rc" = "0" ] || [ "$rc" = "3" ]; then
+    echo "[$(date -u +%FT%TZ)] artifact captured; loop done" >> "$LOG"
+    exit 0
+  fi
+  sleep "$SLEEP_S"
+done
+echo "[$(date -u +%FT%TZ)] loop exhausted without a tunnel window" >> "$LOG"
+exit 2

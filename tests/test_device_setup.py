@@ -1,0 +1,163 @@
+"""Device-resident classical setup vs the host pipeline.
+
+Parity contract (VERDICT round-4 item #1): identical C/F splits,
+identical P/Ac sparsity patterns, values equal to roundoff, and pinned
+iteration parity for the headline classical config.  Reference
+pipeline being re-homed: strength/ahat.cu, selectors/pmis.cu,
+interpolators/distance1.cu, csr_multiply.cu:207.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+from amgx_tpu.amg import classical as host
+from amgx_tpu.amg import device_setup as dev
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.io.poisson import poisson_3d_7pt
+
+import jax.numpy as jnp
+
+
+def _coo_arrays(Asp):
+    A = Asp.tocsr()
+    n = A.shape[0]
+    rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(A.indptr))
+    size = dev._bucket(A.nnz)
+    r, c, v = dev._pad_coo(rows, A.indices.astype(np.int32), A.data,
+                           size, n)
+    return jnp.asarray(r), jnp.asarray(c), jnp.asarray(v), n
+
+
+def _problems(rng):
+    A1 = poisson_3d_7pt(8, dtype=np.float64).to_scipy().tocsr()
+    # random SPD-ish M-matrix with a few positive off-diagonals
+    n = 300
+    B = sps.random(n, n, density=0.02, random_state=np.random.RandomState(7))
+    B = B + B.T
+    A2 = (sps.eye(n) * (np.abs(B).sum(axis=1).max() + 1) - B).tocsr()
+    # nonsymmetric convection-diffusion-like
+    A3 = A1 + sps.diags_array(
+        rng.standard_normal(A1.shape[0] - 1) * 0.05, offsets=1,
+        shape=A1.shape,
+    ).tocsr()
+    return [A1, A2.tocsr(), A3.tocsr()]
+
+
+@pytest.mark.parametrize("pi", [0, 1, 2])
+def test_strength_parity(rng, pi):
+    Asp = _problems(rng)[pi]
+    theta, mrs = 0.25, 0.9
+    S_host = host.strength_ahat(Asp, theta, mrs)
+    rows, cols, vals, n = _coo_arrays(Asp)
+    strong = np.asarray(dev._strength_ahat_dev(
+        rows, cols, vals, n, theta, mrs))
+    # host S pattern == device strong entries of A
+    A = Asp.tocsr()
+    ridx = np.repeat(np.arange(n), np.diff(A.indptr))
+    got = sps.csr_matrix(
+        (strong[: A.nnz].astype(np.int8), (ridx, A.indices)),
+        shape=A.shape,
+    )
+    got.eliminate_zeros()
+    assert (got != S_host).nnz == 0
+
+
+@pytest.mark.parametrize("pi", [0, 1, 2])
+def test_pmis_parity(rng, pi):
+    Asp = _problems(rng)[pi]
+    S = host.strength_ahat(Asp, 0.25, 1.1)
+    cf_host = host.pmis_select(S)
+    rows, cols, vals, n = _coo_arrays(Asp)
+    strong = dev._strength_ahat_dev(rows, cols, vals, n, 0.25, 1.1)
+    import jax
+    lam = jax.ops.segment_sum(
+        strong.astype(jnp.float64), jnp.minimum(cols, n - 1),
+        num_segments=n,
+    )
+    w = lam + jnp.asarray(host._hash_weights(n, seed=0))
+    cf_dev = np.asarray(dev._pmis_dev(rows, cols, strong, n, w))
+    np.testing.assert_array_equal(cf_dev, cf_host)
+
+
+@pytest.mark.parametrize("pi", [0, 1, 2])
+def test_full_level_parity(rng, pi):
+    Asp = _problems(rng)[pi]
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main", '
+        '"solver": "AMG", "algorithm": "CLASSICAL", '
+        '"selector": "PMIS", "interpolator": "D1"}}'
+    )
+    assert dev.device_setup_eligible(cfg, "main", 0)
+    P_h, R_h, Ac_h = host.build_classical_level(Asp, cfg, "main", 0)
+    P_d, R_d, Ac_d = dev.build_classical_level_device(Asp, cfg, "main", 0)
+    assert P_d.shape == P_h.shape
+    # identical patterns
+    assert (abs(P_d) > 0).astype(int).toarray().tolist() == \
+        (abs(P_h) > 0).astype(int).toarray().tolist() if P_h.shape[0] < 600 \
+        else ((abs(P_d) > 0) != (abs(P_h) > 0)).nnz == 0
+    assert np.abs(P_d - P_h).max() < 1e-12
+    assert np.abs((R_d - R_h)).max() < 1e-12
+    # Ac: scipy's product may keep explicit zeros the ESC path also
+    # keeps; compare as dense-diff on values
+    assert Ac_d.shape == Ac_h.shape
+    assert abs(Ac_d - Ac_h).max() < 1e-11
+
+
+def test_headline_iteration_parity(rng):
+    """PCG + classical AMG (PMIS/D1): device-setup hierarchy must match
+    the host-setup hierarchy's iteration count exactly."""
+    from amgx_tpu.io.poisson import poisson_rhs
+    from amgx_tpu.solvers import create_solver
+
+    cfg_s = (
+        '{"config_version": 2, "solver": {"scope": "main", '
+        '"solver": "PCG", "max_iters": 100, "tolerance": 1e-8, '
+        '"convergence": "RELATIVE_INI_CORE", "monitor_residual": 1, '
+        '"preconditioner": {"scope": "amg", "solver": "AMG", '
+        '"algorithm": "CLASSICAL", "selector": "PMIS", '
+        '"interpolator": "D1", "smoother": {"scope": "j", '
+        '"solver": "BLOCK_JACOBI", "relaxation_factor": 0.8, '
+        '"monitor_residual": 0}, "max_iters": 1, "max_levels": 10, '
+        '"min_coarse_rows": 16, "coarse_solver": "DENSE_LU_SOLVER", '
+        '"monitor_residual": 0}}}'
+    )
+    A = poisson_3d_7pt(12, dtype=np.float64)
+    b = poisson_rhs(A.n_rows, dtype=np.float64)
+    iters = {}
+    for loc in ("HOST", "DEVICE"):
+        cfg = AMGConfig.from_string(cfg_s)
+        cfg.set("setup_location", loc, "amg")
+        s = create_solver(cfg, "default")
+        s.setup(A)
+        res = s.solve(b)
+        assert res.converged
+        iters[loc] = int(res.iters)
+    assert iters["DEVICE"] == iters["HOST"]
+
+
+def test_spgemm_device_random(rng):
+    """ESC SpGEMM vs scipy on random rectangular matrices."""
+    m, k, n = 37, 53, 29
+    A = sps.random(m, k, density=0.15,
+                   random_state=np.random.RandomState(3)).tocsr()
+    B = sps.random(k, n, density=0.2,
+                   random_state=np.random.RandomState(4)).tocsr()
+    ar = np.repeat(np.arange(m, dtype=np.int32), np.diff(A.indptr))
+    size_a = dev._bucket(A.nnz)
+    ra, ca, va = dev._pad_coo(ar, A.indices.astype(np.int32), A.data,
+                              size_a, m)
+    br = np.repeat(np.arange(k, dtype=np.int32), np.diff(B.indptr))
+    size_b = dev._bucket(B.nnz)
+    rb, cb, vb = dev._pad_coo(br, B.indices.astype(np.int32), B.data,
+                              size_b, k)
+    orow, ocol, oval, nnz = dev.spgemm_device(
+        jnp.asarray(ra), jnp.asarray(ca), jnp.asarray(va), m,
+        jnp.asarray(rb), jnp.asarray(cb), jnp.asarray(vb), k,
+    )
+    got = dev._coo_to_scipy(orow, ocol, oval, nnz, (m, n))
+    want = (A @ B).tocsr()
+    want.sort_indices()
+    assert abs(got - want).max() < 1e-13
+    # pattern identical (scipy keeps structural zeros; so does ESC)
+    assert got.nnz == want.nnz
