@@ -61,10 +61,13 @@ def _first_per_row(rows_sorted, n):
 
 
 def pairwise_match(W: sps.csr_matrix, merge_singletons: bool = True,
-                   max_rounds: int = 15):
+                   max_rounds: int = 15,
+                   max_unassigned: float = 0.0):
     """Deterministic pairwise matching via mutual-strongest-neighbour
     rounds (the handshaking scheme of the reference's size2 selector,
-    fully vectorized; max_rounds mirrors max_matching_iterations).
+    fully vectorized; max_rounds mirrors max_matching_iterations and
+    ``max_unassigned`` the max_unassigned_percentage early exit,
+    size2_selector.cu:621-625).
 
     Returns agg (n,) int32 aggregate ids 0..n_agg-1.
     """
@@ -82,6 +85,8 @@ def pairwise_match(W: sps.csr_matrix, merge_singletons: bool = True,
     partner = np.full(n, -1, dtype=np.int64)
     for _ in range(max_rounds):
         un = partner == -1
+        if max_unassigned > 0 and un.mean() <= max_unassigned:
+            break  # remaining rows join as merged singletons
         valid = un[rs] & un[cs]
         first = _first_per_row(rs[valid], n)
         # strongest available neighbour per unmatched vertex
@@ -260,22 +265,55 @@ def pairwise_match_device(W: sps.csr_matrix,
     return agg.astype(np.int32)
 
 
+def filter_edge_weights(W: sps.csr_matrix,
+                        alpha: float) -> sps.csr_matrix:
+    """Weak-edge filter (reference multi_pairwise.cu:931-945,
+    filter_weights=1): drop edges with w_ij < alpha * max_k w_ik
+    (symmetrized so the graph stays matchable both ways)."""
+    coo = W.tocoo()
+    rmax = np.zeros(W.shape[0])
+    np.maximum.at(rmax, coo.row, coo.data)
+    keep = (coo.data >= alpha * rmax[coo.row]) | (
+        coo.data >= alpha * rmax[coo.col]
+    )
+    Wf = sps.csr_matrix(
+        (np.where(keep, coo.data, 0.0), (coo.row, coo.col)),
+        shape=W.shape,
+    )
+    Wf.eliminate_zeros()
+    Wf.sort_indices()
+    return Wf
+
+
 def aggregate(Asp: sps.csr_matrix, passes: int, formula: int = 0,
-              merge_singletons: bool = True) -> np.ndarray:
+              merge_singletons: bool = True, max_rounds: int = 15,
+              filter_alpha: float = 0.0,
+              serial_matching: bool = False,
+              max_unassigned: float = 0.0) -> np.ndarray:
     """Compose `passes` pairwise matchings -> aggregates of size ~2^passes
-    (reference SIZE_2=1, SIZE_4=2, SIZE_8=3 passes)."""
+    (reference SIZE_2=1, SIZE_4=2, SIZE_8=3 passes).  ``max_rounds``
+    mirrors max_matching_iterations (size2_selector.cu:621);
+    ``filter_alpha`` > 0 applies the filter_weights weak-edge filter;
+    ``serial_matching`` forces the host matcher (multi_pairwise.cu
+    serial_matching)."""
     n = Asp.shape[0]
     agg = np.arange(n, dtype=np.int32)
     W = edge_weights(Asp, formula)
+    if filter_alpha > 0:
+        W = filter_edge_weights(W, filter_alpha)
     for p in range(passes):
         # large bounded-degree graphs match on device (XLA handshake
         # rounds — bit-identical to the host matcher); small/ragged
         # graphs stay on host where the numpy rounds are cheaper than
         # a compile
-        if W.shape[0] >= _DEVICE_MATCH_MIN_ROWS:
-            sub = pairwise_match_device(W, merge_singletons)
+        if (not serial_matching and max_unassigned <= 0
+                and W.shape[0] >= _DEVICE_MATCH_MIN_ROWS):
+            sub = pairwise_match_device(W, merge_singletons,
+                                        max_rounds=max_rounds)
         else:
-            sub = pairwise_match(W, merge_singletons)
+            sub = pairwise_match(W, merge_singletons,
+                                 max_rounds=max_rounds,
+                                 max_unassigned=max_unassigned)
         agg = sub[agg]
         if p + 1 < passes:
             nc = int(sub.max()) + 1
@@ -480,6 +518,13 @@ def select_aggregates(Asp, cfg, scope):
     passes = SELECTOR_PASSES.get(selector, 1)
     if passes is None:
         passes = int(cfg.get("aggregation_passes", scope))
+    if selector == "DUMMY":
+        # reference dummy.cu:51: aggregates[i] = i / aggregate_size
+        size = max(int(cfg.get("aggregate_size", scope)), 1)
+        agg = (np.arange(Asp.shape[0], dtype=np.int32) // size).astype(
+            np.int32
+        )
+        return _maybe_print_agg_info(cfg, scope, selector, agg), None
     if bool(cfg.get("structured_aggregation", scope)) or selector == "GEO":
         offs = stencil_offsets(Asp)
         grid = (
@@ -488,13 +533,54 @@ def select_aggregates(Asp, cfg, scope):
         if grid is not None:
             strengths = axis_strengths(Asp, *grid)
             block = geo_block_shape(*grid, passes, strengths)
+            agg = geo_aggregate(*grid, passes, strengths=strengths)
             return (
-                geo_aggregate(*grid, passes, strengths=strengths),
+                _maybe_print_agg_info(cfg, scope, selector, agg),
                 (grid, block),
             )
-    formula = int(cfg.get("weight_formula", scope))
+    # reference notay_weights=1 selects the Notay coupling formula
+    # (computeEdgeWeights weight_formula branch)
+    formula = (
+        1 if bool(cfg.get("notay_weights", scope))
+        else int(cfg.get("weight_formula", scope))
+    )
     merge = bool(cfg.get("merge_singletons", scope))
-    return aggregate(Asp, passes, formula, merge), None
+    max_rounds = int(cfg.get("max_matching_iterations", scope))
+    filter_alpha = (
+        float(cfg.get("filter_weights_alpha", scope))
+        if bool(cfg.get("filter_weights", scope)) else 0.0
+    )
+    serial = bool(cfg.get("serial_matching", scope))
+    # max_unassigned_percentage early exit is honored only when the
+    # config sets it: the registry default (0.05) is a reference-GPU
+    # tuning; the deterministic handshake converges in few rounds and
+    # an unconditional 5% early-out would change aggregates for every
+    # existing config
+    max_un = (
+        float(cfg.get("max_unassigned_percentage", scope))
+        if cfg.has("max_unassigned_percentage", scope) else 0.0
+    )
+    agg = aggregate(Asp, passes, formula, merge, max_rounds=max_rounds,
+                    filter_alpha=filter_alpha, serial_matching=serial,
+                    max_unassigned=max_un)
+    return _maybe_print_agg_info(cfg, scope, selector, agg), None
+
+
+def _maybe_print_agg_info(cfg, scope, selector, agg):
+    """print_aggregation_info (reference aggregation selectors'
+    printAggregationInfo): aggregate count + size histogram."""
+    if bool(cfg.get("print_aggregation_info", scope)):
+        from amgx_tpu.core.printing import emit
+
+        nc = int(agg.max()) + 1 if agg.size else 0
+        sizes = np.bincount(agg, minlength=max(nc, 1))
+        emit(
+            f"         Aggregation [{selector}]: {nc} aggregates over "
+            f"{agg.shape[0]} rows; avg size "
+            f"{agg.shape[0] / max(nc, 1):.2f}, max {int(sizes.max())}, "
+            f"singletons {int((sizes == 1).sum())}"
+        )
+    return agg
 
 
 # above this row count the dense-reduction Galerkin replaces the
@@ -715,6 +801,15 @@ def build_aggregation_level(Asp, cfg, scope):
     coarseAGenerator computeAOperator).  Geometric aggregations compute
     the Galerkin product via dense diagonal reductions
     (geo_galerkin_dia) instead of sparse-sparse products."""
+    # reference coarseAgenerator (coarse_A_generator.cu factory): both
+    # registered generators (LOW_DEG hash SpGEMM, GALERKIN cusp product)
+    # compute the same R A P; here one device/scipy Galerkin serves both
+    # names, unknown names fail like the reference factory
+    gen = str(cfg.get("coarseAgenerator", scope)).upper()
+    if gen not in ("", "LOW_DEG", "GALERKIN", "THRUST", "DEFAULT"):
+        raise KeyError(
+            f"CoarseAGeneratorFactory '{gen}' has not been registered"
+        )
     agg, geo_info = select_aggregates(Asp, cfg, scope)
     n = Asp.shape[0]
     nc = int(agg.max()) + 1

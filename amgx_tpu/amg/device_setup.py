@@ -246,6 +246,101 @@ def _transpose_dev(rows, cols, vals, n_rows_sentinel, n_cols):
 
 
 # ----------------------------------------------------------------------
+# sorted-pair lookup (binary search on (row, col) without 64-bit keys)
+
+
+@jax.jit
+def _lookup_sorted_pairs(qrows, qcols, rows, cols):
+    """For each query (qrows[t], qcols[t]) find its index in the
+    (row, col)-sorted COO arrays, or -1 when absent.  Lexicographic
+    binary search — int32-safe (no combined 64-bit key)."""
+    m = rows.shape[0]
+
+    def lt(r1, c1, r2, c2):  # (r1,c1) < (r2,c2)
+        return (r1 < r2) | ((r1 == r2) & (c1 < c2))
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        go_right = lt(rows[mid], cols[mid], qrows, qcols)
+        return jnp.where(go_right, mid + 1, lo), jnp.where(
+            go_right, hi, mid)
+
+    lo = jnp.zeros(qrows.shape, jnp.int32)
+    hi = jnp.full(qrows.shape, m, jnp.int32)
+    steps = int(m).bit_length()
+    lo, _ = lax.fori_loop(0, steps, body, (lo, hi))
+    safe = jnp.minimum(lo, m - 1)
+    hit = (rows[safe] == qrows) & (cols[safe] == qcols)
+    return jnp.where(hit, safe, -1)
+
+
+# ----------------------------------------------------------------------
+# interpolation truncation (reference truncate.cu + interp_max_elements)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "apply_trunc",
+                                             "max_el"))
+def _truncate_weights_dev(prow, pcol, pval, n, trunc, apply_trunc,
+                          max_el):
+    """Per-entry keep mask + rescaled values for the row-sorted P COO
+    (same semantics as the host ``truncate_interp``: drop below
+    trunc*max|row|, keep the max_el largest per row with the host's
+    stable original-position tie-break, rescale to preserve row sums)."""
+    valid = prow < n
+    rs = jnp.minimum(prow, n - 1)
+    absd = jnp.where(valid, jnp.abs(pval), 0.0)
+    keep = valid
+    if apply_trunc:
+        rmax = jax.ops.segment_max(
+            absd, prow, num_segments=n + 1, indices_are_sorted=True
+        )[:n]
+        keep &= absd >= trunc * rmax[rs]
+    if max_el >= 0:
+        # rank within row by descending |val|, original position as the
+        # stable tie-break (host np.lexsort((arange, -absd, rows)))
+        pos = jnp.arange(prow.shape[0], dtype=jnp.int32)
+        srow, _, spos = lax.sort((prow, -absd, pos), num_keys=3)
+        indptr = jnp.searchsorted(
+            srow, jnp.arange(n + 1, dtype=srow.dtype), side="left")
+        rank_sorted = jnp.arange(prow.shape[0], dtype=jnp.int32) - \
+            indptr[jnp.minimum(srow, n - 1)].astype(jnp.int32)
+        rank = jnp.zeros(prow.shape[0], jnp.int32).at[spos].set(
+            rank_sorted)
+        keep &= rank < max_el
+    rs_old = jax.ops.segment_sum(
+        jnp.where(valid, pval, 0.0), prow,
+        num_segments=n + 1, indices_are_sorted=True)[:n]
+    rs_new = jax.ops.segment_sum(
+        jnp.where(keep, pval, 0.0), prow,
+        num_segments=n + 1, indices_are_sorted=True)[:n]
+    scale = jnp.where(rs_new != 0,
+                      rs_old / jnp.where(rs_new != 0, rs_new, 1.0), 1.0)
+    newval = pval * keep * scale[rs]
+    keep &= newval != 0  # eliminate_zeros parity
+    return keep, newval
+
+
+def truncate_interp_device(prow, pcol, pval, nnzP, n, trunc, max_el):
+    """Device truncation; returns compacted row-sorted COO + nnz."""
+    apply_trunc = trunc < 1.0
+    if (not apply_trunc and max_el < 0) or nnzP == 0:
+        return prow, pcol, pval, nnzP
+    keep, newval = _truncate_weights_dev(
+        prow, pcol, pval, n, trunc, apply_trunc, int(max_el))
+    nnz = int(keep.sum())  # scalar sync
+    out = _bucket(nnz)
+    posk = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    slot = jnp.where(keep, posk, out)
+    orow = jnp.full((out,), n, jnp.int32).at[slot].set(
+        prow, mode="drop")
+    ocol = jnp.zeros((out,), jnp.int32).at[slot].set(pcol, mode="drop")
+    oval = jnp.zeros((out,), pval.dtype).at[slot].set(
+        newval, mode="drop")
+    return orow, ocol, oval, nnz
+
+
+# ----------------------------------------------------------------------
 # ESC SpGEMM
 
 
@@ -336,6 +431,265 @@ def spgemm_device(a_rows, a_cols, a_vals, n_left,
 
 
 # ----------------------------------------------------------------------
+# COO utilities shared by the aggressive / D2 paths
+
+
+@functools.partial(jax.jit, static_argnames=("n_left",))
+def _sort_first_dev(rows, cols, vals, n_left):
+    rows, cols, vals = lax.sort((rows, cols, vals), num_keys=2)
+    valid = rows < n_left
+    first = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1]),
+    ]) & valid
+    return rows, cols, vals, first, first.sum()
+
+
+def coalesce_coo_device(rows, cols, vals, n_left):
+    """Sort by (row, col) and sum duplicates; returns padded sorted COO
+    + exact nnz (one scalar sync)."""
+    rows, cols, vals, first, nnz_dev = _sort_first_dev(
+        rows, cols, vals, n_left)
+    nnz = int(nnz_dev)
+    out = _bucket(nnz)
+    return (*_spgemm_compress_dev(rows, cols, vals, first, out, n_left),
+            nnz)
+
+
+@functools.partial(jax.jit, static_argnames=("out_size",))
+def _compact_coo_dev(rows, cols, vals, keep, out_size, sentinel_row):
+    """Compact masked COO entries into a padded buffer, preserving
+    order (entries must already be (row, col)-sorted)."""
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    slot = jnp.where(keep, pos, out_size)
+    orow = jnp.full((out_size,), sentinel_row, jnp.int32).at[slot].set(
+        rows, mode="drop")
+    ocol = jnp.zeros((out_size,), jnp.int32).at[slot].set(
+        cols, mode="drop")
+    oval = jnp.zeros((out_size,), vals.dtype).at[slot].set(
+        vals, mode="drop")
+    return orow, ocol, oval
+
+
+def _compact_masked(rows, cols, vals, keep, sentinel_row):
+    nnz = int(keep.sum())  # scalar sync
+    out = _bucket(nnz)
+    r, c, v = _compact_coo_dev(rows, cols, vals, keep, out,
+                               sentinel_row)
+    return r, c, v, nnz
+
+
+# ----------------------------------------------------------------------
+# aggressive two-stage PMIS (reference selectors AGGRESSIVE_PMIS)
+
+
+def aggressive_pmis_device(rows, cols, vals, strong, n, dtype):
+    """Two-stage aggressive coarsening: PMIS on S, then PMIS (seed 1)
+    among the stage-1 C points on the distance-2 graph S + S@S —
+    bit-compatible with the host ``aggressive_pmis_select``."""
+    fdt = jnp.float64 if dtype == np.float64 else jnp.float32
+    lam = jax.ops.segment_sum(
+        strong.astype(fdt), jnp.minimum(cols, n - 1), num_segments=n)
+    w0 = lam + jnp.asarray(_hash_weights(n, seed=0), fdt)
+    cf1 = _pmis_dev(rows, cols, strong, n, w0).astype(jnp.int32)
+    nc1 = int(cf1.sum())  # scalar sync
+    if nc1 <= 1:
+        return cf1.astype(jnp.int8), nc1
+    # S as explicit COO (binary values)
+    ones = jnp.ones(rows.shape, fdt)
+    srow, scol, sval, nnzS = _compact_masked(rows, cols, ones, strong, n)
+    # S2 = S U S@S
+    ss = spgemm_device(srow, scol, sval, n, srow, scol, sval, n)
+    r2 = jnp.concatenate([srow, ss[0]])
+    c2 = jnp.concatenate([scol, ss[1]])
+    v2 = jnp.concatenate([sval, jnp.ones(ss[0].shape, fdt)])
+    r2, c2, v2, nnz2 = coalesce_coo_device(r2, c2, v2, n)
+    # restrict to C x C, drop diagonal, renumber by cmap1
+    cmap1 = jnp.cumsum(cf1) - 1
+    rs2 = jnp.minimum(r2, n - 1)
+    cs2 = jnp.minimum(c2, n - 1)
+    keepC = (r2 < n) & (cf1[rs2] == 1) & (cf1[cs2] == 1) & (r2 != c2)
+    rc = jnp.where(keepC, cmap1[rs2], nc1).astype(jnp.int32)
+    cc = jnp.where(keepC, cmap1[cs2], 0).astype(jnp.int32)
+    crow, ccol, cvalv, nnzC = _compact_masked(rc, cc, v2, keepC, nc1)
+    edgeC = crow < nc1
+    lam2 = jax.ops.segment_sum(
+        edgeC.astype(fdt), jnp.minimum(ccol, nc1 - 1), num_segments=nc1)
+    w2 = lam2 + jnp.asarray(_hash_weights(nc1, seed=1), fdt)
+    cf2 = _pmis_dev(crow, ccol, edgeC, nc1, w2)
+    # scatter back: final C = stage-1 C that survived stage 2
+    cf = (cf1 == 1) & (cf2.astype(jnp.int32)[
+        jnp.minimum(cmap1, nc1 - 1)] == 1)
+    return cf.astype(jnp.int8), int(cf.sum())
+
+
+# ----------------------------------------------------------------------
+# multipass interpolation (reference interpolators/multipass.cu)
+
+
+def multipass_interpolation_device(rows, cols, vals, strong, cf, n,
+                                   max_passes=10):
+    """Pass-k F rows interpolate through strong assigned neighbours'
+    P rows (same recurrence as the host ``multipass_interpolation``;
+    each pass is one ESC SpGEMM of the scaled strong-assigned slice
+    with the current P)."""
+    valid = rows < n
+    rs = jnp.minimum(rows, n - 1)
+    cs = jnp.minimum(cols, n - 1)
+
+    def seg(x):
+        return jax.ops.segment_sum(
+            x, rows, num_segments=n + 1, indices_are_sorted=True)[:n]
+
+    diag = seg(jnp.where(valid & (rows == cols), vals, 0.0))
+    row_total = seg(jnp.where(valid, vals, 0.0)) - diag
+    strongm = strong & (rows != cols)
+    cf_b = cf.astype(jnp.int32)
+    cmap = jnp.cumsum(cf_b) - 1
+    nc = int(cf_b.sum())
+    assigned = cf_b == 1
+
+    # P starts as the C-point identity block
+    node = jnp.arange(n, dtype=jnp.int32)
+    isC = cf_b == 1
+    p_size = _bucket(nc)
+    posc = jnp.cumsum(isC) - 1
+    slotc = jnp.where(isC, posc, p_size)
+    prow = jnp.full((p_size,), n, jnp.int32).at[slotc].set(
+        node, mode="drop")
+    pcol = jnp.zeros((p_size,), jnp.int32).at[slotc].set(
+        cmap, mode="drop")
+    pval = jnp.zeros((p_size,), vals.dtype).at[slotc].set(
+        jnp.ones((n,), vals.dtype), mode="drop")
+    nnzP = nc
+
+    for _ in range(max_passes):
+        # ready: unassigned rows with a strong assigned neighbour
+        pat = seg(jnp.where(strongm & assigned[cs], 1.0, 0.0)) > 0
+        ready = (~assigned) & pat
+        n_ready = int(ready.sum())  # scalar sync
+        if n_ready == 0:
+            break
+        picked = strongm & ready[rs] & assigned[cs]
+        strong_sum = seg(jnp.where(picked, vals, 0.0))
+        atil = diag + (row_total - strong_sum)
+        atil = jnp.where(atil != 0, atil, 1.0)
+        wvals = jnp.where(picked, -vals / atil[rs], 0.0)
+        wr, wc, wv, nnzW = _compact_masked(rows, cols, wvals, picked, n)
+        wp = spgemm_device(wr, wc, wv, n, prow, pcol, pval, n)
+        # new rows are disjoint from existing P rows: concat + sort
+        r3 = jnp.concatenate([prow, wp[0]])
+        c3 = jnp.concatenate([pcol, wp[1]])
+        v3 = jnp.concatenate([pval, wp[2]])
+        prow, pcol, pval, nnzP = coalesce_coo_device(r3, c3, v3, n)
+        assigned = assigned | ready
+    return prow, pcol, pval, nnzP, nc
+
+
+# ----------------------------------------------------------------------
+# distance-2 "standard" interpolation (reference interpolators/
+# distance2.cu, 2274 LoC; hypre BoomerAMG standard formulation)
+
+
+def standard_interpolation_device(rows, cols, vals, strong, cf, n,
+                                  dtype):
+    """D2 interpolation on device.  Same algebra as the host
+    ``standard_interpolation``, expressed over n-space COO slices with
+    ESC products; the pair-dependent denominators d_ik are entries of
+    (T @ A_FC_neg^T) sampled on the strong-F-F pattern by lexicographic
+    binary search (no 64-bit keys)."""
+    valid = rows < n
+    rs = jnp.minimum(rows, n - 1)
+    cs = jnp.minimum(cols, n - 1)
+    cf_b = cf.astype(jnp.int32)
+    cmap = jnp.cumsum(cf_b) - 1
+    nc = int(cf_b.sum())  # scalar sync
+    isF_r = cf_b[rs] == 0
+    isC_c = cf_b[cs] == 1
+    isF_c = cf_b[cs] == 0
+    offd = valid & (rows != cols)
+
+    def seg(x):
+        return jax.ops.segment_sum(
+            x, rows, num_segments=n + 1, indices_are_sorted=True)[:n]
+
+    diag = seg(jnp.where(valid & (rows == cols), vals, 0.0))
+
+    m_sfc = valid & strong & isF_r & isC_c
+    m_sff = offd & strong & isF_r & isF_c
+    m_afc = valid & isF_r & isC_c
+    # sign restriction: redistribution uses entries opposite in sign to
+    # the row diagonal (host keep_neg)
+    m_neg = m_afc & (vals * diag[rs] < 0)
+
+    fr, fc_, fv, nnz_fc = _compact_masked(rows, cols, vals, m_sfc, n)
+    gr, gc, gv, nnz_ff = _compact_masked(rows, cols, vals, m_sff, n)
+    hr, hc, hv, nnz_neg = _compact_masked(rows, cols, vals, m_neg, n)
+
+    one = jnp.ones
+    # T = SFCb U SFFb @ SFCb   (binary patterns)
+    sfc1 = one(fr.shape, fv.dtype) * (fr < n)
+    sff1 = one(gr.shape, gv.dtype) * (gr < n)
+    tprod = spgemm_device(gr, gc, sff1, n, fr, fc_, sfc1, n)
+    tr = jnp.concatenate([fr, tprod[0]])
+    tc = jnp.concatenate([fc_, tprod[1]])
+    tv = jnp.concatenate([sfc1, one(tprod[0].shape, fv.dtype)])
+    tr, tc, tv, nnzT = coalesce_coo_device(tr, tc, tv, n)
+    tbin = jnp.where(tr < n, one(tr.shape, fv.dtype), 0.0)
+
+    # E = T @ A_FC_neg^T ; d_ik sampled at SFF entries
+    ntr, ntc, ntv = _transpose_dev(hr, hc, hv, n, n)
+    E = spgemm_device(tr, tc, tbin, n, ntr, ntc, ntv, n)
+    d_idx = _lookup_sorted_pairs(gr, gc, E[0], E[1])
+    d_vals = jnp.where(d_idx >= 0, E[2][jnp.maximum(d_idx, 0)], 0.0)
+    d_vals = jnp.where(gr < n, d_vals, 0.0)
+
+    b_vals = jnp.where(d_vals != 0,
+                       gv / jnp.where(d_vals != 0, d_vals, 1.0), 0.0)
+    # B @ A_FC_neg
+    ba = spgemm_device(gr, gc, b_vals, n, hr, hc, hv, n)
+    # Wnum = (AsFC + B @ A_FC_neg) masked to T
+    wr = jnp.concatenate([fr, ba[0]])
+    wc = jnp.concatenate([fc_, ba[1]])
+    wv = jnp.concatenate([fv, ba[2]])
+    wr, wc, wv, nnzW = coalesce_coo_device(wr, wc, wv, n)
+    t_idx = _lookup_sorted_pairs(wr, wc, tr, tc)
+    inT = (t_idx >= 0) & (wr < n)
+
+    # modified diagonal
+    row_total = seg(jnp.where(valid, vals, 0.0)) - diag
+    strong_sum = seg(jnp.where(m_sfc | m_sff, vals, 0.0))
+    weak_sum = row_total - strong_sum
+    undis = jax.ops.segment_sum(
+        jnp.where((d_vals == 0) & (gr < n), gv, 0.0),
+        jnp.minimum(gr, n - 1), num_segments=n)
+    atil = diag + weak_sum + undis
+    atil = jnp.where(atil != 0, atil, 1.0)
+    wv = jnp.where(inT, -wv / atil[jnp.minimum(wr, n - 1)], 0.0)
+
+    # assemble P: F rows from Wnum(T), C identity
+    nnzWk = int(inT.sum())  # scalar sync
+    p_size = _bucket(nnzWk + nc)
+    posw = jnp.cumsum(inT.astype(jnp.int32)) - 1
+    slotw = jnp.where(inT, posw, p_size)
+    prow = jnp.full((p_size,), n, jnp.int32).at[slotw].set(
+        wr, mode="drop")
+    pcol = jnp.zeros((p_size,), jnp.int32).at[slotw].set(
+        cmap[jnp.minimum(wc, n - 1)], mode="drop")
+    pval = jnp.zeros((p_size,), wv.dtype).at[slotw].set(
+        wv, mode="drop")
+    node = jnp.arange(n, dtype=jnp.int32)
+    isC = cf_b == 1
+    posc = jnp.cumsum(isC) - 1
+    slotc = jnp.where(isC, nnzWk + posc, p_size)
+    prow = prow.at[slotc].set(node, mode="drop")
+    pcol = pcol.at[slotc].set(cmap, mode="drop")
+    pval = pval.at[slotc].set(jnp.ones((n,), wv.dtype), mode="drop")
+    prow, pcol, pval = lax.sort((prow, pcol, pval), num_keys=2)
+    return prow, pcol, pval, nnzWk + nc, nc
+
+
+# ----------------------------------------------------------------------
 # orchestration
 
 
@@ -351,16 +705,19 @@ def device_setup_eligible(cfg, scope, level_id: int,
     strength = str(cfg.get("strength", scope)).upper()
     selector = str(cfg.get("selector", scope)).upper()
     interp = str(cfg.get("interpolator", scope)).upper()
-    trunc = float(cfg.get("interp_truncation_factor", scope))
-    max_el = int(cfg.get("interp_max_elements", scope))
     aggressive_levels = int(cfg.get("aggressive_levels", scope))
+    aggressive = (
+        level_id < aggressive_levels
+        or selector in ("AGGRESSIVE_PMIS", "AGGRESSIVE_HMIS")
+    )
+    if aggressive:
+        # aggressive stage: two-stage PMIS + MULTIPASS on device
+        # (AGGRESSIVE_HMIS uses the PMIS-based stage like the host)
+        return strength == "AHAT"
     return (
         strength == "AHAT"
         and selector == "PMIS"
-        and interp == "D1"
-        and trunc >= 1.0
-        and max_el < 0
-        and level_id >= aggressive_levels
+        and interp in ("D1", "D2", "STD", "STANDARD")
     )
 
 
@@ -382,10 +739,23 @@ def build_classical_level_device(Asp, cfg, scope, level_id: int = 0):
     host/device timing profile in ``last_profile``.  Raises nothing:
     callers gate on :func:`device_setup_eligible`.
     """
+    import warnings
+
     global last_profile
     prof = {"host_s": 0.0, "device_s": 0.0, "syncs": 0}
     theta = float(cfg.get("strength_threshold", scope))
     max_row_sum = float(cfg.get("max_row_sum", scope))
+    selector = str(cfg.get("selector", scope)).upper()
+    interp = str(cfg.get("interpolator", scope)).upper()
+    trunc = float(cfg.get("interp_truncation_factor", scope))
+    max_el = int(cfg.get("interp_max_elements", scope))
+    aggressive_levels = int(cfg.get("aggressive_levels", scope))
+    aggressive_interp = str(
+        cfg.get("aggressive_interpolator", scope)).upper()
+    aggressive = (
+        level_id < aggressive_levels
+        or selector in ("AGGRESSIVE_PMIS", "AGGRESSIVE_HMIS")
+    )
 
     t0 = time.perf_counter()
     A = Asp.tocsr()
@@ -396,35 +766,55 @@ def build_classical_level_device(Asp, cfg, scope, level_id: int = 0):
     r_np, c_np, v_np = _pad_coo(
         rows_np, A.indices.astype(np.int32), A.data, size, n
     )
-    # deterministic f64 tie-break weights (host helper, O(n) elwise;
-    # seed=0 matches the host pmis_select stage-0 seed exactly)
-    w = _hash_weights(n, seed=0)
     prof["host_s"] += time.perf_counter() - t0
 
     t0 = time.perf_counter()
     rows = jnp.asarray(r_np)
     cols = jnp.asarray(c_np)
     vals = jnp.asarray(v_np)
+    fdt = jnp.float64 if vals.dtype == jnp.float64 else jnp.float32
     strong = _strength_ahat_dev(rows, cols, vals, n, theta, max_row_sum)
-    # PMIS weights: S^T degree + hash (f64, identical to host)
-    lam = jax.ops.segment_sum(
-        strong.astype(jnp.float64 if vals.dtype == jnp.float64
-                      else jnp.float32),
-        jnp.minimum(cols, n - 1), num_segments=n,
-    )
-    wdev = lam + jnp.asarray(w, lam.dtype)
-    cf = _pmis_dev(rows, cols, strong, n, wdev)
-    pvals, keep, cmap = _d1_weights_dev(rows, cols, vals, strong,
-                                        cf.astype(jnp.int32), n)
-    nf = int(keep.sum())     # scalar sync
-    nc = int(cf.sum())       # scalar sync
-    prof["syncs"] += 2
-    nnzP = nf + nc
-    p_size = _bucket(nnzP)
-    prow, pcol, pval = _assemble_p_dev(
-        rows, cols, pvals, keep, cf.astype(jnp.int32), cmap, n, p_size,
-        jnp.int32(nf), jnp.int32(nc),
-    )
+
+    if aggressive:
+        if aggressive_interp != "MULTIPASS":
+            warnings.warn(
+                f"aggressive interpolator {aggressive_interp}: "
+                "using MULTIPASS"
+            )
+        cf, nc = aggressive_pmis_device(rows, cols, vals, strong, n,
+                                        Asp.dtype)
+        prof["syncs"] += 4
+        prow, pcol, pval, nnzP, nc = multipass_interpolation_device(
+            rows, cols, vals, strong, cf, n)
+        prof["syncs"] += 4
+    else:
+        # PMIS weights: S^T degree + hash (f64, identical to host;
+        # seed=0 matches the host pmis_select stage-0 seed)
+        lam = jax.ops.segment_sum(
+            strong.astype(fdt), jnp.minimum(cols, n - 1),
+            num_segments=n,
+        )
+        wdev = lam + jnp.asarray(_hash_weights(n, seed=0), fdt)
+        cf = _pmis_dev(rows, cols, strong, n, wdev)
+        if interp == "D1":
+            pvals, keep, cmap = _d1_weights_dev(
+                rows, cols, vals, strong, cf.astype(jnp.int32), n)
+            nf = int(keep.sum())     # scalar sync
+            nc = int(cf.sum())       # scalar sync
+            prof["syncs"] += 2
+            nnzP = nf + nc
+            p_size = _bucket(nnzP)
+            prow, pcol, pval = _assemble_p_dev(
+                rows, cols, pvals, keep, cf.astype(jnp.int32), cmap,
+                n, p_size, jnp.int32(nf), jnp.int32(nc),
+            )
+        else:  # D2 / STD / STANDARD
+            prow, pcol, pval, nnzP, nc = standard_interpolation_device(
+                rows, cols, vals, strong, cf, n, Asp.dtype)
+            prof["syncs"] += 6
+
+    prow, pcol, pval, nnzP = truncate_interp_device(
+        prow, pcol, pval, nnzP, n, trunc, max_el)
     # R = P^T
     rrow, rcol, rval = _transpose_dev(prow, pcol, pval, n, nc)
     # Galerkin: AP = A @ P ; Ac = R @ AP

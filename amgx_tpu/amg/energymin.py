@@ -68,7 +68,22 @@ def build_energymin_level(Asp, cfg, scope):
     theta = float(cfg.get("strength_threshold", scope))
     max_row_sum = float(cfg.get("max_row_sum", scope))
     strength = str(cfg.get("strength", scope)).upper()
-    selector = str(cfg.get("selector", scope)).upper()
+    # the energymin path has its own selector param (reference
+    # energymin_amg_level.cu reads energymin_selector, default CR);
+    # an explicitly-set generic selector still wins for compatibility
+    # with configs that predate the dedicated key
+    if cfg.has("selector", scope):
+        selector = str(cfg.get("selector", scope)).upper()
+    else:
+        selector = str(cfg.get("energymin_selector", scope)).upper()
+    em_interp = str(cfg.get("energymin_interpolator", scope)).upper()
+    if em_interp not in ("EM", ""):
+        import warnings
+
+        warnings.warn(
+            f"energymin_interpolator {em_interp!r}: only EM is "
+            "implemented; using EM"
+        )
     trunc = float(cfg.get("interp_truncation_factor", scope))
     max_el = int(cfg.get("interp_max_elements", scope))
 

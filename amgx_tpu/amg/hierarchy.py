@@ -90,6 +90,23 @@ class AMGSolver(Solver):
         # not self.reordering — make_nested neutralizes only the
         # solve-boundary permutation.
         self.coarse_reorder = str(g("matrix_reordering")).upper()
+        # reference amg.cu:365: coarsening continues only while
+        # nc <= coarsen_threshold * n (guards coarsening stalls where
+        # the grid shrinks too slowly to be worth another level)
+        self.coarsen_threshold = float(g("coarsen_threshold"))
+        # scaled error correction (reference
+        # aggregation_amg_level.cu:696-805): 2/4 = lambda minimizing
+        # ||r - lambda*A e|| (= <r,Ae>/<Ae,Ae>), 3/5 = energy lambda
+        # <r,e>/<e,Ae>; >3 additionally smooths e (Vanek).  The scale
+        # recomputes every cycle — the dots fuse into the XLA program
+        # (reuse_scale is therefore N/A on TPU, config/params.py).
+        # AGGREGATION levels only, like the reference (the classical
+        # level has no scaled-correction path).
+        self.error_scaling = (
+            int(g("error_scaling"))
+            if self.algorithm == "AGGREGATION" else 0
+        )
+        self.scaling_smoother_steps = int(g("scaling_smoother_steps"))
         # structure_reuse_levels (reference amg_config): 0 = resetup
         # rebuilds everything; k > 0 = the top k Galerkin products
         # re-evaluate on device (amg/spgemm.py plans); < 0 = all levels
@@ -197,7 +214,9 @@ class AMGSolver(Solver):
                 break
             P, R, Ac = self._build_coarse(Asp, lvl.level_id)
             nc = Ac.shape[0]
-            if nc >= n or nc == 0:  # coarsening stalled
+            # stall: empty, non-shrinking, or shrinking slower than
+            # coarsen_threshold allows (reference amg.cu:365-370)
+            if nc >= n or nc == 0 or nc > self.coarsen_threshold * n:
                 break
             dtype = lvl.A.values.dtype
             if self.coarse_reorder != "NONE":
@@ -241,10 +260,17 @@ class AMGSolver(Solver):
             coarsest.smoother = self._make_smoother(coarsest.A)
 
         self._params = self._collect_params()
-        if self.print_grid_stats:
+        # reference solver.cu:541-546: grid stats and vis data print
+        # only at verbosity_level > 2
+        if self.print_grid_stats and self.verbosity > 2:
             from amgx_tpu.core.printing import emit
 
             emit(self.grid_stats())
+        if bool(self.cfg.get("print_vis_data", self.scope)) \
+                and self.verbosity > 2:
+            from amgx_tpu.core.printing import emit
+
+            emit(self.vis_data())
 
     def _resetup_impl(self, A: SparseMatrix) -> bool:
         """Values-only refresh (reference structure_reuse_levels /
@@ -327,6 +353,30 @@ class AMGSolver(Solver):
             self.coarse_solver.make_apply() if self.coarse_solver else None
         )
         cycle_type = self.cycle_type
+        error_scaling = self.error_scaling
+        scaling_steps = max(self.scaling_smoother_steps, 0)
+        vanek_steps = max(self.postsweeps, 1)
+
+        def _scaled_correction(A, smooth_fn, smp, b, x, r, e):
+            """x + lambda*e with the error_scaling lambda (reference
+            aggregation_amg_level.cu:696-805)."""
+            vanek = error_scaling > 3
+            if vanek and smooth_fn is not None:
+                # smooth the correction against rhs 0, x against b,
+                # then refresh the residual (Vanek scheme)
+                e = smooth_fn(smp, jnp.zeros_like(e), e, vanek_steps)
+                x = smooth_fn(smp, b, x, vanek_steps)
+                r = b - spmv(A, x)
+            elif scaling_steps > 0 and smooth_fn is not None:
+                e = smooth_fn(smp, r, e, scaling_steps)
+            Ae = spmv(A, e)
+            if error_scaling in (2, 4):
+                num, den = dot(r, Ae), dot(Ae, Ae)
+            else:  # 3, 5
+                num, den = dot(r, e), dot(e, Ae)
+            lam = jnp.where(den != 0, num / jnp.where(den != 0, den, 1.0),
+                            1.0)
+            return x + lam * e
 
         def cycle(params, b, x, lvl_id=0):
             level_params, coarse_params = params
@@ -370,7 +420,12 @@ class AMGSolver(Solver):
             else:
                 xc = cycle(params, bc, xc, lvl_id + 1)
             with named_scope(f"amg_l{lvl_id}_prolong"):
-                x = x + spmv(P, xc)
+                if self.error_scaling >= 2:
+                    x = _scaled_correction(
+                        A, smooth_fns[lvl_id], smp, b, x, r,
+                        spmv(P, xc))
+                else:
+                    x = x + spmv(P, xc)
             if post > 0:
                 with named_scope(f"amg_l{lvl_id}_postsmooth"):
                     x = smooth_fns[lvl_id](smp, b, x, post)
@@ -432,7 +487,12 @@ class AMGSolver(Solver):
             xc = jnp.zeros((R.n_rows * R.block_size,), dtype=b.dtype)
             xc = _v_cycle(params, bc, xc, lvl_id + 1)
             with named_scope(f"amg_l{lvl_id}_prolong"):
-                x = x + spmv(P, xc)
+                if error_scaling >= 2:
+                    x = _scaled_correction(
+                        A, smooth_fns[lvl_id], smp, b, x, r,
+                        spmv(P, xc))
+                else:
+                    x = x + spmv(P, xc)
             if post > 0:
                 with named_scope(f"amg_l{lvl_id}_postsmooth"):
                     x = smooth_fns[lvl_id](smp, b, x, post)
@@ -462,6 +522,20 @@ class AMGSolver(Solver):
 
     # ------------------------------------------------------------------
 
+    def vis_data(self) -> str:
+        """Per-level structure dump (reference print_vis_data /
+        amg_level printVisData: writes grid/aggregate visualization
+        data; here a compact per-level structural summary)."""
+        lines = ["         AMG visualization data:"]
+        for lvl in self.levels:
+            pr = lvl.P.nnz if lvl.P is not None else 0
+            lines.append(
+                f"           level {lvl.level_id}: rows={lvl.n_rows} "
+                f"nnz={lvl.nnz} interp_nnz={pr} "
+                f"avg_row_nnz={lvl.nnz / max(lvl.n_rows, 1):.2f}"
+            )
+        return "\n".join(lines)
+
     def grid_stats(self) -> str:
         """Grid statistics table (reference AMG::printGridStatistics,
         README.md:104-117 output contract)."""
@@ -472,13 +546,23 @@ class AMGSolver(Solver):
             n, nnz = lvl.n_rows, lvl.nnz
             total_rows += n
             total_nnz += nnz
-            itemsize = np.dtype(lvl.A.values.dtype).itemsize
-            bytes_total += nnz * (itemsize + 4) + 4 * (n + 1)
+            # measured bytes: every array leaf the level holds on
+            # device (operator + transfers), not a model — the per-
+            # level HBM figure users tune against (reference
+            # memory_info.h "Mem Usage")
+            lvl_bytes = 0
+            for obj in (lvl.A, lvl.P, lvl.R):
+                if obj is None:
+                    continue
+                for leaf in jax.tree_util.tree_leaves(obj):
+                    if hasattr(leaf, "nbytes"):
+                        lvl_bytes += int(leaf.nbytes)
+            bytes_total += lvl_bytes
             sp = nnz / (n * n) if n else 0.0
             rows.append(
                 f"         {lvl.level_id:>5}(D)"
                 f" {n:>10} {nnz:>12} {sp:>10.3g}"
-                f" {nnz * itemsize / 2**30:>9.2e}"
+                f" {lvl_bytes / 2**30:>9.2e}"
             )
         fine = self.levels[0]
         grid_cx = total_rows / fine.n_rows if fine.n_rows else 0

@@ -155,6 +155,7 @@ class AMGConfig:
             raise ConfigError(
                 f"parameter {name!r} value {value!r} not in {desc.allowed}"
             )
+        P.warn_if_na(name)
         self._values[(scope, name)] = value
 
     def set(self, name: str, value: Any, scope: str = "default"):

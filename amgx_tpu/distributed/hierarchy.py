@@ -383,6 +383,7 @@ def build_distributed_hierarchy_local(
     grade_lower: int = _GRADE_LOWER,
     proc_grid=None,
     mesh=None,
+    stop_measure: str = "sum",
 ) -> DistHierarchy:
     """The distributed setup loop from per-process local blocks
     (reference per-rank setup_v2, amg.cu:425-660).
@@ -412,8 +413,17 @@ def build_distributed_hierarchy_local(
     lvl_own: Ownership = ownership
     levels: List[DistLevel] = []
 
+    # reference amg.cu:333-360: the coarsening-stop measure is the sum
+    # of partition rows by default here; stop_measure="min" uses the
+    # worst (smallest) partition scaled to the part count instead
+    # (use_sum_stopping_criteria=0 semantics).
+    def _stop_rows(own):
+        if stop_measure == "min":
+            return int(np.asarray(own.counts).min()) * len(own.counts)
+        return own.n_global
+
     while (
-        lvl_own.n_global > consolidate_rows and len(levels) < max_levels
+        _stop_rows(lvl_own) > consolidate_rows and len(levels) < max_levels
     ):
         counts = lvl_own.counts
         rows_pp = max(int(counts.max()), 1)
@@ -667,6 +677,7 @@ def build_distributed_hierarchy_block(
     max_levels: int = 20,
     consolidate_rows: int = _CONSOLIDATE_ROWS,
     grade_lower: int = _GRADE_LOWER,
+    stop_measure: str = "sum",
 ) -> DistHierarchy:
     """Distributed aggregation AMG on a BLOCK matrix (reference
     distributed block path: aggregation treats block rows as graph
@@ -728,16 +739,46 @@ def build_distributed_hierarchy_block(
     max_part_nnz = 0
     max_part_rows = 0
 
+    # reference computeEdgeWeightsBlockDiaCsr_V2 (size2_selector.cu:770):
+    # aggregation_edge_weight_component picks the block component the
+    # edge weights condense on.  When the config does not set it, the
+    # TPU default is the Frobenius condense (uses the whole block; at
+    # least as informative as any single component)
+    ew_comp = (
+        int(cfg.get("aggregation_edge_weight_component", scope))
+        if cfg.has("aggregation_edge_weight_component", scope)
+        else -1
+    )
+
     def cond_csr(d, counts_p):
-        """Condensed Frobenius-norm scalar csr of one block part."""
+        """Condensed scalar csr of one block part (component or
+        Frobenius weights)."""
         nloc = rows_pp_cur + len(d["halo_glob"])
-        fro = np.sqrt((d["vals"] ** 2).sum(axis=(1, 2)))
+        if 0 <= ew_comp < d["vals"].shape[1] * d["vals"].shape[2]:
+            bi, bj = divmod(ew_comp, d["vals"].shape[2])
+            w = np.abs(d["vals"][:, bi, bj])
+            # component-(0,0)-only condensation can drop block edges
+            # whose (0,0) entry is zero; keep the graph connected with
+            # a small Frobenius floor
+            fro = np.sqrt((d["vals"] ** 2).sum(axis=(1, 2)))
+            w = np.where(w > 0, w, 1e-12 * fro)
+        else:
+            w = np.sqrt((d["vals"] ** 2).sum(axis=(1, 2)))
         return sps.csr_matrix(
-            (fro, d["cols"], d["indptr"]), shape=(counts_p, nloc)
+            (w, d["cols"], d["indptr"]), shape=(counts_p, nloc)
         )
 
+    # reference amg.cu:333-360: the coarsening-stop measure is the sum
+    # of partition rows by default here; stop_measure="min" uses the
+    # worst (smallest) partition scaled to the part count instead
+    # (use_sum_stopping_criteria=0 semantics).
+    def _stop_rows(own):
+        if stop_measure == "min":
+            return int(np.asarray(own.counts).min()) * len(own.counts)
+        return own.n_global
+
     while (
-        lvl_own.n_global > consolidate_rows and len(levels) < max_levels
+        _stop_rows(lvl_own) > consolidate_rows and len(levels) < max_levels
     ):
         counts = lvl_own.counts
         rows_pp_cur = max(int(counts.max()), 1)
@@ -986,6 +1027,7 @@ def build_distributed_hierarchy(
     max_levels: int = 20,
     consolidate_rows: int = _CONSOLIDATE_ROWS,
     grade_lower: int = _GRADE_LOWER,
+    stop_measure: str = "sum",
 ) -> DistHierarchy:
     """Single-process convenience wrapper: partition the global matrix
     into local parts, then run the per-process setup loop
@@ -1021,6 +1063,7 @@ def build_distributed_hierarchy(
         consolidate_rows=consolidate_rows,
         grade_lower=grade_lower,
         proc_grid=proc_grid,
+        stop_measure=stop_measure,
     )
     # fine-level pad/unpad convenience for non-contiguous partitions
     # (grid slabs / arbitrary partition vectors): the global-matrix

@@ -21,15 +21,27 @@ from jax.sharding import Mesh, PartitionSpec as P
 from amgx_tpu.distributed.partition import DistributedMatrix
 
 
-def _shard_params(A: DistributedMatrix):
+def _shard_params(A: DistributedMatrix, cfg=None, scope="default"):
     """Traced per-shard arrays, stacked on the shard axis: the local
     operator (interior/boundary split when built) plus halo-exchange
-    maps, as a dict pytree."""
+    maps, as a dict pytree.
+
+    min_rows_latency_hiding (reference core.cu:346): when the config
+    sets it explicitly, levels below the row threshold drop the
+    interior/boundary overlap split (a negative explicit value drops
+    it everywhere).  Unset, the TPU default keeps the overlap at every
+    level — the split costs nothing under XLA's scheduler."""
+    overlap_ok = True
+    if cfg is not None and cfg.has("min_rows_latency_hiding", scope):
+        thresh = int(cfg.get("min_rows_latency_hiding", scope))
+        rows = int(A.ell_cols.shape[1]) if hasattr(
+            A.ell_cols, "shape") else 0
+        overlap_ok = thresh >= 0 and rows >= thresh
     out = {
         "diag": jnp.asarray(A.diag),
         "ell": (jnp.asarray(A.ell_cols), jnp.asarray(A.ell_vals)),
     }
-    if A.int_mask is not None:
+    if A.int_mask is not None and overlap_ok:
         out["split"] = (
             jnp.asarray(A.int_mask),
             jnp.asarray(A.own_mask),

@@ -94,7 +94,7 @@ class SingleIterationEigenSolver(EigenSolver):
                     (A.n_rows,), 1.0 / A.n_rows
                 )
 
-    def solve(self, x0=None) -> EigenResult:
+    def _solve_impl(self, x0=None) -> EigenResult:
         A = self.A
         n = A.n_rows
         dtype = np.dtype(A.values.dtype)
@@ -188,7 +188,7 @@ class SubspaceIterationEigenSolver(EigenSolver):
     """Block power iteration with QR + Rayleigh-Ritz (reference
     subspace_iteration_eigensolver.cu)."""
 
-    def solve(self, x0=None) -> EigenResult:
+    def _solve_impl(self, x0=None) -> EigenResult:
         A = self.A
         n = A.n_rows
         k = max(self.wanted_count, 1)
@@ -241,7 +241,7 @@ class LanczosEigenSolver(EigenSolver):
     """Symmetric Lanczos with full reorthogonalization (reference
     lanczos_eigensolver.cu); tridiagonal Ritz problem on host."""
 
-    def solve(self, x0=None) -> EigenResult:
+    def _solve_impl(self, x0=None) -> EigenResult:
         A = self.A
         n = A.n_rows
         dtype = np.dtype(A.values.dtype)
@@ -299,7 +299,7 @@ class ArnoldiEigenSolver(EigenSolver):
     """Arnoldi for nonsymmetric spectra (reference arnoldi_eigensolver.cu);
     Hessenberg eigenproblem on host."""
 
-    def solve(self, x0=None) -> EigenResult:
+    def _solve_impl(self, x0=None) -> EigenResult:
         A = self.A
         n = A.n_rows
         dtype = np.dtype(A.values.dtype)
@@ -345,7 +345,7 @@ class LOBPCGEigenSolver(EigenSolver):
     """LOBPCG for extreme eigenpairs of SPD matrices (reference
     lobpcg_eigensolver.cu); Rayleigh-Ritz on the [X R P] basis."""
 
-    def solve(self, x0=None) -> EigenResult:
+    def _solve_impl(self, x0=None) -> EigenResult:
         A = self.A
         n = A.n_rows
         k = max(self.wanted_count, 1)

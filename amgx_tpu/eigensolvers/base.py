@@ -86,7 +86,48 @@ class EigenSolver:
         return max(self.max_iters, 2 * self.wanted_count + 2)
 
     def solve(self, x0=None) -> EigenResult:
+        """Run the algorithm, then the optional eigenvector post-pass
+        (reference eigensolver.cu solve + eigenvector_solver)."""
+        return self._maybe_extract_vectors(self._solve_impl(x0))
+
+    def _solve_impl(self, x0=None) -> EigenResult:
         raise NotImplementedError
+
+    def _maybe_extract_vectors(self, res: EigenResult) -> EigenResult:
+        """Post-pass eigenvector extraction (reference
+        eigensolver.cu:271-276 + eigenvector_solver.cu): when
+        ``eig_eigenvector_solver`` names a solver and the algorithm did
+        not already produce vectors, run one shift-inverted inverse
+        iteration per converged eigenvalue."""
+        name = str(self.cfg.get("eig_eigenvector_solver", self.scope))
+        if (not self.want_vectors or res.eigenvectors is not None
+                or not name or not res.eigenvalues.size):
+            return res
+        import dataclasses
+
+        import numpy as np
+        import scipy.sparse as sps
+
+        from amgx_tpu.core.matrix import SparseMatrix
+        from amgx_tpu.solvers.registry import SolverRegistry, make_nested
+
+        sp = self.A.to_scipy().tocsr()
+        n = sp.shape[0]
+        vecs = np.zeros((n, len(res.eigenvalues)), dtype=sp.dtype)
+        rng = np.random.default_rng(7)
+        for k, lam in enumerate(np.atleast_1d(res.eigenvalues)):
+            shift = float(np.real(lam)) * (1.0 + 1e-6) + 1e-12
+            shifted = (sp - shift * sps.eye_array(n)).tocsr()
+            inner = make_nested(
+                SolverRegistry.get(name)(self.cfg, self.scope))
+            inner.setup(SparseMatrix.from_scipy(shifted))
+            v = rng.standard_normal(n).astype(
+                np.real(np.zeros(1, sp.dtype)).dtype)
+            for _ in range(3):
+                v = np.asarray(inner.solve(v).x)
+                v = v / max(np.linalg.norm(v), 1e-300)
+            vecs[:, k] = v
+        return dataclasses.replace(res, eigenvectors=vecs)
 
 
 def create_eigensolver(cfg, scope: str = "default") -> EigenSolver:

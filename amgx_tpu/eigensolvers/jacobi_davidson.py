@@ -51,7 +51,7 @@ def _correction_cg(A, theta, u, r, iters=8):
 
 @register_eigensolver("JACOBI_DAVIDSON")
 class JacobiDavidsonEigenSolver(EigenSolver):
-    def solve(self, x0=None) -> EigenResult:
+    def _solve_impl(self, x0=None) -> EigenResult:
         A = self.A
         n = A.n_rows
         dtype = np.dtype(A.values.dtype)

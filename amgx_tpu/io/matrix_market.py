@@ -327,3 +327,59 @@ def write_system(path, A: SparseMatrix, rhs=None, sol=None):
                         f.write(f"{v.real:.17g} {v.imag:.17g}\n")
                     else:
                         f.write(f"{v:.17g}\n")
+
+
+def complex_to_real_system(A_dict, rhs, sol, conversion_type: int):
+    """Equivalent-real-formulation (ERF) conversion of a complex system
+    (reference readers.cu:221-345 ReadAndConvert, ``complex_conversion``
+    config param): K1..K4 produce the 2n x 2n real system
+
+      K1: [[ Re, -Im], [Im,  Re]]   b = [Re b; Im b]  x = [Re x;  Im x]
+      K2: [[ Re,  Im], [Im, -Re]]   b = [Re b; Im b]  x = [Re x; -Im x]
+      K3: [[ Im,  Re], [Re, -Im]]   b = [Im b; Re b]  x = [Re x;  Im x]
+      K4: [[ Im, -Re], [Re,  Im]]   b = [Im b; Re b]  x = [Re x; -Im x]
+    """
+    if conversion_type not in (1, 2, 3, 4):
+        raise MatrixIOError(
+            f"complex_conversion={conversion_type}: expected 1..4"
+        )
+    import scipy.sparse as sps
+
+    n = A_dict["n_rows"]
+    C = sps.csr_matrix(
+        (np.asarray(A_dict["vals"]),
+         (np.asarray(A_dict["rows"]), np.asarray(A_dict["cols"]))),
+        shape=(n, A_dict["n_cols"]),
+    )
+    Re, Im = C.real.tocsr(), C.imag.tocsr()
+    blocks = {
+        1: [[Re, -Im], [Im, Re]],
+        2: [[Re, Im], [Im, -Re]],
+        3: [[Im, Re], [Re, -Im]],
+        4: [[Im, -Re], [Re, Im]],
+    }[conversion_type]
+    K = sps.bmat(blocks, format="coo")
+    out = dict(
+        rows=K.row.astype(np.int64),
+        cols=K.col.astype(np.int64),
+        vals=K.data,
+        n_rows=2 * n,
+        n_cols=2 * A_dict["n_cols"],
+        block_dims=(1, 1),
+    )
+    b2 = x2 = None
+    if rhs is not None:
+        rhs = np.asarray(rhs)
+        b2 = (
+            np.concatenate([rhs.real, rhs.imag])
+            if conversion_type in (1, 2)
+            else np.concatenate([rhs.imag, rhs.real])
+        )
+    if sol is not None:
+        sol = np.asarray(sol)
+        x2 = (
+            np.concatenate([sol.real, sol.imag])
+            if conversion_type in (1, 3)
+            else np.concatenate([sol.real, -sol.imag])
+        )
+    return out, b2, x2

@@ -94,11 +94,20 @@ def downwind_order(indptr, indices, vals, n) -> np.ndarray:
     return np.lexsort((np.arange(n), level))
 
 
-def min_max_coloring(indptr, indices, n, max_rounds=64, seed=0) -> np.ndarray:
+def min_max_coloring(indptr, indices, n, max_rounds=64, seed=0,
+                     weakness_bound=None,
+                     late_rejection=False) -> np.ndarray:
     """Luby-style min-max hash coloring (reference min_max.cu structure):
     in each round, uncolored vertices that are local maxima (by hashed
     weight) among uncolored neighbours take the current color; local
-    minima take color+1.  Deterministic for a fixed seed."""
+    minima take color+1.  Deterministic for a fixed seed.
+
+    ``weakness_bound`` relaxes the local-max test (reference
+    min_max_2ring.cu:194: a vertex counts as max when at most that many
+    uncolored neighbours beat its hash), coloring more vertices per
+    round at the cost of tentative conflicts; ``late_rejection``
+    (min_max_2ring.cu:404) then uncolors the lower-hash side of any
+    same-round conflict instead of preventing it up front."""
     rng = np.random.default_rng(seed)
     w = rng.permutation(n).astype(np.int64)
     colors = np.full(n, -1, dtype=np.int32)
@@ -107,6 +116,9 @@ def min_max_coloring(indptr, indices, n, max_rounds=64, seed=0) -> np.ndarray:
     mask_offdiag = indices != row_ids
     rows = row_ids[mask_offdiag]
     cols = indices[mask_offdiag]
+    relaxed = (
+        weakness_bound is not None and 0 < weakness_bound < 2 ** 30
+    )
     for _ in range(max_rounds):
         un = colors < 0
         if not un.any():
@@ -115,14 +127,34 @@ def min_max_coloring(indptr, indices, n, max_rounds=64, seed=0) -> np.ndarray:
         # neighbours
         active_edge = un[rows] & un[cols] & (cols < n)
         r, c = rows[active_edge], cols[active_edge]
-        nb_max = np.full(n, -1, dtype=np.int64)
-        nb_min = np.full(n, n + 1, dtype=np.int64)
-        np.maximum.at(nb_max, r, w[c])
-        np.minimum.at(nb_min, r, w[c])
-        is_max = un & (w > nb_max)
-        is_min = un & (w < nb_min) & ~is_max
+        if relaxed:
+            gt = np.zeros(n, dtype=np.int64)
+            lt = np.zeros(n, dtype=np.int64)
+            np.add.at(gt, r, (w[c] > w[r]).astype(np.int64))
+            np.add.at(lt, r, (w[c] < w[r]).astype(np.int64))
+            is_max = un & (gt <= weakness_bound)
+            is_min = un & (lt <= weakness_bound) & ~is_max
+        else:
+            nb_max = np.full(n, -1, dtype=np.int64)
+            nb_min = np.full(n, n + 1, dtype=np.int64)
+            np.maximum.at(nb_max, r, w[c])
+            np.minimum.at(nb_min, r, w[c])
+            is_max = un & (w > nb_max)
+            is_min = un & (w < nb_min) & ~is_max
         colors[is_max] = color
         colors[is_min] = color + 1
+        if relaxed:
+            # the relaxed test can create same-round conflicts: the
+            # lower-hash side reverts.  (The reference's two schedules
+            # — in-kernel prevention vs late_rejection — collapse to
+            # this same fixpoint in vectorized form; late_rejection
+            # additionally allows reverting against already-colored
+            # neighbours, min_max_2ring.cu:404.)
+            hi = color if not late_rejection else 0
+            same = (colors[rows] >= hi) & (
+                colors[rows] == colors[cols])
+            lose = same & (w[rows] < w[cols])
+            colors[rows[lose]] = -1
         color += 2
     # anything left (pathological): greedy-fix
     left = np.nonzero(colors < 0)[0]
@@ -268,12 +300,53 @@ def recolor_min_colors(
     return _compact_colors(colors)
 
 
+def parallel_greedy_coloring(indptr, indices, n, max_uncolored=0.0,
+                             seed=0) -> np.ndarray:
+    """PARALLEL_GREEDY (reference parallel_greedy.cu): Jones-Plassmann
+    rounds — every uncolored vertex proposes the smallest color unused
+    by its colored neighbours, and commits when it is the hashed local
+    max among uncolored neighbours.  Stops once the uncolored fraction
+    drops below ``max_uncolored_percentage`` (remainder greedy-fixed),
+    like the reference's early-exit."""
+    w = _mix_hash(np.arange(n), seed).astype(np.int64)
+    colors = np.full(n, -1, dtype=np.int32)
+    row_ids = np.repeat(np.arange(n), np.diff(indptr))
+    keep = (indices != row_ids) & (indices < n)
+    rows, cols = row_ids[keep], indices[keep]
+    for _ in range(4 * 64):
+        un = colors < 0
+        n_un = int(un.sum())
+        if n_un == 0 or n_un <= max_uncolored * n:
+            break
+        # smallest available color per uncolored vertex
+        ncmax = int(colors.max()) + 2 if colors.max() >= 0 else 1
+        used = np.zeros((n, ncmax + 1), dtype=bool)
+        colored_nb = colors[cols] >= 0
+        used[rows[colored_nb], colors[cols[colored_nb]]] = True
+        avail = ~used
+        proposal = np.argmax(avail, axis=1).astype(np.int32)
+        # local max among uncolored neighbours commits
+        ae = un[rows] & un[cols]
+        nb_max = np.full(n, -1, dtype=np.int64)
+        np.maximum.at(nb_max, rows[ae], w[cols[ae]])
+        commit = un & (w > nb_max)
+        colors[commit] = proposal[commit]
+    for i in np.nonzero(colors < 0)[0]:
+        neigh = indices[indptr[i]: indptr[i + 1]]
+        used = set(colors[neigh[neigh < n]].tolist())
+        c = 0
+        while c in used:
+            c += 1
+        colors[i] = c
+    return _compact_colors(colors)
+
+
 _SCHEME_ALIASES = {
     "MIN_MAX": "MIN_MAX",
     "MIN_MAX_2RING": "MIN_MAX_2RING",
     "GREEDY_MIN_MAX_2RING": "GREEDY_2RING",
-    "PARALLEL_GREEDY": "MIN_MAX",
-    "ROUND_ROBIN": "MIN_MAX",
+    "PARALLEL_GREEDY": "PARALLEL_GREEDY",
+    "ROUND_ROBIN": "ROUND_ROBIN",
     "MULTI_HASH": "MULTI_HASH",
     "UNIFORM": "UNIFORM",
     "SERIAL_GREEDY_BFS": "GREEDY",
@@ -286,24 +359,64 @@ _SCHEME_ALIASES = {
 _UNIFORM_MAX_COLORS = 64
 
 
-def color_matrix(A, scheme="MIN_MAX", deterministic=False) -> np.ndarray:
-    """Color a SparseMatrix (host). Returns int32 colors (n_rows,)."""
+def color_matrix(A, scheme="MIN_MAX", deterministic=False,
+                 cfg=None, scope="default") -> np.ndarray:
+    """Color a SparseMatrix (host). Returns int32 colors (n_rows,).
+
+    When ``cfg`` is given, the reference coloring knobs are honored:
+    ``coloring_level`` (0 = no coloring, 1 = distance-1, >=2 =
+    distance-2 via the two-ring graph, min_max.cu:426-434),
+    ``num_colors`` (ROUND_ROBIN modulus, round_robin.cu:29),
+    ``max_num_hash`` (MULTI_HASH hash count), ``max_uncolored_percentage``
+    (PARALLEL_GREEDY early exit, parallel_greedy.cu:664),
+    ``coloring_try_remove_last_colors``/``coloring_custom_arg``
+    (GREEDY_RECOLOR shrink passes, greedy_recolor.cu), and
+    ``print_coloring_info`` (emit summary)."""
     indptr = np.asarray(A.row_offsets)
     indices = np.asarray(A.col_indices)
     n = A.n_rows
     algo = _SCHEME_ALIASES.get(scheme.upper(), "MIN_MAX")
+    g = (lambda k: cfg.get(k, scope)) if cfg is not None else None
+    coloring_level = int(g("coloring_level")) if g else 1
+
+    if coloring_level == 0:
+        colors = np.zeros(n, dtype=np.int32)
+        return _emit_coloring_info(g, scheme, colors, indptr, indices)
+    if coloring_level >= 2 and algo not in (
+        "MIN_MAX_2RING", "GREEDY_2RING", "LOCALLY_DOWNWIND",
+    ):
+        # distance-2 coloring: color the two-ring graph.  The 2RING
+        # schemes already operate at distance 2; LOCALLY_DOWNWIND
+        # needs A's values aligned with the graph, so it stays on the
+        # distance-1 pattern.
+        indptr, indices = _two_ring_graph(indptr, indices, n)
+
     if algo in ("MIN_MAX_2RING", "GREEDY_2RING"):
         ip2, ix2 = _two_ring_graph(indptr, indices, n)
         if deterministic or algo == "GREEDY_2RING":
-            return greedy_coloring(ip2, ix2, n)
-        return min_max_coloring(ip2, ix2, n)
-    if algo == "LOCALLY_DOWNWIND":
+            colors = greedy_coloring(ip2, ix2, n)
+        else:
+            wb = int(g("weakness_bound")) if g else None
+            lr = bool(g("late_rejection")) if g else False
+            colors = min_max_coloring(ip2, ix2, n, weakness_bound=wb,
+                                      late_rejection=lr)
+    elif algo == "LOCALLY_DOWNWIND":
         vals = np.asarray(A.values)
         if vals.ndim > 1:  # block matrix: use block Frobenius weight
             vals = np.sqrt((np.abs(vals) ** 2).sum(axis=(1, 2)))
         order = downwind_order(indptr, indices, vals, n)
-        return greedy_coloring(indptr, indices, n, order=order)
-    if algo == "UNIFORM":
+        colors = greedy_coloring(indptr, indices, n, order=order)
+    elif algo == "ROUND_ROBIN":
+        # reference round_robin.cu:29: literally i % num_colors (no
+        # conflict resolution — a calibration scheme, kept faithful)
+        k = max(int(g("num_colors")) if g else 10, 1)
+        colors = (np.arange(n, dtype=np.int32) % k).astype(np.int32)
+        return _emit_coloring_info(g, scheme, colors, indptr, indices)
+    elif algo == "PARALLEL_GREEDY":
+        frac = float(g("max_uncolored_percentage")) if g else 0.0
+        colors = parallel_greedy_coloring(indptr, indices, n,
+                                          max_uncolored=frac)
+    elif algo == "UNIFORM":
         row_ids = np.repeat(np.arange(n), np.diff(indptr))
         off = indices != row_ids
         if off.any():
@@ -311,20 +424,53 @@ def color_matrix(A, scheme="MIN_MAX", deterministic=False) -> np.ndarray:
         else:
             period = 1
         if period <= _UNIFORM_MAX_COLORS:
-            return (np.arange(n, dtype=np.int32) % period).astype(
+            colors = (np.arange(n, dtype=np.int32) % period).astype(
                 np.int32
             )
-        return greedy_coloring(indptr, indices, n)
-    if algo == "MULTI_HASH":
-        return multi_hash_coloring(indptr, indices, n)
-    if algo == "GREEDY_RECOLOR":
+            return _emit_coloring_info(g, scheme, colors, indptr,
+                                       indices)
+        colors = greedy_coloring(indptr, indices, n)
+    elif algo == "MULTI_HASH":
+        nh = max(int(g("max_num_hash")) if g else 8, 1)
+        colors = multi_hash_coloring(indptr, indices, n, num_hash=nh)
+    elif algo == "GREEDY_RECOLOR":
         # reference greedy_recolor.cu: fast multi-hash first coloring,
-        # then iterated class-parallel palette shrinking
+        # then iterated class-parallel palette shrinking;
+        # coloring_try_remove_last_colors / coloring_custom_arg bound
+        # the shrink passes
         first = multi_hash_coloring(indptr, indices, n)
-        return recolor_min_colors(indptr, indices, n, first)
-    if deterministic or algo == "GREEDY":
-        return greedy_coloring(indptr, indices, n)
-    return min_max_coloring(indptr, indices, n)
+        passes = 4
+        if g:
+            try_rm = int(g("coloring_try_remove_last_colors"))
+            custom = str(g("coloring_custom_arg"))
+            if try_rm > 0:
+                passes = try_rm
+            elif custom.isdigit():
+                passes = max(int(custom), 1)
+        colors = recolor_min_colors(indptr, indices, n, first,
+                                    max_passes=passes)
+    elif deterministic or algo == "GREEDY":
+        colors = greedy_coloring(indptr, indices, n)
+    else:
+        colors = min_max_coloring(indptr, indices, n)
+    return _emit_coloring_info(g, scheme, colors, indptr, indices)
+
+
+def _emit_coloring_info(g, scheme, colors, indptr, indices):
+    """print_coloring_info (reference matrix_coloring.cu): color count,
+    class sizes, validity."""
+    if g is not None and bool(g("print_coloring_info")):
+        from amgx_tpu.core.printing import emit
+
+        nc = int(colors.max()) + 1
+        sizes = np.bincount(colors, minlength=nc)
+        ok = validate_coloring(indptr, indices, colors)
+        emit(
+            f"         Coloring [{scheme}]: {nc} colors over "
+            f"{colors.shape[0]} rows; largest class {int(sizes.max())}"
+            f", smallest {int(sizes.min())}; valid={ok}"
+        )
+    return colors
 
 
 def validate_coloring(indptr, indices, colors) -> bool:

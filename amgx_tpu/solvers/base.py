@@ -34,9 +34,29 @@ import numpy as np
 from amgx_tpu.core.matrix import SparseMatrix
 from amgx_tpu.core.printing import emit
 from amgx_tpu.core.types import NormType
+
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.ops.norms import norm as _norm, block_norm as _block_norm
 from amgx_tpu.solvers.convergence import make_convergence_check
+
+
+def device_memory_stats():
+    """(bytes_in_use, peak_bytes_in_use) from the default device's
+    runtime allocator (the TPU HBM counters behind the reference's
+    MemoryInfo / "Mem Usage" column, include/memory_info.h:9-33), or
+    None when the backend exposes no stats (CPU)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    used = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use", used)
+    if used is None:
+        return None
+    return used, peak
+
 
 # AMGX_SOLVE_* status codes (reference amgx_c.h:75-80)
 SUCCESS = 0
@@ -81,6 +101,15 @@ class Solver:
         self.relaxation_factor = float(g("relaxation_factor"))
         self.print_solve_stats = bool(g("print_solve_stats"))
         self.obtain_timings = bool(g("obtain_timings"))
+        # reference solver.cu:34,541-830: verbosity_level gates all
+        # solve/grid printouts (>2 = full tables, 1-2 = summary line,
+        # 0 = silent); solver_verbose=1 dumps the solver settings at
+        # setup (solver.cu:349)
+        self.verbosity = int(g("verbosity_level"))
+        self.solver_verbose = bool(g("solver_verbose"))
+        # reference convergence_analysis.cu: when > 0, print a
+        # convergence-rate analysis over the final N iterations
+        self.convergence_analysis = int(g("convergence_analysis"))
         self.rel_div_tolerance = float(g("rel_div_tolerance"))
         self.alt_rel_tolerance = float(g("alt_rel_tolerance"))
         self.scaling = str(g("scaling"))
@@ -309,6 +338,15 @@ class Solver:
 
     def setup(self, A: SparseMatrix):
         t0 = time.perf_counter()
+        if self.solver_verbose:
+            # reference solver.cu:349: dump the solver settings
+            emit(
+                f"{self.registry_name} solver settings (scope "
+                f"{self.scope!r}): max_iters={self.max_iters} "
+                f"tolerance={self.tolerance} norm={self.norm_type.value} "
+                f"convergence={self.conv_type} "
+                f"relaxation_factor={self.relaxation_factor}"
+            )
         self._scale_vecs = None
         self._reorder = None
         if self.scaling.upper() not in ("", "NONE"):
@@ -402,8 +440,16 @@ class Solver:
             res = dataclasses.replace(res, x=self._scale_vecs[1] * res.x)
         res.x.block_until_ready()
         self.solve_time = time.perf_counter() - t0
-        if self.print_solve_stats:
+        if self.print_solve_stats and self.verbosity > 2:
             self._print_stats(res)
+        elif self.print_solve_stats and self.verbosity in (1, 2):
+            # reduced one-line summary (reference solver.cu:760,830)
+            emit(
+                f"         Total Iterations: {int(res.iters)}  "
+                f"status: {int(res.status)}"
+            )
+        if self.convergence_analysis > 0 and res.history is not None:
+            self._print_convergence_analysis(res)
         if self.obtain_timings:
             emit(
                 f"Total Time: {self.setup_time + self.solve_time:10.6f}\n"
@@ -412,6 +458,14 @@ class Solver:
                 f"    solve(per iteration): "
                 f"{self.solve_time / max(1, int(res.iters)):10.6f} s"
             )
+            mem = device_memory_stats()
+            if mem is not None:
+                # reference "Mem Usage" column (memory_info.h:9-33);
+                # on TPU this is live/peak HBM from the runtime
+                emit(
+                    f"    Mem Usage: {mem[0] / 2**30:10.4f} GB in use, "
+                    f"peak {mem[1] / 2**30:10.4f} GB"
+                )
         return res
 
     def _print_stats(self, res: SolveResult):
@@ -450,6 +504,34 @@ class Solver:
             f"         Residual reduction: "
             f"{float(np.max(hist[iters]) / max(np.max(hist[0]), 1e-300)):18.6e}\n"
             f"         Solve status: {label}"
+        )
+
+    def _print_convergence_analysis(self, res: SolveResult):
+        """Reference convergence_analysis.cu: geometric-mean rate and
+        per-iteration rates over the last ``convergence_analysis``
+        iterations."""
+        import numpy as np
+
+        hist = np.asarray(res.history)
+        iters = int(res.iters)
+        k = min(self.convergence_analysis, iters)
+        if k < 1:
+            return
+        rows = []
+        for i in range(iters - k + 1, iters + 1):
+            prev = float(np.max(hist[i - 1]))
+            cur = float(np.max(hist[i]))
+            rows.append(
+                f"           iter {i:3d}: rate "
+                f"{(cur / prev if prev > 0 else 0.0):10.4f}"
+            )
+        r0 = float(np.max(hist[iters - k]))
+        rn = float(np.max(hist[iters]))
+        geo = (rn / r0) ** (1.0 / k) if r0 > 0 else 0.0
+        emit(
+            "         Convergence analysis (last %d iterations):\n" % k
+            + "\n".join(rows)
+            + f"\n           geometric-mean rate: {geo:10.4f}"
         )
 
     @staticmethod

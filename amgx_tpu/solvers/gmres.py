@@ -10,8 +10,10 @@ host per iteration.
 
 GMRES is left-preconditioned (Krylov space of M A); FGMRES is flexible
 right-preconditioned, storing the preconditioned vectors Z_j so the
-preconditioner may change between iterations.  Real dtypes only (complex
-Givens TBD).
+preconditioner may change between iterations.  Complex modes (dZ*/dC*,
+reference amgx_config.h:103-121) use conjugated MGS projections and the
+unitary Givens scheme; real dtypes recover the classical formulas
+exactly.
 """
 
 from __future__ import annotations
@@ -38,6 +40,11 @@ class FGMRESSolver(KrylovSolver):
     def __init__(self, cfg, scope="default"):
         super().__init__(cfg, scope)
         self.restart = int(cfg.get("gmres_n_restart", scope))
+        # reference fgmres_solver.cu:235-241: gmres_krylov_dim > 0 caps
+        # the Krylov basis below the restart length
+        kdim = int(cfg.get("gmres_krylov_dim", scope))
+        if kdim > 0:
+            self.restart = min(self.restart, kdim)
 
     def make_solve(self):
         return self._build_solve(self.max_iters, self.monitor_residual)
@@ -76,19 +83,24 @@ class FGMRESSolver(KrylovSolver):
 
                 def mgs(i, wc):
                     w, hcol = wc
-                    h = jnp.where(i <= j, jnp.dot(V[i], w), 0.0)
+                    # conjugated projection (complex modes dZ*/dC*):
+                    # vdot conjugates V[i]; identical to dot for reals
+                    h = jnp.where(i <= j, jnp.vdot(V[i], w), 0.0)
                     w = w - h * V[i]
                     return (w, hcol.at[i].set(h))
 
                 w, hcol = jax.lax.fori_loop(0, m, mgs, (w, hcol))
-                hlast = jnp.sqrt(jnp.dot(w, w))
+                hlast = jnp.sqrt(jnp.real(jnp.vdot(w, w)))
                 hcol = hcol.at[j + 1].set(hlast)
                 V = V.at[j + 1].set(w / jnp.where(hlast > 0, hlast, 1.0))
 
                 # apply existing Givens rotations to the new column
                 def rot(i, hc):
+                    # unitary Givens: [[c, s], [-conj(s), conj(c)]]
+                    # (reduces to the real rotation when dt is real)
                     t = cs[i] * hc[i] + sn[i] * hc[i + 1]
-                    u = -sn[i] * hc[i] + cs[i] * hc[i + 1]
+                    u = (-jnp.conj(sn[i]) * hc[i]
+                         + jnp.conj(cs[i]) * hc[i + 1])
                     do = i < j
                     return hc.at[i].set(jnp.where(do, t, hc[i])).at[
                         i + 1
@@ -96,14 +108,22 @@ class FGMRESSolver(KrylovSolver):
 
                 hcol = jax.lax.fori_loop(0, m, rot, hcol)
                 hj, hj1 = hcol[j], hcol[j + 1]
-                denom = jnp.sqrt(hj * hj + hj1 * hj1)
+                denom = jnp.sqrt(
+                    jnp.real(hj * jnp.conj(hj))
+                    + jnp.real(hj1 * jnp.conj(hj1))
+                )
                 denom = jnp.where(denom > 0, denom, 1.0)
-                c_new, s_new = hj / denom, hj1 / denom
+                # G = [[conj(hj), conj(hj1)], [-hj1, hj]] / denom is
+                # unitary and maps (hj, hj1) -> (denom, 0); real dtypes
+                # recover the classical (c, s) = (hj, hj1)/denom
+                c_new = jnp.conj(hj) / denom
+                s_new = jnp.conj(hj1) / denom
                 hcol = hcol.at[j].set(denom).at[j + 1].set(0.0)
                 cs = cs.at[j].set(c_new)
                 sn = sn.at[j].set(s_new)
                 gj = g[j]
-                g = g.at[j].set(c_new * gj).at[j + 1].set(-s_new * gj)
+                g = g.at[j].set(c_new * gj).at[j + 1].set(
+                    -jnp.conj(s_new) * gj)
                 H = H.at[:, j].set(hcol)
 
                 res_est = jnp.abs(g[j + 1])
@@ -135,7 +155,7 @@ class FGMRESSolver(KrylovSolver):
             def restart_body(c):
                 x, it, hist, status, ini, mx = c
                 r = precond_resid(x)
-                beta = jnp.sqrt(jnp.dot(r, r))
+                beta = jnp.sqrt(jnp.real(jnp.vdot(r, r)))
                 V = jnp.zeros((m + 1, n), dt)
                 V = V.at[0].set(r / jnp.where(beta > 0, beta, 1.0))
                 Z = jnp.zeros((m if flexible else 1, n), dt)
@@ -168,7 +188,7 @@ class FGMRESSolver(KrylovSolver):
             rdt = jnp.zeros((), dt).real.dtype
             hist = jnp.full((max_iters + 1, 1), jnp.nan, rdt)
             r0 = precond_resid(x0)
-            nrm0 = jnp.atleast_1d(jnp.sqrt(jnp.dot(r0, r0)))
+            nrm0 = jnp.atleast_1d(jnp.sqrt(jnp.real(jnp.vdot(r0, r0))))
             hist = hist.at[0].set(nrm0)
             status0 = jnp.where(
                 conv_check(nrm0, nrm0, nrm0) & monitored,

@@ -42,7 +42,8 @@ class MulticolorGSSolver(Solver):
         )
 
         A = scalarized(A, "MULTICOLOR_GS")
-        colors = color_matrix(A, self.scheme, self.deterministic)
+        colors = color_matrix(A, self.scheme, self.deterministic,
+                              cfg=self.cfg, scope=self.scope)
         self.num_colors = nc = int(colors.max()) + 1
         rows_by_color = [np.nonzero(colors == c)[0] for c in range(nc)]
         Asp = A.to_scipy().tocsr()

@@ -37,7 +37,8 @@ class KaczmarzSolver(Solver):
         rownorm2 = np.asarray(sp.multiply(sp).sum(axis=1)).ravel()
         rownorm2 = np.where(rownorm2 > 0, rownorm2, 1.0)
         if self.coloring_needed:
-            colors = color_matrix(A, self.scheme, self.deterministic)
+            colors = color_matrix(A, self.scheme, self.deterministic,
+                              cfg=self.cfg, scope=self.scope)
         else:
             colors = np.zeros(A.n_rows, dtype=np.int32)
         self.num_colors = int(colors.max()) + 1

@@ -46,6 +46,16 @@ def register_solver(name: str):
 def create_solver(cfg, scope: str = "default", param: str = "solver"):
     """Allocate the solver named by cfg param in scope
     (reference SolverFactory::allocate, solver.h:281-310)."""
+    if param == "solver" and scope == "default" \
+            and bool(cfg.get("print_config", scope)):
+        # reference amg_config printAmgConfig: dump the effective
+        # config once at top-level solver creation
+        from amgx_tpu.core.printing import emit
+
+        lines = ["         AMG Configuration:"]
+        for (sc, name_), v in sorted(cfg.items().items()):
+            lines.append(f"           {sc}:{name_} = {v!r}")
+        emit("\n".join(lines))
     name, new_scope = cfg.get_scoped(param, scope)
     cls = SolverRegistry.get(name)
     return cls(cfg, new_scope)

@@ -1,11 +1,31 @@
 #!/usr/bin/env bash
 # CI entry point (reference ci/test.sh runs amgx_tests_launcher).
 # Runs the full suite on the 8-device virtual CPU mesh (including the
-# slow 62-config acceptance sweep), refreshes the acceptance table,
-# then the bench smoke on whatever backend is available.
+# slow 62-config acceptance sweep), the native C-ABI build + demos
+# (round-5: a C-ABI regression fails CI), refreshes the acceptance
+# table, then the bench smoke on whatever backend is available.
 set -e
 cd "$(dirname "$0")/.."
 python -m pytest tests/ -q
 python -m pytest tests/ -q -m slow
+
+# ---- native C ABI (VERDICT r4 #9) -----------------------------------
+# Build from source and run both demos on CPU; assert exit 0 and the
+# expected iteration count from the reference README sample (1 iter).
+make -C native clean all
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+out=$(./native/amgx_capi_demo /root/reference/examples/matrix.mtx \
+      /root/reference/src/configs/FGMRES_AGGREGATION.json)
+echo "$out"
+echo "$out" | grep -q "status=0 iterations=1" || {
+    echo "C-ABI capi demo: unexpected status/iterations" >&2; exit 1; }
+dout=$(./native/amgx_dist_demo) || {
+    echo "C-ABI dist demo failed" >&2; exit 1; }
+echo "$dout" | grep -q "distributed solve: status=0" || {
+    echo "C-ABI dist demo: unexpected status" >&2; exit 1; }
+unset JAX_PLATFORMS
+
 python ci/acceptance.py
 python bench.py

