@@ -153,6 +153,11 @@ def _match_ell_arrays(W: sps.csr_matrix):
     w = int(lens.max()) if lens.size else 0
     if w == 0 or w > _DEVICE_MATCH_MAX_WIDTH:
         return None
+    if len(W.indices) > np.iinfo(np.int32).max:
+        # int32 ranks would silently wrap at >= 2^31 edges and corrupt
+        # selections (ADVICE r4 #1); the host matcher handles the
+        # giant-graph case with int64 arithmetic
+        return None
     r = np.repeat(np.arange(n, dtype=np.int64), lens)
     c = W.indices.astype(np.int64)
     jitter = _edge_jitter(r, c, n)

@@ -659,6 +659,10 @@ def build_classical_level(Asp, cfg, scope, level_id: int = 0):
             P = direct_interpolation(Asp, S, cf)
         elif interp in ("D2", "STD", "STANDARD"):
             P = standard_interpolation(Asp, S, cf)
+        elif interp == "MULTIPASS":
+            # reference multipass.cu works with any selector (F points
+            # may lack direct strong C neighbours)
+            P = multipass_interpolation(Asp, S, cf)
         else:
             warnings.warn(
                 f"interpolator {interp} not yet implemented; "

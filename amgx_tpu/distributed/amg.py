@@ -651,6 +651,7 @@ class DistributedAMG:
                         comm=comm,
                         consolidate_rows=self.consolidate_rows,
                         mesh=self.mesh,
+                        stop_measure=self._stop_measure(),
                     )
                 )
             else:
@@ -675,6 +676,7 @@ class DistributedAMG:
                 Asp, self.n_parts, self.cfg, self.scope,
                 grid=self._grid, owner=self._owner,
                 consolidate_rows=self.consolidate_rows,
+                stop_measure=self._stop_measure(),
             )
         else:
             self.h = build_distributed_hierarchy(

@@ -77,6 +77,16 @@ _CONSOLIDATE_ROWS = 4096
 _GRADE_LOWER = 1024
 
 
+def _stop_rows(own, stop_measure: str) -> int:
+    """Coarsening-stop row measure (reference amg.cu:333-360): the sum
+    of partition rows by default, or the worst (smallest) partition
+    scaled to the part count with stop_measure="min"
+    (use_sum_stopping_criteria=0 semantics)."""
+    if stop_measure == "min":
+        return int(np.asarray(own.counts).min()) * len(own.counts)
+    return own.n_global
+
+
 @dataclasses.dataclass
 class DistLevel:
     """One distributed level: sharded operator + grid-transfer blocks."""
@@ -413,17 +423,9 @@ def build_distributed_hierarchy_local(
     lvl_own: Ownership = ownership
     levels: List[DistLevel] = []
 
-    # reference amg.cu:333-360: the coarsening-stop measure is the sum
-    # of partition rows by default here; stop_measure="min" uses the
-    # worst (smallest) partition scaled to the part count instead
-    # (use_sum_stopping_criteria=0 semantics).
-    def _stop_rows(own):
-        if stop_measure == "min":
-            return int(np.asarray(own.counts).min()) * len(own.counts)
-        return own.n_global
-
     while (
-        _stop_rows(lvl_own) > consolidate_rows and len(levels) < max_levels
+        _stop_rows(lvl_own, stop_measure) > consolidate_rows
+        and len(levels) < max_levels
     ):
         counts = lvl_own.counts
         rows_pp = max(int(counts.max()), 1)
@@ -768,17 +770,9 @@ def build_distributed_hierarchy_block(
             (w, d["cols"], d["indptr"]), shape=(counts_p, nloc)
         )
 
-    # reference amg.cu:333-360: the coarsening-stop measure is the sum
-    # of partition rows by default here; stop_measure="min" uses the
-    # worst (smallest) partition scaled to the part count instead
-    # (use_sum_stopping_criteria=0 semantics).
-    def _stop_rows(own):
-        if stop_measure == "min":
-            return int(np.asarray(own.counts).min()) * len(own.counts)
-        return own.n_global
-
     while (
-        _stop_rows(lvl_own) > consolidate_rows and len(levels) < max_levels
+        _stop_rows(lvl_own, stop_measure) > consolidate_rows
+        and len(levels) < max_levels
     ):
         counts = lvl_own.counts
         rows_pp_cur = max(int(counts.max()), 1)

@@ -272,3 +272,93 @@ def test_distributed_classical_galerkin_matches_global():
     )
     d = abs(Ac_dist - Ac_serial)
     assert d.max() < 1e-10 * max(abs(Ac_serial).max(), 1)
+
+
+MP_CFG = CLASSICAL_CFG.replace('"interpolator": "D1"',
+                               '"interpolator": "MULTIPASS"')
+
+
+def test_distributed_multipass_galerkin_matches_serial():
+    """Round 5 (VERDICT r4 #7): distributed MULTIPASS interpolation —
+    the fine-level distributed Galerkin product equals the serial
+    multipass product (union of shard rows == serial coarse operator,
+    to roundoff)."""
+    import scipy.sparse as sps
+
+    from amgx_tpu.amg.classical import (
+        multipass_interpolation,
+        pmis_select,
+        strength_ahat,
+    )
+
+    from amgx_tpu.distributed.solve import dist_spmv_replicated_check
+
+    Asp = poisson_3d_7pt(12).to_scipy().tocsr()
+    cfg = AMGConfig.from_string(MP_CFG)
+    # 4 parts = contiguous slab partitions, so the distributed coarse
+    # numbering (owner-major) coincides with the serial numbering and
+    # the operators are directly comparable (the D2 galerkin test uses
+    # the same contiguity argument); non-contiguous partitions produce
+    # a symmetric permutation of the same operator (iteration-parity
+    # covered on the 8-way mesh below)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # no D1 fallback
+        h = build_distributed_classical_hierarchy(
+            Asp, 4, cfg, "amg", consolidate_rows=64
+        )
+    S = strength_ahat(Asp, 0.25, 1.1)
+    cf = pmis_select(S)
+    P = multipass_interpolation(Asp, S, cf)
+    Ac_serial = (P.T @ Asp @ P).tocsr()
+    nc = Ac_serial.shape[0]
+    assert h.levels[1].A.n_global == nc
+    # operator-equality via matvec probes on the coarse level
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        x = rng.standard_normal(nc)
+        y_d = dist_spmv_replicated_check(
+            h.levels[1].A, x, mesh1d(4))
+        np.testing.assert_allclose(
+            y_d, Ac_serial @ x, rtol=1e-10, atol=1e-12)
+
+
+def test_distributed_multipass_iters_match_serial():
+    """AMG-PCG with interpolator=MULTIPASS: distributed within +-2
+    iterations of serial, no fallback warning."""
+    import json
+
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.solvers import create_solver
+
+    Asp = poisson_3d_7pt(16).to_scipy().tocsr()
+    n = Asp.shape[0]
+    b = poisson_rhs(n)
+
+    amg_scope = json.loads(MP_CFG)["solver"]
+    pcg_cfg = AMGConfig.from_string(json.dumps({
+        "config_version": 2,
+        "solver": {
+            "scope": "main", "solver": "PCG", "max_iters": 100,
+            "tolerance": 1e-08, "convergence": "RELATIVE_INI",
+            "norm": "L2", "monitor_residual": 1,
+            "preconditioner": amg_scope,
+        },
+    }))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = create_solver(pcg_cfg, "default")
+        s.setup(SparseMatrix.from_scipy(Asp))
+        res = s.solve(b)
+    it_serial = int(res.iters)
+    assert int(res.status) == 0
+
+    cfg = AMGConfig.from_string(MP_CFG)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        sd = DistributedAMG(
+            Asp, mesh1d(8), cfg=cfg, scope="amg", consolidate_rows=256
+        )
+    x, it_dist, _ = sd.solve(b, max_iters=100, tol=1e-8)
+    rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
+    assert rel < 1e-7
+    assert abs(it_dist - it_serial) <= 2, (it_dist, it_serial)
