@@ -27,18 +27,60 @@ def partition_vector_contiguous(n: int, n_parts: int) -> np.ndarray:
 def read_system_distributed(path, n_parts: int, partition_vec=None):
     """Read a global system and split it into per-partition pieces.
 
-    Returns (parts, rhs_parts, partition_vec) where parts[p] is a dict
-    with the partition's global row ids and its local scipy CSR rows
-    (global column space — the caller renumbers via
+    Returns (parts, rhs_parts, partition_vec).  Scalar matrices:
+    parts[p] is {global_rows, A_local} with local scipy CSR rows in
+    the global column space (the caller renumbers via
     :func:`amgx_tpu.distributed.partition.partition_matrix` or keeps
-    global indexing).
+    global indexing).  Block matrices (reference distributed_io.cu
+    block path): parts[p] is {global_rows, block_dims, indptr, cols,
+    vals} — block CSR rows with (nnz, b, b) value blocks, the layout
+    ``DistributedAMG.from_local_parts``-style consumers assemble from.
     """
     Ad, rhs, _sol = read_system(path)
-    if Ad["block_dims"] != (1, 1):
-        raise NotImplementedError(
-            "distributed reads of block matrices are not supported yet"
-        )
+    bx, by = Ad["block_dims"]
     n = Ad["n_rows"]
+    if (bx, by) != (1, 1):
+        if bx != by:
+            raise NotImplementedError(
+                "distributed reads of rectangular-block matrices are "
+                "not supported"
+            )
+        # block matrices (reference distributed_io.cu block path): the
+        # partition vector addresses BLOCK rows; per-part local pieces
+        # keep the (nnz, b*b) block values alongside block csr indexing
+        vals = np.asarray(Ad["vals"]).reshape(-1, bx * by)
+        order = np.lexsort((Ad["cols"], Ad["rows"]))
+        rows_s = np.asarray(Ad["rows"])[order]
+        cols_s = np.asarray(Ad["cols"])[order]
+        vals_s = vals[order]
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(np.bincount(rows_s, minlength=n), out=indptr[1:])
+        if partition_vec is None:
+            partition_vec = partition_vector_contiguous(n, n_parts)
+        partition_vec = np.asarray(partition_vec)
+        parts = []
+        rhs_parts = []
+        for p in range(n_parts):
+            rows = np.nonzero(partition_vec == p)[0]
+            sel = np.concatenate([
+                np.arange(indptr[r], indptr[r + 1]) for r in rows
+            ]) if len(rows) else np.zeros(0, np.int64)
+            lens = (indptr[rows + 1] - indptr[rows]) if len(rows) \
+                else np.zeros(0, np.int64)
+            parts.append(dict(
+                global_rows=rows,
+                block_dims=(bx, by),
+                indptr=np.concatenate([[0], np.cumsum(lens)]),
+                cols=cols_s[sel],
+                vals=vals_s[sel].reshape(-1, bx, by),
+            ))
+            if rhs is None:
+                rhs_parts.append(None)
+            else:
+                sidx = (rows[:, None] * bx
+                        + np.arange(bx)[None, :]).reshape(-1)
+                rhs_parts.append(np.asarray(rhs)[sidx])
+        return parts, rhs_parts, partition_vec
     A = sps.csr_matrix(
         (Ad["vals"], (Ad["rows"], Ad["cols"])), shape=(n, Ad["n_cols"])
     )
@@ -56,10 +98,19 @@ def read_system_distributed(path, n_parts: int, partition_vec=None):
 
 def union_equals_global(parts, A_global: sps.csr_matrix) -> bool:
     """The reference test's assertion: the union of partition rows
-    reproduces the global matrix."""
-    n = A_global.shape[0]
+    reproduces the global matrix.  ``A_global`` is the SCALAR matrix
+    in both cases (block parts are expanded for the comparison)."""
     rebuilt = sps.lil_matrix(A_global.shape)
     for part in parts:
-        rebuilt[part["global_rows"]] = part["A_local"]
+        if "A_local" in part:
+            rebuilt[part["global_rows"]] = part["A_local"]
+            continue
+        bx, by = part["block_dims"]
+        ip, cols, vals = part["indptr"], part["cols"], part["vals"]
+        for li, g in enumerate(part["global_rows"]):
+            for s in range(ip[li], ip[li + 1]):
+                j = cols[s]
+                rebuilt[g * bx:(g + 1) * bx,
+                        j * by:(j + 1) * by] = vals[s]
     diff = abs(rebuilt.tocsr() - A_global)
     return diff.nnz == 0 or float(diff.max()) == 0.0

@@ -200,44 +200,49 @@ class MulticolorDILUSolver(_ColorSweepSmoother):
             einv_full = (1.0 / E).astype(vals.dtype)
         else:
             # block E: E_i = a_ii - sum_lower a_ij Einv_j a_ji
-            # map (i,j) -> slot of (j,i) if present
-            AT = sps.csr_matrix(
-                (np.arange(len(indices)) + 1, indices, indptr),
-                shape=(n, n),
-            ).T.tocsr()
-            AT.sort_indices()
-            trans_slot = np.full(len(indices), -1, dtype=np.int64)
-            # entries of AT are (j,i) slots laid out in the same (row,
-            # col) order as A's pattern iff A's pattern is symmetric;
-            # handle general patterns via searchsorted per row
-            for i in range(n):
-                s0, s1 = indptr[i], indptr[i + 1]
-                cols_i = indices[s0:s1]
-                t0, t1 = AT.indptr[i], AT.indptr[i + 1]
-                at_cols = AT.indices[t0:t1]
-                at_slot = AT.data[t0:t1] - 1
-                pos = np.searchsorted(at_cols, cols_i)
-                ok = (pos < at_cols.shape[0]) & (
-                    at_cols[np.minimum(pos, len(at_cols) - 1)] == cols_i
-                )
-                trans_slot[s0:s1][ok] = at_slot[pos[ok]]
+            # map (i,j) -> slot of (j,i) if present — one global
+            # lexsorted searchsorted (the per-row loop was O(n) Python)
+            order = np.lexsort((indices, row_ids))
+            key_s = (row_ids[order].astype(np.int64) * (n + 1)
+                     + indices[order])
+            tkey = (indices.astype(np.int64) * (n + 1) + row_ids)
+            pos = np.searchsorted(key_s, tkey)
+            ok = (pos < key_s.shape[0]) & (
+                key_s[np.minimum(pos, len(key_s) - 1)] == tkey
+            )
+            trans_slot = np.where(
+                ok, order[np.minimum(pos, len(order) - 1)], -1
+            )
             Einv = np.zeros((n, b, b), dtype=vals.dtype)
             E = diag.astype(vals.dtype).copy()
             eye = np.eye(b, dtype=vals.dtype)
+            col_of_entry = colors[indices]
+            row_of_entry = colors[row_ids]
             for c in range(nc):
                 rows_c = rows_by_color[c]
                 if rows_c.size == 0:
                     continue
                 if c > 0:
-                    # correction: sum over lower entries with transpose
-                    for i in rows_c:
-                        acc = np.zeros((b, b), vals.dtype)
-                        for s in range(indptr[i], indptr[i + 1]):
-                            j = indices[s]
-                            ts = trans_slot[s]
-                            if colors[j] < c and ts >= 0:
-                                acc += vals[s] @ Einv[j] @ vals[ts]
-                        E[i] = diag[i] - acc
+                    # batched correction (one einsum per color — the
+                    # per-row Python loop made 64^3 block setups take
+                    # minutes): entries of color-c rows whose column
+                    # color is lower and whose transpose entry exists
+                    in_c = (
+                        (row_of_entry == c)
+                        & (col_of_entry < c)
+                        & (trans_slot >= 0)
+                        & (indices != row_ids)
+                    )
+                    if in_c.any():
+                        ei = row_ids[in_c]
+                        prod = np.einsum(
+                            "nij,njk,nkl->nil",
+                            vals[in_c],
+                            Einv[indices[in_c]],
+                            vals[np.maximum(trans_slot[in_c], 0)],
+                        )
+                        E[rows_c] = diag[rows_c]
+                        np.add.at(E, ei, -prod)
                 # invert (guarded)
                 blk = E[rows_c]
                 dets_ok = np.abs(np.linalg.det(blk)) > 1e-300

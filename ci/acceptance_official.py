@@ -1,0 +1,130 @@
+"""Official-size acceptance runs (VERDICT r4 #6; BASELINE.md configs
+2-3 at their real sizes).
+
+Runs sequentially (RAM discipline on the single-core CPU host):
+  1. 256^3 Poisson-7pt, PCG + Jacobi preconditioner  (config 2)
+  2. 512^3 Poisson-7pt, classical PMIS + D1 V-cycle  (config 3)
+
+Records wall-clock (setup/solve split), first-compile time, iteration
+count, and peak RSS; one JSON line each, appended to
+ACCEPTANCE_OFFICIAL.jsonl.  Reduced-size versions stay in CI; this
+script is the one-off official-scale evidence run.
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def rss_gb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+
+
+def run_case(name, n_side, cfg_str, dtype_name, out_path):
+    import numpy as np
+
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.io.poisson import poisson_3d_7pt, poisson_rhs
+    from amgx_tpu.solvers import create_solver
+
+    dtype = np.dtype(dtype_name)
+    t0 = time.perf_counter()
+    A = poisson_3d_7pt(n_side, dtype=dtype)
+    b = poisson_rhs(A.n_rows, dtype=dtype)
+    gen_s = time.perf_counter() - t0
+
+    cfg = AMGConfig.from_string(cfg_str)
+    s = create_solver(cfg, "default")
+    t0 = time.perf_counter()
+    s.setup(A)
+    setup_s = time.perf_counter() - t0
+    # first solve includes XLA compile; second isolates iteration cost
+    t0 = time.perf_counter()
+    res = s.solve(b)
+    first_solve_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = s.solve(b)
+    solve_s = time.perf_counter() - t0
+    rec = {
+        "case": name,
+        "n_side": n_side,
+        "rows": A.n_rows,
+        "nnz": A.nnz,
+        "dtype": dtype_name,
+        "generate_s": round(gen_s, 1),
+        "setup_s": round(setup_s, 1),
+        "first_solve_s_incl_compile": round(first_solve_s, 1),
+        "solve_s": round(solve_s, 1),
+        "iterations": int(res.iters),
+        "converged": bool(res.converged),
+        "per_iteration_s": round(solve_s / max(int(res.iters), 1), 3),
+        "peak_rss_gb": round(rss_gb(), 1),
+        "device": "cpu (1 core; official-size evidence run)",
+    }
+    if hasattr(s, "precond") and hasattr(s.precond, "levels"):
+        rec["levels"] = len(s.precond.levels)
+        rec["operator_complexity"] = round(
+            sum(l.nnz for l in s.precond.levels)
+            / max(s.precond.levels[0].nnz, 1), 3)
+        prof = getattr(s.precond, "setup_profile", {})
+        if prof:
+            rec["setup_pipeline"] = {
+                k: round(v, 1) if isinstance(v, float) else v
+                for k, v in prof.items()
+            }
+    with open(out_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+PCG_JACOBI = (
+    '{"config_version": 2, "solver": {"scope": "main", '
+    '"solver": "PCG", "max_iters": 1000, "tolerance": 1e-8, '
+    '"convergence": "RELATIVE_INI", "monitor_residual": 1, '
+    '"preconditioner": {"scope": "jac", "solver": "BLOCK_JACOBI", '
+    '"relaxation_factor": 1.0, "monitor_residual": 0}}}'
+)
+
+CLASSICAL = (
+    '{"config_version": 2, "solver": {"scope": "main", '
+    '"solver": "PCG", "max_iters": 200, "tolerance": 1e-8, '
+    '"convergence": "RELATIVE_INI", "monitor_residual": 1, '
+    '"preconditioner": {"scope": "amg", "solver": "AMG", '
+    '"algorithm": "CLASSICAL", "selector": "PMIS", '
+    '"interpolator": "D1", "smoother": {"scope": "j", '
+    '"solver": "BLOCK_JACOBI", "relaxation_factor": 0.8, '
+    '"monitor_residual": 0}, "max_iters": 1, "max_levels": 20, '
+    '"min_coarse_rows": 256, "coarse_solver": "DENSE_LU_SOLVER", '
+    '"cycle": "V", "monitor_residual": 0, '
+    '"setup_location": "%s"}}}'
+)
+
+
+def main():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ACCEPTANCE_OFFICIAL.jsonl")
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("both", "pcg"):
+        run_case("pcg_jacobi_256", 256, PCG_JACOBI, "float64", out)
+    if which in ("both", "classical"):
+        # HOST setup: the proven scipy pipeline; the device pipeline's
+        # official-size profile is ci/setup_profile.py's job
+        run_case("classical_pmis_d1_512", 512, CLASSICAL % "HOST",
+                 "float64", out)
+
+
+if __name__ == "__main__":
+    main()

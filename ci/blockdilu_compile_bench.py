@@ -1,0 +1,83 @@
+"""64^3 block-DILU compile-time evidence (VERDICT r4 #5 'Done' bar).
+
+Measures end-to-end wall (setup, first solve incl. XLA compile, warm
+solve) for serial MULTICOLOR_DILU-preconditioned PCG on a b=4 block
+3D Poisson (kron with a coupled SPD 4x4 block), with the default
+(2-4 color) coloring and with MULTI_HASH (many colors — the regime
+whose unrolled sweeps hit the round-4 compile wall; the stacked fori
+sweep engages at >= 6 colors).  One JSON line per case.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+    import scipy.sparse as sps
+
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.io.poisson import poisson_3d_7pt
+    from amgx_tpu.solvers import create_solver
+
+    n1d = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    b = 4
+    L = poisson_3d_7pt(n1d).to_scipy().tocsr()
+    rng = np.random.default_rng(3)
+    B = np.eye(b) + 0.2 * np.ones((b, b)) + np.diag(rng.random(b))
+    A = SparseMatrix.from_scipy(
+        sps.kron(L, B, format="csr"), block_size=b)
+    rhs = np.ones(A.n_rows * b)
+
+    for scheme in ("MIN_MAX", "MULTI_HASH"):
+        cfg = AMGConfig.from_string(
+            '{"config_version": 2, "solver": {"scope": "main", '
+            '"solver": "PCG", "max_iters": 60, "tolerance": 1e-8, '
+            '"convergence": "RELATIVE_INI", "monitor_residual": 1, '
+            '"preconditioner": {"scope": "d", '
+            '"solver": "MULTICOLOR_DILU", "relaxation_factor": 1.0, '
+            f'"matrix_coloring_scheme": "{scheme}", '
+            '"monitor_residual": 0}}}'
+        )
+        s = create_solver(cfg, "default")
+        t0 = time.perf_counter()
+        s.setup(A)
+        setup_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res = s.solve(rhs)
+        first_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res = s.solve(rhs)
+        warm_s = time.perf_counter() - t0
+        print(json.dumps({
+            "case": f"block_dilu_b{b}_{n1d}^3",
+            "coloring": scheme,
+            "block_rows": A.n_rows,
+            "colors": int(getattr(s.precond, "num_colors", 0))
+            if hasattr(s, "precond") else None,
+            "fori_sweep": bool(getattr(s.precond, "_fori", False))
+            if hasattr(s, "precond") else None,
+            "setup_s": round(setup_s, 1),
+            "first_solve_s_incl_compile": round(first_s, 1),
+            "warm_solve_s": round(warm_s, 1),
+            "iterations": int(res.iters),
+            "converged": bool(res.converged),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
