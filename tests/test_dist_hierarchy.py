@@ -299,3 +299,60 @@ def test_distributed_setup_deterministic():
         np.testing.assert_array_equal(a.A.ell_vals, b.A.ell_vals)
         np.testing.assert_array_equal(a.A.owner, b.A.owner)
     assert (h1.tail_matrix != h2.tail_matrix).nnz == 0
+
+
+def test_scalar_block_builder_protocol_lockstep():
+    """ADVICE r4 #2 guard: the scalar and block distributed builders
+    mirror one collective protocol step for step (MAINTENANCE NOTE in
+    build_distributed_hierarchy_block).  Until the loop is parametrized
+    on a value-combine callback, this test pins the invariant that
+    matters at runtime: on matched problems (L vs L ⊗ I_b with the
+    same partition), both builders drive the comm fabric through the
+    SAME sequence of round kinds — a protocol edit applied to only one
+    builder fails here instead of desyncing SPMD ranks."""
+    import scipy.sparse as sps
+
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.distributed.hierarchy import (
+        build_distributed_hierarchy,
+        build_distributed_hierarchy_block,
+    )
+
+    L = poisson_3d_7pt(10).to_scipy().tocsr()
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "amg",'
+        ' "solver": "AMG", "algorithm": "AGGREGATION",'
+        ' "selector": "SIZE_2", "monitor_residual": 0}}'
+    )
+
+    h_s = build_distributed_hierarchy(
+        L, 4, cfg, "amg", consolidate_rows=64,
+    )
+    kinds_s = [r["kind"] for r in h_s.comm.stats.rounds]
+
+    b = 2
+    Ab = sps.kron(L, np.eye(b), format="csr")
+    h_b = build_distributed_hierarchy_block(
+        Ab, 4, b, cfg, "amg", consolidate_rows=64,
+    )
+    kinds_b = [r["kind"] for r in h_b.comm.stats.rounds]
+
+    # identical per-level protocol: the repeating per-level round
+    # pattern (split at 'coarse-counts') must be the same chunk for
+    # every level of BOTH builders, and the tails must match (level
+    # counts may differ — block bookkeeping counts scalar unknowns)
+    def chunks(kinds):
+        out, cur = [], []
+        for k in kinds:
+            if k == "coarse-counts" and cur:
+                out.append(tuple(cur))
+                cur = []
+            cur.append(k)
+        out.append(tuple(cur))
+        return out
+
+    cs, cb = chunks(kinds_s), chunks(kinds_b)
+    # every full level chunk identical across levels and builders
+    level_chunks = {c for c in cs[:-1] + cb[:-1]}
+    assert len(level_chunks) == 1, level_chunks
+    assert cs[-1] == cb[-1], (cs[-1], cb[-1])  # tail glue
