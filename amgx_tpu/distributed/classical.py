@@ -278,6 +278,200 @@ def _pmis_distributed(
     return {p: (state[p] == 1).astype(np.int8) for p in my_parts}
 
 
+
+def _coarse_numbering_and_colinfo(
+    cf, lvl_parts, lvl_own: Ownership, comm, my_parts, rows_pp: int,
+    kind_prefix: str = "",
+):
+    """Shared coarse-numbering + halo-column-info stage (owners number
+    their C points; ghost C/F flags + global coarse ids ride one
+    targeted exchange).  Used by the main setup loop and the
+    aggressive stage-2 refine — ONE copy of the numbering/halo
+    assembly logic.
+
+    Returns (ncs, coffsets, own_c, gcid, reqs, colinfo)."""
+    counts = lvl_own.counts
+    ncs = np.asarray(
+        comm.allgather(
+            {p: int(cf[p].sum()) for p in my_parts},
+            kind=kind_prefix + "coarse-counts",
+        ),
+        dtype=np.int64,
+    )
+    coffsets = np.concatenate([[0], np.cumsum(ncs)])
+    own_c = OffsetOwnership(coffsets)
+    gcid = {}
+    for p in my_parts:
+        g = np.full(int(counts[p]), -1, dtype=np.int64)
+        cm = np.cumsum(cf[p]) - 1
+        sel = cf[p] == 1
+        g[sel] = coffsets[p] + cm[sel]
+        gcid[p] = g
+    reqs = {}
+    for p in my_parts:
+        hg = lvl_parts[p]["halo_glob"]
+        if not len(hg):
+            continue
+        owners = lvl_own.owner_of(hg)
+        reqs[p] = {
+            int(o): hg[owners == o] for o in np.unique(owners)
+        }
+    ans = fetch_by_owner(
+        comm, reqs,
+        lambda o, ids: np.stack([
+            cf[o][lvl_own.local_of_ids(ids)].astype(np.int64),
+            gcid[o][lvl_own.local_of_ids(ids)],
+        ]),
+        kind=kind_prefix + "halo-cf",
+    )
+    colinfo = {}
+    for p in my_parts:
+        nloc = lvl_parts[p]["A"].shape[1]
+        cf_col = np.zeros(nloc, dtype=np.int8)
+        gc_col = np.full(nloc, -1, dtype=np.int64)
+        cf_col[: int(counts[p])] = cf[p]
+        gc_col[: int(counts[p])] = gcid[p]
+        hg = lvl_parts[p]["halo_glob"]
+        if len(hg):
+            owners = lvl_own.owner_of(hg)
+            cfh = np.zeros(len(hg), dtype=np.int8)
+            gch = np.full(len(hg), -1, dtype=np.int64)
+            for o, v in ans.get(p, {}).items():
+                m = owners == o
+                cfh[m] = v[0].astype(np.int8)
+                gch[m] = v[1]
+            cf_col[rows_pp: rows_pp + len(hg)] = cfh
+            gc_col[rows_pp: rows_pp + len(hg)] = gch
+        colinfo[p] = (cf_col, gc_col)
+    return ncs, coffsets, own_c, gcid, reqs, colinfo
+
+
+def _aggressive_pmis_refine(
+    lvl_parts, lvl_own: Ownership, comm, my_parts, S_parts, cf1,
+    rows_pp: int,
+):
+    """Distributed two-stage aggressive coarsening, stage 2 (reference
+    selectors AGGRESSIVE_PMIS; serial ``aggressive_pmis_select``):
+    PMIS with seed 1 among the stage-1 C points on the distance-2
+    strength graph S ∪ S·S, restricted to C x C with the diagonal
+    dropped.  The C-subgraph is built per part — distance-2 paths
+    through halo midpoints ride one targeted exchange that ships each
+    halo node's strong->C(stage-1) targets in stage-1-compacted global
+    coarse ids, so the stage-2 hash weights (and hence the selection)
+    are identical to the serial refine on contiguous partitions.
+
+    Returns cf_final[p] (int8 per owned row, 1 = C).
+    """
+    counts = lvl_own.counts
+    # stage-1 compacted coarse numbering + ghost C/F info (shared
+    # helper — one copy of the numbering/halo assembly logic)
+    ncs1, coffsets1, own_c1, gcid1, reqs, colinfo = (
+        _coarse_numbering_and_colinfo(
+            cf1, lvl_parts, lvl_own, comm, my_parts, rows_pp,
+            kind_prefix="agg2-",
+        )
+    )
+
+    # strong->C(stage-1) targets of each owned row, as compacted gcids
+    def strongC_row_targets(o, li):
+        S = S_parts[o].tocsr()
+        cf_col_o, gc_col_o = colinfo[o]
+        sub = S[li].tocoo()
+        m = cf_col_o[sub.col] == 1
+        tgts = gc_col_o[sub.col[m]]
+        iptr = np.concatenate(
+            [[0], np.cumsum(np.bincount(sub.row[m], minlength=len(li)))]
+        ).astype(np.int64)
+        return iptr, tgts
+
+    # fetch halo nodes' strong->C targets (the distance-2 midpoint ring)
+    ans2 = fetch_by_owner(
+        comm, reqs,
+        lambda o, ids: strongC_row_targets(
+            o, lvl_own.local_of_ids(ids)),
+        kind="agg2-halo-s2",
+    )
+
+    # build the per-part C-subgraph (global coarse ids) with sparse
+    # algebra: M maps every local slot to its strong->C target gcids
+    # (owned rows from S, halo slots from the fetched payloads); then
+    # Sc rows = M[C rows] ∪ (B[C rows] @ M) — the vectorized form of
+    # the serial Sb + Sb@Sb restricted to C x C
+    Sc_parts = {}
+    pseudo_parts = {}
+    rows_pp_c = max(int(ncs1.max()), 1)
+    for p in my_parts:
+        S = S_parts[p].tocsr()
+        cf_col, gc_col = colinfo[p]
+        hg = lvl_parts[p]["halo_glob"]
+        nloc = S.shape[1]
+        nr = int(counts[p])
+        # M: (nloc x nc_global) strong->C map in gcid columns
+        m_rows = [np.repeat(np.arange(nr, dtype=np.int64),
+                            np.diff(S.indptr))]
+        m_cols = [S.indices.astype(np.int64)]
+        keep0 = cf_col[m_cols[0]] == 1
+        m_rows[0] = m_rows[0][keep0]
+        m_cols[0] = gc_col[m_cols[0][keep0]]
+        if len(hg):
+            owners = lvl_own.owner_of(hg)
+            for o, (iptr, tgts) in ans2.get(p, {}).items():
+                ids = reqs[p][o]
+                slots = rows_pp + np.searchsorted(hg, ids)
+                lens = np.diff(iptr)
+                m_rows.append(np.repeat(
+                    slots.astype(np.int64), lens))
+                m_cols.append(np.asarray(tgts, dtype=np.int64))
+        mr = np.concatenate(m_rows)
+        mc = np.concatenate(m_cols)
+        nc_glob = int(coffsets1[-1])
+        M = sps.csr_matrix(
+            (np.ones(len(mr), dtype=np.int8), (mr, mc)),
+            shape=(nloc, max(nc_glob, 1)),
+        )
+        c_rows_loc = np.nonzero(cf1[p] == 1)[0]
+        B = S[c_rows_loc].astype(bool).astype(np.int8)
+        Sc_g = (M[c_rows_loc] + B @ M).tocsr()  # (nc_p x nc_global)
+        Sc_g.sum_duplicates()
+        # drop the diagonal (own coarse id)
+        coo = Sc_g.tocoo()
+        own_id = gcid1[p][c_rows_loc]
+        keep = coo.col != own_id[coo.row]
+        er = coo.row[keep].astype(np.int64)
+        ec = coo.col[keep].astype(np.int64)
+        is_owned = own_c1.owner_of(ec) == p if len(ec) else \
+            np.zeros(0, bool)
+        cols_loc, halo_c = halo_localize(
+            ec, is_owned,
+            own_c1.local_of_ids(ec[is_owned]) if len(ec) else
+            np.zeros(0, np.int64),
+            rows_pp_c,
+        )
+        nloc_c = rows_pp_c + len(halo_c)
+        iptr = np.concatenate(
+            [[0], np.cumsum(np.bincount(
+                er, minlength=int(ncs1[p])))]
+        ).astype(np.int64)
+        order = np.argsort(er, kind="stable")
+        Sc_parts[p] = sps.csr_matrix(
+            (np.ones(len(ec), dtype=np.int8), cols_loc[order], iptr),
+            shape=(int(ncs1[p]), nloc_c),
+        )
+        pseudo_parts[p] = dict(A=Sc_parts[p], halo_glob=halo_c)
+
+    cf2 = _pmis_distributed(
+        pseudo_parts, own_c1, comm, my_parts, Sc_parts, rows_pp_c,
+        seed=1,
+    )
+    out = {}
+    for p in my_parts:
+        cf = np.zeros(int(counts[p]), dtype=np.int8)
+        c_rows_loc = np.nonzero(cf1[p] == 1)[0]
+        cf[c_rows_loc[cf2[p] == 1]] = 1
+        out[p] = cf
+    return out
+
+
 def _direct_interpolation_local(
     A_local: sps.csr_matrix, S_local: sps.csr_matrix, counts_p: int,
     cf_row: np.ndarray, cf_col: np.ndarray, gc_col: np.ndarray,
@@ -862,6 +1056,13 @@ def build_distributed_classical_hierarchy_local(
             "(D1, D2/standard and MULTIPASS are the distributed "
             "roster)"
         )
+    selector = str(cfg.get("selector", scope)).upper()
+    aggressive_levels = int(cfg.get("aggressive_levels", scope))
+    aggressive_interp = str(
+        cfg.get("aggressive_interpolator", scope)).upper()
+    always_aggressive = selector in (
+        "AGGRESSIVE_PMIS", "AGGRESSIVE_HMIS",
+    )
 
     lvl_parts = init_lvl_parts(local_parts, ownership, my_parts)
     lvl_own: Ownership = ownership
@@ -889,76 +1090,42 @@ def build_distributed_classical_hierarchy_local(
         cf = _pmis_distributed(
             lvl_parts, lvl_own, comm, my_parts, S_parts, rows_pp
         )
+        # aggressive two-stage coarsening (reference AGGRESSIVE_PMIS /
+        # aggressive_levels): refine stage-1 C points by PMIS on the
+        # distance-2 C-subgraph, then interpolate with MULTIPASS
+        lvl_aggressive = (
+            len(levels) < aggressive_levels or always_aggressive
+        )
+        if lvl_aggressive:
+            if aggressive_interp != "MULTIPASS":
+                import warnings
 
-        # ---- coarse numbering: owners number their C points --------
-        ncs = np.asarray(
-            comm.allgather(
-                {p: int(cf[p].sum()) for p in my_parts},
-                kind="coarse-counts",
-            ),
-            dtype=np.int64,
+                warnings.warn(
+                    f"aggressive interpolator {aggressive_interp}: "
+                    "using MULTIPASS"
+                )
+            cf = _aggressive_pmis_refine(
+                lvl_parts, lvl_own, comm, my_parts, S_parts, cf,
+                rows_pp,
+            )
+        lvl_use_mp = use_mp or lvl_aggressive
+
+        # ---- coarse numbering + ghost C/F info (shared helper) -----
+        ncs, coffsets, own_c, gcid, reqs, colinfo = (
+            _coarse_numbering_and_colinfo(
+                cf, lvl_parts, lvl_own, comm, my_parts, rows_pp,
+            )
         )
         nc_global = int(ncs.sum())
         if nc_global >= lvl_own.n_global or nc_global == 0:
             break
-        coffsets = np.concatenate([[0], np.cumsum(ncs)])
-        own_c = OffsetOwnership(coffsets)
-
-        # global coarse id per owned row (C points only; -1 for F)
-        gcid = {}
-        for p in my_parts:
-            g = np.full(int(counts[p]), -1, dtype=np.int64)
-            cm = np.cumsum(cf[p]) - 1
-            sel = cf[p] == 1
-            g[sel] = coffsets[p] + cm[sel]
-            gcid[p] = g
-
-        # ---- ghost C/F + coarse ids for halo columns ---------------
-        reqs = {}
-        for p in my_parts:
-            hg = lvl_parts[p]["halo_glob"]
-            if not len(hg):
-                continue
-            owners = lvl_own.owner_of(hg)
-            reqs[p] = {
-                int(o): hg[owners == o] for o in np.unique(owners)
-            }
-        ans = fetch_by_owner(
-            comm, reqs,
-            lambda o, ids: np.stack([
-                cf[o][lvl_own.local_of_ids(ids)].astype(np.int64),
-                gcid[o][lvl_own.local_of_ids(ids)],
-            ]),
-            kind="halo-cf",
-        )
-
-        # ---- per-part local column info (cf / coarse id per slot) --
-        colinfo = {}
-        for p in my_parts:
-            nloc = lvl_parts[p]["A"].shape[1]
-            cf_col = np.zeros(nloc, dtype=np.int8)
-            gc_col = np.full(nloc, -1, dtype=np.int64)
-            cf_col[: int(counts[p])] = cf[p]
-            gc_col[: int(counts[p])] = gcid[p]
-            hg = lvl_parts[p]["halo_glob"]
-            if len(hg):
-                owners = lvl_own.owner_of(hg)
-                cfh = np.zeros(len(hg), dtype=np.int8)
-                gch = np.full(len(hg), -1, dtype=np.int64)
-                for o, v in ans.get(p, {}).items():
-                    m = owners == o
-                    cfh[m] = v[0].astype(np.int8)
-                    gch[m] = v[1]
-                cf_col[rows_pp: rows_pp + len(hg)] = cfh
-                gc_col[rows_pp: rows_pp + len(hg)] = gch
-            colinfo[p] = (cf_col, gc_col)
 
         # ---- D2: fetch halo F rows' strong-C and sign-restricted
         # F->C data in GLOBAL coarse ids (the second-ring structural
         # content of reference distance2.cu, ridden as one targeted
         # exchange instead of a second halo ring) -------------------
         halo_d2 = {}
-        if use_d2:
+        if use_d2 and not lvl_use_mp:
             reqs2 = {}
             for p in my_parts:
                 hg = lvl_parts[p]["halo_glob"]
@@ -984,7 +1151,7 @@ def build_distributed_classical_hierarchy_local(
             )
 
         # ---- interpolation of owned rows ---------------------------
-        if use_mp:
+        if lvl_use_mp:
             P_parts = _multipass_interpolation_distributed(
                 lvl_parts, lvl_own, comm, my_parts, S_parts, cf,
                 colinfo, counts, rows_pp,
@@ -997,7 +1164,7 @@ def build_distributed_classical_hierarchy_local(
         else:
             P_parts = {}
         # p -> (P csr compact, global coarse col ids)
-        for p in (() if use_mp else my_parts):
+        for p in (() if lvl_use_mp else my_parts):
             cf_col, gc_col = colinfo[p]
             if use_d2:
                 hg = lvl_parts[p]["halo_glob"]

@@ -362,3 +362,72 @@ def test_distributed_multipass_iters_match_serial():
     rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
     assert rel < 1e-7
     assert abs(it_dist - it_serial) <= 2, (it_dist, it_serial)
+
+
+def test_distributed_aggressive_matches_serial():
+    """Round 5: distributed two-stage aggressive coarsening — the
+    stage-2 C/F refine reproduces the serial aggressive_pmis_select
+    coarse count, the Galerkin operator matches the serial
+    aggressive+MULTIPASS product (contiguous partitions), and the
+    AMG-PCG iteration count stays within +-2 of serial on the 8-way
+    mesh."""
+    import json
+
+    from amgx_tpu.amg.classical import (
+        aggressive_pmis_select,
+        multipass_interpolation,
+        strength_ahat,
+    )
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.distributed.solve import dist_spmv_replicated_check
+    from amgx_tpu.solvers import create_solver
+
+    AGG_CFG = CLASSICAL_CFG.replace(
+        '"interpolator": "D1"',
+        '"interpolator": "D1", "aggressive_levels": 1')
+
+    Asp = poisson_3d_7pt(12).to_scipy().tocsr()
+    S = strength_ahat(Asp, 0.25, 1.1)
+    cf = aggressive_pmis_select(S)
+    P = multipass_interpolation(Asp, S, cf)
+    Ac_serial = (P.T @ Asp @ P).tocsr()
+    nc = Ac_serial.shape[0]
+
+    h = build_distributed_classical_hierarchy(
+        Asp, 4, AMGConfig.from_string(AGG_CFG), "amg",
+        consolidate_rows=32,
+    )
+    assert h.levels[1].A.n_global == nc
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        x = rng.standard_normal(nc)
+        y_d = dist_spmv_replicated_check(h.levels[1].A, x, mesh1d(4))
+        np.testing.assert_allclose(
+            y_d, Ac_serial @ x, rtol=1e-10, atol=1e-12)
+
+    # iteration parity on the 8-way mesh
+    amg_scope = json.loads(AGG_CFG)["solver"]
+    pcg_cfg = AMGConfig.from_string(json.dumps({
+        "config_version": 2,
+        "solver": {
+            "scope": "main", "solver": "PCG", "max_iters": 100,
+            "tolerance": 1e-08, "convergence": "RELATIVE_INI",
+            "norm": "L2", "monitor_residual": 1,
+            "preconditioner": amg_scope,
+        },
+    }))
+    b = poisson_rhs(Asp.shape[0])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = create_solver(pcg_cfg, "default")
+        s.setup(SparseMatrix.from_scipy(Asp))
+        res = s.solve(b)
+    it_serial = int(res.iters)
+    sd = DistributedAMG(
+        Asp, mesh1d(8), cfg=AMGConfig.from_string(AGG_CFG),
+        scope="amg", consolidate_rows=128,
+    )
+    x, it_dist, _ = sd.solve(b, max_iters=100, tol=1e-8)
+    rel = np.linalg.norm(b - Asp @ x) / np.linalg.norm(b)
+    assert rel < 1e-7
+    assert abs(it_dist - it_serial) <= 2, (it_dist, it_serial)
