@@ -116,6 +116,47 @@ def strength_all(Asp: sps.csr_matrix):
     return S
 
 
+def strong_entry_flags(Asp: sps.csr_matrix,
+                       S: sps.csr_matrix,
+                       chunk_rows: int = 2_000_000) -> np.ndarray:
+    """Membership flag per A entry: (i, j) in S's pattern.
+
+    A general (row-aligned) membership test: chunked key build +
+    chunk-local sort + searchsorted, replacing the old ``np.isin``
+    over global int64 keys, whose internal sort peaked at tens of GB
+    at 512^3 (the single-host OOM regime).  Workspace is bounded by
+    ``chunk_rows`` worth of keys; neither matrix needs sorted
+    within-row columns and S's pattern need not be a subset of A's."""
+    indptr, indices = Asp.indptr, Asp.indices
+    Sp, Si = S.indptr, S.indices
+    n = indptr.shape[0] - 1
+    ncol = np.int64(Asp.shape[1])
+    out = np.zeros(indices.shape[0], dtype=bool)
+    for r0 in range(0, n, chunk_rows):
+        r1 = min(r0 + chunk_rows, n)
+        a0, a1 = int(indptr[r0]), int(indptr[r1])
+        s0, s1 = int(Sp[r0]), int(Sp[r1])
+        if a1 == a0 or s1 == s0:
+            continue
+        arow = np.repeat(
+            np.arange(r0, r1, dtype=np.int64),
+            np.diff(indptr[r0: r1 + 1]).astype(np.int64),
+        )
+        akey = arow * ncol + indices[a0:a1]
+        srow = np.repeat(
+            np.arange(r0, r1, dtype=np.int64),
+            np.diff(Sp[r0: r1 + 1]).astype(np.int64),
+        )
+        skey = np.sort(srow * ncol + Si[s0:s1])
+        # np.sort: column order within rows is NOT guaranteed sorted
+        # (distributed local blocks store owned-first then halo slots);
+        # the sort is chunk-local, so workspace stays bounded
+        pos = np.searchsorted(skey, akey)
+        safe = np.minimum(pos, len(skey) - 1)
+        out[a0:a1] = (pos < len(skey)) & (skey[safe] == akey)
+    return out
+
+
 def _hash_weights(n: int, seed: int = 0x9E3779B9) -> np.ndarray:
     """Deterministic pseudo-random tie-break weights in [0,1)."""
     idx = np.arange(n, dtype=np.uint64)
@@ -390,10 +431,8 @@ def direct_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
     offd = indices != row_ids
 
     # strong flag per A entry: membership of (i,j) in S's sparsity
-    Scoo = S.tocoo()
-    s_keys = Scoo.row.astype(np.int64) * n + Scoo.col
-    a_keys = row_ids.astype(np.int64) * n + indices
-    strong_flag = np.isin(a_keys, s_keys)
+    # (chunked searchsorted, bounded workspace — see strong_entry_flags)
+    strong_flag = strong_entry_flags(Asp, S)
 
     is_C_col = cf[indices] == 1
     neg = data < 0

@@ -47,6 +47,7 @@ import scipy.sparse as sps
 
 from amgx_tpu.amg.classical import (
     strength_ahat,
+    strong_entry_flags,
     truncate_interp,
 )
 from amgx_tpu.distributed.comm import LoopbackComm, fetch_by_owner
@@ -492,12 +493,8 @@ def _direct_interpolation_local(
     offd = indices != row_ids
 
     # strong flag per A entry: S shares A's row structure only where
-    # entries survived; look up by (row, col) keys
-    Scoo = S_local.tocoo()
-    ncol = A_local.shape[1]
-    s_keys = Scoo.row.astype(np.int64) * ncol + Scoo.col
-    a_keys = row_ids.astype(np.int64) * ncol + indices
-    strong_flag = np.isin(a_keys, s_keys)
+    # entries survived (chunked searchsorted — strong_entry_flags)
+    strong_flag = strong_entry_flags(A_local, S_local)
 
     is_C_col = cf_col[indices] == 1
     neg = data < 0
@@ -636,13 +633,10 @@ def _multipass_interpolation_distributed(
         nr = int(counts[p])
         ncol = A_l.shape[1]
         row_ids = np.repeat(np.arange(nr), np.diff(A_l.indptr))
-        s_keys = S_l.tocoo()
-        sk = s_keys.row.astype(np.int64) * ncol + s_keys.col
-        ak = row_ids.astype(np.int64) * ncol + A_l.indices
-        strong = np.isin(ak, sk) & (A_l.indices != row_ids)
+        offd = A_l.indices != row_ids
+        strong = strong_entry_flags(A_l, S_l) & offd
         diag = np.asarray(A_l.diagonal())[:nr]
         row_total = np.zeros(nr)
-        offd = A_l.indices != row_ids
         np.add.at(row_total, row_ids,
                   np.where(offd, A_l.data, 0.0))
         st[p] = dict(
