@@ -209,9 +209,22 @@ def main(out="ACCEPTANCE.md"):
             f"| {label} | {it} | {rate_s} | {rel:.2e} | {st} |"
         )
     lines.append("")
+    # preserve any hand-maintained appendix below the generated table
+    # (e.g. the round-5 official-size section) across regenerations
+    tail = ""
+    try:
+        with open(out) as f:
+            prev = f.read()
+        marker = "\n## "
+        if marker in prev:
+            tail = prev[prev.index(marker):]
+    except FileNotFoundError:
+        pass
     with open(out, "w") as f:
         f.write("\n".join(lines))
-    print("\n".join(lines))
+        if tail:
+            f.write(tail)
+    print("\n".join(lines) + tail)
 
 
 if __name__ == "__main__":
