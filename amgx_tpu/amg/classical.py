@@ -712,5 +712,40 @@ def build_classical_level(Asp, cfg, scope, level_id: int = 0):
     R = P.T.tocsr()
     Ac = (R @ Asp @ P).tocsr()
     Ac.sum_duplicates()
+    if int(cfg.get("structure_reuse_levels", scope)) != 0:
+        # structure reuse needs the FULL structural Galerkin pattern
+        # stored: scipy's value matmul prunes numerically-cancelled
+        # entries, and a pruned Ac cannot hold the slot when future
+        # coefficient sets make it nonzero (plan_rap would correctly
+        # refuse and resetup would silently fall back to full
+        # re-coarsening).  Union with the binary-product pattern
+        # (explicit zeros) — only paid when reuse is requested.
+        ones = np.ones
+        Rb = sps.csr_matrix(
+            (ones(R.nnz), R.indices, R.indptr), shape=R.shape)
+        Ab = sps.csr_matrix(
+            (ones(Asp.nnz), Asp.indices, Asp.indptr), shape=Asp.shape)
+        Pb = sps.csr_matrix(
+            (ones(P.nnz), P.indices, P.indptr), shape=P.shape)
+        pat = (Rb @ Ab @ Pb).tocsr()
+        pat.sort_indices()
+        # fill the structural pattern with the computed values
+        # explicitly (scipy's + would re-prune the zero slots): locate
+        # each value entry's slot in the superset pattern
+        Ac.sort_indices()
+        nc2 = np.int64(pat.shape[1]) + 1
+        pkey = (np.repeat(np.arange(pat.shape[0], dtype=np.int64),
+                          np.diff(pat.indptr)) * nc2
+                + pat.indices)
+        vkey = (np.repeat(np.arange(Ac.shape[0], dtype=np.int64),
+                          np.diff(Ac.indptr)) * nc2
+                + Ac.indices)
+        pos = np.searchsorted(pkey, vkey)
+        data = np.zeros(pat.nnz, dtype=Ac.data.dtype)
+        data[pos] = Ac.data
+        Ac = sps.csr_matrix(
+            (data, pat.indices.copy(), pat.indptr.copy()),
+            shape=pat.shape,
+        )
     Ac.sort_indices()
     return P, R, Ac

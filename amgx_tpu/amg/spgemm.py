@@ -28,6 +28,8 @@ from __future__ import annotations
 import dataclasses
 
 import numpy as np
+import scipy.sparse as sps
+
 import jax
 import jax.numpy as jnp
 
@@ -143,8 +145,24 @@ def plan_rap(Rsp, Asp, Psp, Acsp) -> RAPPlan:
 
     ``Acsp`` must be (or cover) the structure of ``R @ A @ P`` —
     exactly what setup computed it as.
+
+    The intermediate AP pattern is computed STRUCTURALLY (binary
+    product): scipy's value matmul prunes numerically-cancelled
+    entries, which would make the first-stage plan reject its own
+    product pattern whenever cancellation occurs (observed on
+    classical D1 hierarchies) — and a pruned AP would silently drop
+    contributions for future value sets, which is the whole point of
+    the plan.
     """
-    APsp = (Asp.tocsr() @ Psp.tocsr()).tocsr()
+    A = Asp.tocsr()
+    P = Psp.tocsr()
+    Ab = sps.csr_matrix(
+        (np.ones(A.nnz), A.indices, A.indptr), shape=A.shape
+    )
+    Pb = sps.csr_matrix(
+        (np.ones(P.nnz), P.indices, P.indptr), shape=P.shape
+    )
+    APsp = (Ab @ Pb).tocsr()
     APsp.sort_indices()
     return RAPPlan(
         ap=plan_spmm(Asp, Psp, APsp),

@@ -278,3 +278,94 @@ def test_spgemm_device_random(rng):
     assert abs(got - want).max() < 1e-13
     # pattern identical (scipy keeps structural zeros; so does ESC)
     assert got.nnz == want.nnz
+
+
+def test_device_setup_nonsymmetric_solve(rng):
+    """Device pipeline end-to-end on a NONSYMMETRIC operator
+    (convection-diffusion-like): BiCGStab + classical AMG converges
+    with host-parity iterations."""
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.io.poisson import poisson_rhs
+    from amgx_tpu.solvers import create_solver
+
+    A1 = poisson_3d_7pt(10, dtype=np.float64).to_scipy().tocsr()
+    n = A1.shape[0]
+    conv = sps.diags_array(
+        np.full(n - 1, 0.3), offsets=1, shape=A1.shape
+    ) - sps.diags_array(
+        np.full(n - 1, 0.3), offsets=-1, shape=A1.shape
+    )
+    Ansym = (A1 + conv).tocsr()
+    b = poisson_rhs(n, dtype=np.float64)
+    cfg_s = (
+        '{"config_version": 2, "solver": {"scope": "main", '
+        '"solver": "PBICGSTAB", "max_iters": 120, '
+        '"tolerance": 1e-8, "convergence": "RELATIVE_INI", '
+        '"monitor_residual": 1, "preconditioner": {"scope": "amg", '
+        '"solver": "AMG", "algorithm": "CLASSICAL", '
+        '"selector": "PMIS", "interpolator": "D1", '
+        '"smoother": {"scope": "j", "solver": "BLOCK_JACOBI", '
+        '"relaxation_factor": 0.8, "monitor_residual": 0}, '
+        '"max_iters": 1, "min_coarse_rows": 32, '
+        '"coarse_solver": "DENSE_LU_SOLVER", '
+        '"monitor_residual": 0}}}'
+    )
+    iters = {}
+    for loc in ("HOST", "DEVICE"):
+        cfg = AMGConfig.from_string(cfg_s)
+        cfg.set("setup_location", loc, "amg")
+        s = create_solver(cfg, "default")
+        s.setup(SparseMatrix.from_scipy(Ansym))
+        if loc == "DEVICE":
+            # parity must not pass vacuously via a silent host fallback
+            assert s.precond.setup_profile, "device pipeline not engaged"
+        res = s.solve(b)
+        assert bool(res.converged), loc
+        x = np.asarray(res.x)
+        rel = np.linalg.norm(Ansym @ x - np.asarray(b)) / \
+            np.linalg.norm(np.asarray(b))
+        assert rel < 1e-6, (loc, rel)
+        iters[loc] = int(res.iters)
+    assert abs(iters["DEVICE"] - iters["HOST"]) <= 1, iters
+
+
+def test_device_setup_then_resetup(rng):
+    """Device-built hierarchies interoperate with the values-only
+    resetup path (structure_reuse_levels): after replace-coefficients
+    the re-evaluated Galerkin chain solves the perturbed system."""
+    from amgx_tpu.io.poisson import poisson_rhs
+    from amgx_tpu.solvers import create_solver
+
+    A = poisson_3d_7pt(10, dtype=np.float64)
+    b = poisson_rhs(A.n_rows, dtype=np.float64)
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main", '
+        '"solver": "PCG", "max_iters": 100, "tolerance": 1e-8, '
+        '"convergence": "RELATIVE_INI", "monitor_residual": 1, '
+        '"preconditioner": {"scope": "amg", "solver": "AMG", '
+        '"algorithm": "CLASSICAL", "selector": "PMIS", '
+        '"interpolator": "D1", "smoother": {"scope": "j", '
+        '"solver": "BLOCK_JACOBI", "relaxation_factor": 0.8, '
+        '"monitor_residual": 0}, "max_iters": 1, '
+        '"min_coarse_rows": 32, "structure_reuse_levels": -1, '
+        '"coarse_solver": "DENSE_LU_SOLVER", "monitor_residual": 0, '
+        '"setup_location": "DEVICE"}}}'
+    )
+    s = create_solver(cfg, "default")
+    s.setup(A)
+    assert s.precond.setup_profile  # device pipeline engaged
+    # the values-only reuse path must actually be planned, or resetup
+    # silently re-coarsens from scratch and this test proves nothing
+    assert s.precond.levels[0].rap_plan is not None
+    res1 = s.solve(b)
+    assert bool(res1.converged)
+    # perturb values (same pattern), resetup, solve again
+    A2 = A.replace_values(np.asarray(A.values) * 1.1)
+    s.resetup(A2)
+    res2 = s.solve(b)
+    assert bool(res2.converged)
+    x2 = np.asarray(res2.x)
+    sp2 = A2.to_scipy()
+    rel = np.linalg.norm(sp2 @ x2 - np.asarray(b)) / \
+        np.linalg.norm(np.asarray(b))
+    assert rel < 1e-6, rel
