@@ -412,15 +412,42 @@ def _indptr_from_sorted_rows(rows, n):
                             side="left")
 
 
+class DeviceSetupOverflow(RuntimeError):
+    """An ESC SpGEMM expansion exceeds int32 addressing; the caller
+    must fall back to the host (scipy) builder for this level."""
+
+
+# ESC expansion entries are addressed with (at most) int32 arithmetic
+# on device: when jax_enable_x64 is off, jnp.int64 silently degrades to
+# int32, so any larger expansion would wrap and corrupt the Galerkin
+# product.  Detected on HOST (numpy int64, immune to the degradation).
+_SPGEMM_MAX_EXPANSION = 2**31 - 1
+
+
 def spgemm_device(a_rows, a_cols, a_vals, n_left,
                   b_rows, b_cols, b_vals, n_mid):
     """C = A @ B on device (ESC).  A, B are row-sorted padded COO; the
-    single host round-trips are the expansion bound and the output nnz
+    host round-trips are the expansion bound and the output nnz
     (reference two-phase csr_multiply.cu:207 counter readbacks).
-    Returns (rows, cols, vals, nnz) with padded static shapes."""
+    Returns (rows, cols, vals, nnz) with padded static shapes.
+
+    Raises :class:`DeviceSetupOverflow` when the expansion would
+    exceed int32 range (ADVICE r5 medium): the device cumsum computes
+    in int32 whenever ``jax_enable_x64`` is off, so the bound is
+    re-derived in host numpy int64 — per-entry counts each fit int32,
+    only their SUM can wrap — and oversized products are rejected
+    before any wrapped index can silently corrupt the product.
+    """
     b_indptr = _indptr_from_sorted_rows(b_rows, n_mid)
     cum, cnt = _spgemm_bound_dev(a_rows, a_cols, b_indptr, n_left)
-    total = int(cum[-1])  # scalar sync #1
+    # host int64 bound (sync #1 — an array pull, the overflow guard's
+    # price; the device `cum` stays int32-safe once total is in range)
+    total = int(np.asarray(cnt, dtype=np.int64).sum())
+    if total > _SPGEMM_MAX_EXPANSION:
+        raise DeviceSetupOverflow(
+            f"ESC SpGEMM expansion {total} exceeds int32 range; "
+            "use the host builder for this level"
+        )
     E = _bucket(total)
     rows, cols, vals, first, nnz_dev = _spgemm_expand_sort_dev(
         a_rows, a_cols, a_vals, cum, cnt, b_indptr, b_cols, b_vals,

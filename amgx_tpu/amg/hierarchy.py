@@ -158,17 +158,32 @@ class AMGSolver(Solver):
 
             if device_setup_eligible(self.cfg, self.scope, level_id,
                                      dtype=Asp.dtype):
-                out = build_classical_level_device(
-                    Asp, self.cfg, self.scope, level_id
+                from amgx_tpu.amg.device_setup import (
+                    DeviceSetupOverflow,
                 )
-                from amgx_tpu.amg import device_setup
 
-                for k, v in device_setup.last_profile.items():
-                    self.setup_profile[k] = (
-                        self.setup_profile.get(k, 0) + v
+                try:
+                    out = build_classical_level_device(
+                        Asp, self.cfg, self.scope, level_id
                     )
-                return out
-            if explicit_device:
+                except DeviceSetupOverflow as e:
+                    # Galerkin expansion past int32 addressing: the
+                    # host (scipy int64) builder handles this level
+                    import warnings
+
+                    warnings.warn(
+                        f"device setup level {level_id}: {e}; "
+                        "falling back to the host builder"
+                    )
+                else:
+                    from amgx_tpu.amg import device_setup
+
+                    for k, v in device_setup.last_profile.items():
+                        self.setup_profile[k] = (
+                            self.setup_profile.get(k, 0) + v
+                        )
+                    return out
+            elif explicit_device:
                 import warnings
 
                 warnings.warn(
@@ -317,6 +332,78 @@ class AMGSolver(Solver):
             self._coarsen_from(self.levels[i].A.to_scipy())
         self._finalize_setup()
         return True
+
+    def make_batch_params(self):
+        """Traced values-only hierarchy rebuild (the batched analogue
+        of ``_resetup_impl``): the finest coefficients flow down the
+        Galerkin chain through the stored RAP plans, each level's
+        smoother params rebuild from its level values, and the coarse
+        solver re-factorizes — all inside one jit/vmap program, so one
+        vmapped call re-evaluates a whole group's hierarchies
+        (:mod:`amgx_tpu.serve`).  Transfer operators P/R keep their
+        setup-time weights, exactly like ``structure_reuse_levels``.
+
+        Requires planned Galerkin products on every transition and
+        batch-capable smoothers/coarse solver; returns None otherwise.
+        """
+        if not self.levels or self.levels[0].A.block_size != 1:
+            return None
+        lvls = self.levels
+        if any(lvl.rap_plan is None for lvl in lvls[:-1]):
+            return None
+        sm = []
+        for lvl in lvls:
+            if lvl.smoother is None:
+                sm.append(None)
+                continue
+            s = lvl.smoother.make_batch_params()
+            if s is None:
+                return None
+            sm.append(s)
+        cs = None
+        if self.coarse_solver is not None:
+            cs = self.coarse_solver.make_batch_params()
+            if cs is None:
+                return None
+        n_lv = len(lvls)
+        sm_fns = [None if s is None else s[1] for s in sm]
+        cs_fn = None if cs is None else cs[1]
+        template = dict(
+            As=tuple(lvl.A for lvl in lvls),
+            Ps=tuple(lvl.P for lvl in lvls[:-1]),
+            Rs=tuple(lvl.R for lvl in lvls[:-1]),
+            plans=tuple(lvl.rap_plan for lvl in lvls[:-1]),
+            smoothers=tuple(None if s is None else s[0] for s in sm),
+            coarse=None if cs is None else cs[0],
+        )
+
+        def fn(t, v):
+            lvl_vals = [v]
+            for i in range(n_lv - 1):
+                lvl_vals.append(
+                    t["plans"][i].apply(
+                        t["Rs"][i].values, lvl_vals[i], t["Ps"][i].values
+                    )
+                )
+            per_level = []
+            for i in range(n_lv):
+                Ai = t["As"][i].replace_values(lvl_vals[i])
+                P = t["Ps"][i] if i < n_lv - 1 else None
+                R = t["Rs"][i] if i < n_lv - 1 else None
+                smp = (
+                    sm_fns[i](t["smoothers"][i], lvl_vals[i])
+                    if sm_fns[i] is not None
+                    else None
+                )
+                per_level.append((Ai, P, R, smp))
+            coarse = (
+                cs_fn(t["coarse"], lvl_vals[-1])
+                if cs_fn is not None
+                else None
+            )
+            return tuple(per_level), coarse
+
+        return template, fn
 
     def _collect_params(self):
         per_level = []

@@ -175,6 +175,9 @@ class _SolverHandle:
         self.cfg = cfg
         self.solver = None
         self.result = None
+        # batched solve state (solver_solve_batch)
+        self.batch_service = None
+        self.batch_results = None
 
 
 # ---------------------------------------------------------------------------
@@ -1015,6 +1018,91 @@ def solver_get_iteration_residual(slv_h: int, it: int, idx: int = 0):
     if not (0 <= it < hist.shape[0]):
         raise AMGXError(RC_BAD_PARAMETERS, f"iteration {it} out of range")
     return float(hist[it, idx])
+
+
+@_traced
+def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
+    """Batched solve of N independent systems through the serve layer
+    (no reference analogue — the TPU-side answer to running N AmgX
+    solvers on N CUDA streams).
+
+    ``mtx_handles``/``rhs_handles``/``sol_handles`` are equal-length
+    sequences of uploaded matrix / rhs / solution handles.  Systems
+    sharing a sparsity pattern execute as vmapped groups with one
+    hierarchy setup per pattern (amgx_tpu.serve); solutions land in the
+    solution vectors, per-system status via solver_get_batch_status.
+    The first call builds the service from the solver's config; later
+    calls reuse its hierarchy/compile caches.
+    """
+    s = _get(slv_h, _SolverHandle)
+    mtx_handles = list(mtx_handles)
+    rhs_handles = list(rhs_handles)
+    sol_handles = list(sol_handles)
+    if not (len(mtx_handles) == len(rhs_handles) == len(sol_handles)):
+        raise AMGXError(
+            RC_BAD_PARAMETERS,
+            "solver_solve_batch: handle lists must have equal length",
+        )
+    if not mtx_handles:
+        s.batch_results = []
+        return RC_OK
+    if s.batch_service is None:
+        from amgx_tpu.serve import BatchedSolveService
+
+        s.batch_service = BatchedSolveService(config=s.cfg.cfg)
+    systems = []
+    for mh, rh, sh in zip(mtx_handles, rhs_handles, sol_handles):
+        m = _get(mh, _Matrix)
+        r = _get(rh, _Vector)
+        if m.A is None:
+            raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+        if r.data is None:
+            raise AMGXError(RC_BAD_PARAMETERS, "rhs not uploaded")
+        A = m.A
+        if np.dtype(A.values.dtype) != np.dtype(s.mode.mat_dtype):
+            A = A.astype(s.mode.mat_dtype)
+        # like solver_solve, an uploaded solution vector warm-starts
+        sol = _get(sh, _Vector)
+        x0 = (
+            None
+            if sol.data is None
+            else sol.data.astype(s.mode.vec_dtype)
+        )
+        systems.append((A, r.data.astype(s.mode.vec_dtype), x0))
+    results = s.batch_service.solve_many(systems)
+    for res, sh in zip(results, sol_handles):
+        v = _get(sh, _Vector)
+        v.data = np.asarray(res.x, dtype=v.mode.vec_dtype)
+    s.batch_results = results
+    s.result = results[-1]
+    return RC_OK
+
+
+def solver_get_batch_status(slv_h: int, idx: int) -> int:
+    s = _get(slv_h, _SolverHandle)
+    if s.batch_results is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no batch solve yet")
+    if not (0 <= idx < len(s.batch_results)):
+        raise AMGXError(RC_BAD_PARAMETERS, f"batch index {idx} invalid")
+    return int(s.batch_results[idx].status)
+
+
+def solver_get_batch_iterations_number(slv_h: int, idx: int) -> int:
+    s = _get(slv_h, _SolverHandle)
+    if s.batch_results is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no batch solve yet")
+    if not (0 <= idx < len(s.batch_results)):
+        raise AMGXError(RC_BAD_PARAMETERS, f"batch index {idx} invalid")
+    return int(s.batch_results[idx].iters)
+
+
+def solver_get_batch_metrics(slv_h: int) -> dict:
+    """Snapshot of the solver handle's serve-layer counters (queue
+    depth, cache/bucket hits, compiles, per-bucket latency)."""
+    s = _get(slv_h, _SolverHandle)
+    if s.batch_service is None:
+        return {}
+    return s.batch_service.metrics.snapshot()
 
 
 @_traced

@@ -25,6 +25,7 @@ Galerkin products) manipulates scipy.sparse and converts back.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Any, Optional
 
 import numpy as np
@@ -113,6 +114,16 @@ class SparseMatrix:
     dia_vals: Optional[jnp.ndarray] = None
     # dense copy for small unstructured matrices (SpMV = MXU matmul)
     dense: Optional[jnp.ndarray] = None
+    # First-occurrence gather maps (slot -> nnz index, -1 = empty):
+    # replace_values rebuilds diag/dia_vals/ell_vals with GATHERS
+    # instead of scatters — scatter is the slow op on both CPU XLA and
+    # TPU, and the serve layer re-runs these rebuilds per batched
+    # call.  Assumes canonical CSR (duplicate (row, col) entries, when
+    # present at all, are zero-valued beyond the first — true for
+    # from_coo-deduplicated uploads and serve bucket padding).
+    diag_src: Optional[jnp.ndarray] = None
+    dia_src: Optional[jnp.ndarray] = None
+    ell_src: Optional[jnp.ndarray] = None
 
     n_rows: int = _static_field(default=0)
     n_cols: int = _static_field(default=0)
@@ -167,19 +178,51 @@ class SparseMatrix:
         assert off == 0
         return size
 
+    def fingerprint(self) -> str:
+        """Sparsity fingerprint: a stable hash of the STRUCTURE only
+        (row_offsets, col_indices, shape, block size) — values excluded.
+        Two matrices with equal fingerprints accept each other's
+        coefficient arrays (``replace_values``), which is what the
+        batched solve service (:mod:`amgx_tpu.serve`) groups on.  The
+        hash is computed once per object and memoized (the index
+        arrays are immutable device buffers)."""
+        fp = getattr(self, "_fingerprint_cache", None)
+        if fp is None:
+            fp = sparsity_fingerprint(
+                np.asarray(self.row_offsets),
+                np.asarray(self.col_indices),
+                self.n_rows,
+                self.n_cols,
+                self.block_size,
+            )
+            # frozen dataclass: memoize around the freeze (the cache is
+            # not a field, so pytree transforms simply drop it)
+            object.__setattr__(self, "_fingerprint_cache", fp)
+        return fp
+
     # ---- value updates (structure reuse) -------------------------------
 
     def replace_values(self, values, diag=None) -> "SparseMatrix":
         """Refresh coefficients keeping structure — the
-        AMGX_matrix_replace_coefficients fast path (amgx_c.h:281-286)."""
+        AMGX_matrix_replace_coefficients fast path (amgx_c.h:281-286).
+
+        Traced and vmap-safe; acceleration-structure values rebuild by
+        gather when the ``*_src`` maps exist (see their field comment),
+        falling back to scatter forms otherwise."""
         values = jnp.asarray(values, dtype=self.values.dtype).reshape(
             self.values.shape
         )
         if diag is None:
-            diag = _extract_diag_jnp(self, values)
+            if self.diag_src is not None:
+                diag = _gather_src(self.diag_src, values)
+            else:
+                diag = _extract_diag_jnp(self, values)
         new = dataclasses.replace(self, values=values, diag=diag)
         if self.has_ell:
-            ell_vals = _scatter_ell_vals(self, values)
+            if self.ell_src is not None:
+                ell_vals = _gather_src(self.ell_src, values)
+            else:
+                ell_vals = _scatter_ell_vals(self, values)
             new = dataclasses.replace(new, ell_vals=ell_vals)
             if self.ell_wvals is not None:
                 # the windowed layout stores values in plain tiled
@@ -190,9 +233,11 @@ class SparseMatrix:
                     new, ell_wvals=tile_ell_jnp(ell_vals)
                 )
         if self.has_dia:
-            new = dataclasses.replace(
-                new, dia_vals=_scatter_dia_vals(self, values)
-            )
+            if self.dia_src is not None:
+                dia_vals = _gather_src(self.dia_src, values)
+            else:
+                dia_vals = _scatter_dia_vals(self, values)
+            new = dataclasses.replace(new, dia_vals=dia_vals)
         if self.has_dense:
             d = jnp.zeros_like(self.dense)
             d = d.at[self.row_ids, self.col_indices].add(values)
@@ -226,9 +271,18 @@ class SparseMatrix:
         views=None,
         partition=None,
         dtype=None,
+        accel_formats=("dia", "dense", "ell"),
     ) -> "SparseMatrix":
         """Build from host CSR arrays (also the upload path — reference
-        AMGX_matrix_upload_all, amgx_c.h:262-279)."""
+        AMGX_matrix_upload_all, amgx_c.h:262-279).
+
+        ``accel_formats`` restricts which acceleration structures may
+        build (each still subject to its own gate); ``build_ell=False``
+        disables all of them.  The serve bucketing layer passes
+        ``("dense",)``: the dense structure is the only one whose
+        static metadata is pattern-independent, so bucketed matrices
+        sharing it also share XLA programs.
+        """
         row_offsets = np.asarray(row_offsets, dtype=np.int32)
         col_indices = np.asarray(col_indices, dtype=np.int32)
         values = np.asarray(values)
@@ -248,10 +302,30 @@ class SparseMatrix:
         row_lens = np.diff(row_offsets)
         row_ids = np.repeat(np.arange(n_rows, dtype=np.int32), row_lens)
         diag = _extract_diag_np(row_offsets, col_indices, values, n_rows, b)
+        diag_src = None
+        if nnz:
+            # unbuffered minimum: FIRST occurrence wins (plain fancy
+            # assignment iterates in memory order, not array order)
+            sentinel = np.iinfo(np.int32).max
+            diag_src = np.full(n_rows, sentinel, dtype=np.int32)
+            hit_idx = np.nonzero(col_indices == row_ids)[0]
+            np.minimum.at(
+                diag_src, row_ids[hit_idx], hit_idx.astype(np.int32)
+            )
+            diag_src[diag_src == sentinel] = -1
 
-        dia_offsets = dia_vals = None
-        if b == 1 and n_rows == n_cols and nnz:
-            dia_offsets, dia_vals = _try_build_dia_np(
+        dia_offsets = dia_vals = dia_src = None
+        # build_ell=False opts out of ALL acceleration structures (DIA
+        # included): bucketed/CSR-only matrices need
+        # pattern-independent static metadata
+        if (
+            build_ell
+            and "dia" in accel_formats
+            and b == 1
+            and n_rows == n_cols
+            and nnz
+        ):
+            dia_offsets, dia_vals, dia_src = _try_build_dia_np(
                 row_offsets, col_indices, values, row_ids, n_rows
             )
 
@@ -259,6 +333,7 @@ class SparseMatrix:
         dense_bytes = n_rows * n_cols * values.dtype.itemsize
         if (
             build_ell  # opt-out flag covers all acceleration structures
+            and "dense" in accel_formats
             and b == 1
             and dia_offsets is None
             and 0 < n_rows <= _DENSE_MAX_ROWS
@@ -268,11 +343,12 @@ class SparseMatrix:
             dense = np.zeros((n_rows, n_cols), dtype=values.dtype)
             np.add.at(dense, (row_ids, col_indices), values)
 
-        ell_cols = ell_vals = None
+        ell_cols = ell_vals = ell_src = None
         ell_wcols = ell_wvals = ell_wbase = None
         ell_wwidth = None
         if (
             build_ell
+            and "ell" in accel_formats
             and n_rows > 0
             and dia_offsets is None
             and dense is None
@@ -281,7 +357,7 @@ class SparseMatrix:
             if w <= _ELL_MAX_WIDTH and w * n_rows <= _ELL_MAX_OVERHEAD * max(
                 nnz, 1
             ):
-                ell_cols, ell_vals = _build_ell_np(
+                ell_cols, ell_vals, ell_src = _build_ell_np(
                     row_offsets, col_indices, values, n_rows, w, b
                 )
                 if b == 1 and w > 0 and _want_tiled_ell(values.dtype):
@@ -312,6 +388,9 @@ class SparseMatrix:
             ell_wwidth=ell_wwidth,
             dia_vals=None if dia_vals is None else dev(dia_vals),
             dense=None if dense is None else dev(dense),
+            diag_src=None if diag_src is None else dev(diag_src),
+            dia_src=None if dia_src is None else dev(dia_src),
+            ell_src=None if ell_src is None else dev(ell_src),
             n_rows=int(n_rows),
             n_cols=int(n_cols),
             block_size=int(b),
@@ -400,6 +479,27 @@ class SparseMatrix:
 # host helpers
 
 
+def sparsity_fingerprint(
+    row_offsets, col_indices, n_rows, n_cols, block_size=1
+) -> str:
+    """Hash of a CSR sparsity pattern (host arrays).
+
+    Stable across processes (content hash, not Python ``hash``): the
+    serve hierarchy cache keys persist-ably on it.  Index dtypes are
+    normalized to int32 first so an int64 upload and an int32 upload of
+    the same pattern collide, as they must.
+    """
+    h = hashlib.blake2b(digest_size=16)
+    h.update(
+        np.asarray(
+            [n_rows, n_cols, block_size, len(col_indices)], dtype=np.int64
+        ).tobytes()
+    )
+    h.update(np.ascontiguousarray(row_offsets, dtype=np.int32).tobytes())
+    h.update(np.ascontiguousarray(col_indices, dtype=np.int32).tobytes())
+    return h.hexdigest()
+
+
 def _row_ids_np(row_offsets, n_rows):
     return np.repeat(
         np.arange(n_rows, dtype=np.int32), np.diff(row_offsets)
@@ -411,7 +511,8 @@ def _extract_diag_np(row_offsets, col_indices, values, n_rows, b):
     diag = np.zeros(shape, dtype=values.dtype)
     row_ids = _row_ids_np(row_offsets, n_rows)
     hit = col_indices == row_ids
-    diag[row_ids[hit]] = values[hit]
+    # sum duplicates, matching the DIA/ELL/segment-sum SpMV paths
+    np.add.at(diag, row_ids[hit], values[hit])
     return diag
 
 
@@ -419,13 +520,17 @@ def _build_ell_np(row_offsets, col_indices, values, n_rows, w, b):
     ell_cols = np.zeros((n_rows, w), dtype=np.int32)
     vshape = (n_rows, w) if b == 1 else (n_rows, w, b, b)
     ell_vals = np.zeros(vshape, dtype=values.dtype)
+    ell_src = np.full((n_rows, w), -1, dtype=np.int32)
     row_ids = _row_ids_np(row_offsets, n_rows)
     pos = np.arange(col_indices.shape[0], dtype=np.int64) - row_offsets[
         row_ids
     ].astype(np.int64)
     ell_cols[row_ids, pos] = col_indices
     ell_vals[row_ids, pos] = values
-    return ell_cols, ell_vals
+    ell_src[row_ids, pos] = np.arange(
+        col_indices.shape[0], dtype=np.int32
+    )
+    return ell_cols, ell_vals, ell_src
 
 
 def dia_gate(num_diags: int, n: int, nnz: int) -> bool:
@@ -443,13 +548,29 @@ def _try_build_dia_np(row_offsets, col_indices, values, row_ids, n):
     offs = col_indices.astype(np.int64) - row_ids.astype(np.int64)
     uniq = np.unique(offs)
     if not dia_gate(uniq.shape[0], n, col_indices.shape[0]):
-        return None, None
+        return None, None, None
     dia_vals = np.zeros((uniq.shape[0], n), dtype=values.dtype)
     k = np.searchsorted(uniq, offs)
     # add (not assign): duplicate (row,col) entries must sum, matching the
     # ELL/segment-sum SpMV paths
     np.add.at(dia_vals, (k, row_ids), values)
-    return tuple(int(o) for o in uniq), dia_vals
+    # unbuffered minimum: FIRST occurrence wins (replace_values
+    # gather-rebuild; duplicates beyond the first must be zero-valued)
+    sentinel = np.iinfo(np.int32).max
+    dia_src = np.full((uniq.shape[0], n), sentinel, dtype=np.int32)
+    idx = np.arange(col_indices.shape[0], dtype=np.int32)
+    np.minimum.at(dia_src, (k, row_ids), idx)
+    dia_src[dia_src == sentinel] = -1
+    return tuple(int(o) for o in uniq), dia_vals, dia_src
+
+
+def _gather_src(src, values):
+    """Gather values into an acceleration-structure layout via a
+    first-occurrence source map (-1 = empty slot).  The traced twin of
+    the host builders; O(slots) gathers, no scatter."""
+    v = values[jnp.clip(src, 0)]
+    mask = (src >= 0).reshape(src.shape + (1,) * (values.ndim - 1))
+    return jnp.where(mask, v, 0)
 
 
 def _extract_diag_jnp(A: SparseMatrix, values):

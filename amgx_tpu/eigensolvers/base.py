@@ -18,6 +18,10 @@ class EigenResult:
     iterations: int
     converged: bool
     residual: float
+    # per-vector convergence of the eigenvector post-pass (inverse
+    # iteration): (k,) bool, or None when the algorithm produced the
+    # vectors itself
+    vector_converged: Optional[np.ndarray] = None
 
 
 _EIGENSOLVERS: Dict[str, type] = {}
@@ -93,12 +97,20 @@ class EigenSolver:
     def _solve_impl(self, x0=None) -> EigenResult:
         raise NotImplementedError
 
+    # inverse-iteration post-pass bounds: iterate to the residual
+    # tolerance below, at most this many steps per vector
+    _VECTOR_MAX_STEPS = 32
+
     def _maybe_extract_vectors(self, res: EigenResult) -> EigenResult:
         """Post-pass eigenvector extraction (reference
         eigensolver.cu:271-276 + eigenvector_solver.cu): when
         ``eig_eigenvector_solver`` names a solver and the algorithm did
-        not already produce vectors, run one shift-inverted inverse
-        iteration per converged eigenvalue."""
+        not already produce vectors, run shift-inverted inverse
+        iteration per converged eigenvalue — to the residual tolerance
+        ``||A v - lam v|| <= eig_tolerance * ||A|| * ||v||`` with an
+        iteration cap, a COMPLEX shift when the operator is complex
+        (a real-part shift stalls on complex pairs), and per-vector
+        convergence flags in ``vector_converged``."""
         name = str(self.cfg.get("eig_eigenvector_solver", self.scope))
         if (not self.want_vectors or res.eigenvectors is not None
                 or not name or not res.eigenvalues.size):
@@ -113,21 +125,47 @@ class EigenSolver:
 
         sp = self.A.to_scipy().tocsr()
         n = sp.shape[0]
-        vecs = np.zeros((n, len(res.eigenvalues)), dtype=sp.dtype)
+        is_complex = np.issubdtype(sp.dtype, np.complexfloating)
+        # residual scale: lam and v are normalized against the operator
+        # magnitude so the tolerance is meaningful for scaled matrices
+        a_scale = max(float(abs(sp).sum(axis=1).max()), 1e-300)
+        tol = max(self.tolerance, 1e-14)
+        lams = np.atleast_1d(res.eigenvalues)
+        vecs = np.zeros((n, len(lams)), dtype=sp.dtype)
+        vec_ok = np.zeros(len(lams), dtype=bool)
         rng = np.random.default_rng(7)
-        for k, lam in enumerate(np.atleast_1d(res.eigenvalues)):
-            shift = float(np.real(lam)) * (1.0 + 1e-6) + 1e-12
+        for k, lam in enumerate(lams):
+            lam_c = complex(lam) if is_complex else float(np.real(lam))
+            # relative shift offset, with an absolute floor scaled by
+            # ||A|| so lam == 0 does not produce a near-exact-singular
+            # shifted matrix (ADVICE r5: shift=1e-12 at lam=0)
+            off = 1e-6 * max(abs(lam_c), 1e-4 * a_scale)
+            shift = lam_c + off
             shifted = (sp - shift * sps.eye_array(n)).tocsr()
             inner = make_nested(
                 SolverRegistry.get(name)(self.cfg, self.scope))
             inner.setup(SparseMatrix.from_scipy(shifted))
-            v = rng.standard_normal(n).astype(
-                np.real(np.zeros(1, sp.dtype)).dtype)
-            for _ in range(3):
+            v = rng.standard_normal(n)
+            if is_complex:
+                v = v + 1j * rng.standard_normal(n)
+            v = v.astype(sp.dtype)
+            v = v / max(np.linalg.norm(v), 1e-300)
+            for _ in range(self._VECTOR_MAX_STEPS):
                 v = np.asarray(inner.solve(v).x)
                 v = v / max(np.linalg.norm(v), 1e-300)
+                # residual against the vector's own Rayleigh quotient:
+                # the algorithm's eigenvalue is only tol-accurate, so
+                # ||A v - lam v|| would floor at the eigenvalue error
+                Av = sp @ v
+                rho = np.vdot(v, Av)
+                resid = float(np.linalg.norm(Av - rho * v))
+                if resid <= tol * a_scale:
+                    vec_ok[k] = True
+                    break
             vecs[:, k] = v
-        return dataclasses.replace(res, eigenvectors=vecs)
+        return dataclasses.replace(
+            res, eigenvectors=vecs, vector_converged=vec_ok
+        )
 
 
 def create_eigensolver(cfg, scope: str = "default") -> EigenSolver:

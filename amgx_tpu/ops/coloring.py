@@ -145,16 +145,20 @@ def min_max_coloring(indptr, indices, n, max_rounds=64, seed=0,
         colors[is_min] = color + 1
         if relaxed:
             # the relaxed test can create same-round conflicts: the
-            # lower-hash side reverts.  (The reference's two schedules
-            # — in-kernel prevention vs late_rejection — collapse to
+            # lower-hash ENDPOINT of each conflicting edge reverts,
+            # whichever direction the edge is stored in — nonsymmetric
+            # patterns may store only the (hi-hash -> lo-hash)
+            # direction, where reverting only ``rows`` would leave an
+            # invalid pair colored.  (The reference's two schedules —
+            # in-kernel prevention vs late_rejection — collapse to
             # this same fixpoint in vectorized form; late_rejection
             # additionally allows reverting against already-colored
             # neighbours, min_max_2ring.cu:404.)
             hi = color if not late_rejection else 0
             same = (colors[rows] >= hi) & (
                 colors[rows] == colors[cols])
-            lose = same & (w[rows] < w[cols])
-            colors[rows[lose]] = -1
+            lo_end = np.where(w[rows] < w[cols], rows, cols)
+            colors[lo_end[same]] = -1
         color += 2
     # anything left (pathological): greedy-fix
     left = np.nonzero(colors < 0)[0]
@@ -165,7 +169,40 @@ def min_max_coloring(indptr, indices, n, max_rounds=64, seed=0,
         while c in used:
             c += 1
         colors[i] = c
+    if relaxed:
+        # belt-and-braces for GS/DILU's independent-set contract:
+        # greedy-recolor any residual conflict (late_rejection against
+        # earlier rounds can strand adjacent same-color pairs)
+        colors = _fix_conflict_vertices(colors, rows, cols, w, n)
     return _compact_colors(colors)
+
+
+def _fix_conflict_vertices(colors, rows, cols, w, n):
+    """Greedy-recolor the lower-hash endpoint of every same-colored
+    edge until :func:`validate_coloring` would pass.  Neighbourhoods
+    are symmetrized (a directed edge constrains both endpoints)."""
+    local = cols < n  # halo columns carry no local color
+    rows, cols = rows[local], cols[local]
+    sym_r = np.concatenate([rows, cols])
+    sym_c = np.concatenate([cols, rows])
+    order = np.argsort(sym_r, kind="stable")
+    sym_r, sym_c = sym_r[order], sym_c[order]
+    sym_ptr = np.searchsorted(sym_r, np.arange(n + 1))
+    for _ in range(16):
+        bad = colors[rows] == colors[cols]
+        if not bad.any():
+            break
+        verts = np.unique(
+            np.where(w[rows[bad]] < w[cols[bad]], rows[bad], cols[bad])
+        )
+        for i in verts:
+            neigh = sym_c[sym_ptr[i] : sym_ptr[i + 1]]
+            used = set(colors[neigh].tolist())
+            c = 0
+            while c in used:
+                c += 1
+            colors[i] = c
+    return colors
 
 
 def _compact_colors(colors):

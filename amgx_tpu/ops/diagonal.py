@@ -47,6 +47,15 @@ def invert_diag(A):
     return jnp.asarray(np.linalg.inv(d))
 
 
+def invert_diag_jnp(A):
+    """Traced twin of :func:`invert_diag` (same zero-pivot policy) for
+    values-only re-setup inside jit/vmap (serve batched params)."""
+    d = A.diag
+    if A.block_size == 1:
+        return jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 1.0)
+    return jnp.linalg.inv(d)
+
+
 def apply_dinv(dinv, r, block_size):
     """z = D^{-1} r for flat vectors (block-aware)."""
     if block_size == 1:

@@ -1,0 +1,42 @@
+"""Batched solve service (serve-scale layer).
+
+Many independent sparse solves -> a few vmapped device calls:
+
+  * :func:`amgx_tpu.core.matrix.sparsity_fingerprint` groups requests
+    that share a sparsity pattern;
+  * :mod:`amgx_tpu.serve.bucketing` pads groups to a small set of
+    (n, nnz, batch) buckets so XLA compile-cache hits dominate;
+  * :mod:`amgx_tpu.serve.batched` runs the vmapped masked-convergence
+    solve (early-converged instances freeze);
+  * :mod:`amgx_tpu.serve.cache` reuses one hierarchy setup per
+    (fingerprint, config) across all later coefficient sets;
+  * :mod:`amgx_tpu.serve.metrics` exports the serving counters.
+
+Entry point::
+
+    from amgx_tpu.serve import BatchedSolveService
+    svc = BatchedSolveService()           # Jacobi-PCG default config
+    results = svc.solve_many([(A0, b0), (A1, b1), ...])
+"""
+
+from amgx_tpu.serve.bucketing import pad_pattern, bucket_batch
+from amgx_tpu.serve.batched import make_batched_solve
+from amgx_tpu.serve.cache import HierarchyCache, config_hash
+from amgx_tpu.serve.metrics import ServeMetrics
+from amgx_tpu.serve.service import (
+    DEFAULT_CONFIG,
+    BatchedSolveService,
+    SolveTicket,
+)
+
+__all__ = [
+    "BatchedSolveService",
+    "DEFAULT_CONFIG",
+    "SolveTicket",
+    "HierarchyCache",
+    "ServeMetrics",
+    "make_batched_solve",
+    "pad_pattern",
+    "bucket_batch",
+    "config_hash",
+]

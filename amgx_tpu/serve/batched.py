@@ -1,0 +1,314 @@
+"""Batched solve execution: vmap a solver's iteration over a leading
+batch axis with masked per-instance convergence.
+
+Why not ``vmap(solver.make_solve())``: vmapping a ``lax.while_loop``
+runs the body on EVERY instance until the LAST one converges, so
+early-converged instances keep iterating — their x drifts past the
+converged answer and their iteration counts are lost.  This module
+instead builds ONE while_loop at the batch level whose body applies the
+vmapped per-instance iteration and then commits updates only where the
+instance is still active (residual-masked updates): converged instances
+freeze bit-exactly at their convergence iterate, and per-instance
+status/iteration counts match the sequential solves.
+
+The compiled program takes the solver's *batch template* (pattern data:
+index arrays, transfer operators, Galerkin plans — see
+``Solver.make_batch_params``) as an ARGUMENT, so every pattern in the
+same (n, nnz, batch) shape bucket reuses one XLA executable.
+
+Shared-structure batching: naively vmapping over fully-batched params
+replicates pattern leaves (index arrays, transfer operators) B times
+AND — worse — turns every SpMV gather into a batched-*indices* gather,
+which XLA lowers to a slow general gather (measured ~10x on CPU).  The
+loop instead splits params leaves into value-dependent (batched,
+``in_axes=0``) and structural (shared, ``in_axes=None``) by a
+dependence walk over the params-rebuild jaxpr — syntactic dependence,
+so a leaf is only ever shared when it provably cannot vary with the
+coefficients.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from amgx_tpu.core.profiling import named_scope
+from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.solvers.base import (
+    FAILED,
+    NOT_CONVERGED,
+    SUCCESS,
+    DIVERGED,
+    SolveResult,
+)
+
+
+def _instance_protocol(solver):
+    """Resolve the per-instance iteration protocol of a solver into
+    (init_one, iter_one, norm_one) pure functions:
+
+      init_one(params, b, x0)        -> extra
+      iter_one(params, b, x, extra)  -> (x, extra)
+      norm_one(params, b, x, extra)  -> (ncomp,) residual norm
+
+    Returns None when the solver exposes no step/iterate protocol
+    (GMRES/IDR override make_solve wholesale).
+    """
+    norm_of = solver.make_norm()
+
+    if hasattr(solver, "_make_init"):
+        try:
+            init_fn, iter_fn = solver._make_init(), solver._make_iter()
+        except NotImplementedError:
+            init_fn = None
+        if init_fn is not None:
+            return (
+                init_fn,
+                iter_fn,
+                lambda params, b, x, extra: norm_of(extra[0]),
+            )
+
+    try:
+        rstep = solver.make_residual_step()
+    except NotImplementedError:
+        rstep = None
+    if rstep is not None:
+        op = solver.operator_of
+
+        def init_r(params, b, x0):
+            return (b - spmv(op(params), x0),)
+
+        def iter_r(params, b, x, extra):
+            x = rstep(params, b, x, extra[0])
+            return x, (b - spmv(op(params), x),)
+
+        return init_r, iter_r, lambda params, b, x, extra: norm_of(
+            extra[0]
+        )
+
+    try:
+        step = solver.make_step()
+    except NotImplementedError:
+        return None
+    op = solver.operator_of
+
+    def init_s(params, b, x0):
+        return ()
+
+    def iter_s(params, b, x, extra):
+        return step(params, b, x), ()
+
+    def norm_s(params, b, x, extra):
+        return norm_of(b - spmv(op(params), x))
+
+    return init_s, iter_s, norm_s
+
+
+def _value_dependent_flags(params_of, template, values_spec):
+    """Per-leaf booleans for ``params_of(template, values)``: True when
+    the leaf can depend on ``values`` (syntactic dependence over the
+    rebuild jaxpr).  Conservative fallback: everything depends."""
+    fn = lambda v: params_of(template, v)  # noqa: E731
+    out_shape = jax.eval_shape(fn, values_spec)
+    leaves, treedef = jax.tree_util.tree_flatten(out_shape)
+    try:
+        from jax import core
+
+        closed = jax.make_jaxpr(fn)(values_spec)
+        jaxpr = closed.jaxpr
+        dep = set(jaxpr.invars)
+
+        def is_dep(atom):
+            return not isinstance(atom, core.Literal) and atom in dep
+
+        for eqn in jaxpr.eqns:
+            hit = any(is_dep(v) for v in eqn.invars)
+            if not hit:
+                # conservative recursion stand-in: sub-jaxprs (scan,
+                # cond, pjit) are treated atomically above
+                continue
+            dep.update(eqn.outvars)
+        flags = [is_dep(v) for v in jaxpr.outvars]
+        if len(flags) != len(leaves):
+            raise ValueError("outvar/leaf count mismatch")
+        return flags, treedef
+    except Exception:  # jax internals moved: batch everything
+        return [True] * len(leaves), treedef
+
+
+def make_batched_solve(solver):
+    """Pure ``fn(template, values_B, b_B, x0_B) -> SolveResult`` with
+    batched leaves (x (B, n), iters/status (B,), norms (B, ncomp),
+    history (B, max_iters+1, ncomp)), or None when the solver supports
+    neither a traced values-only params rebuild nor an iteration
+    protocol.  Jit the result once per shape bucket.
+    """
+    bp = solver.make_batch_params()
+    if bp is None:
+        return None
+    template0, params_of = bp
+    proto = _instance_protocol(solver)
+    if proto is None:
+        return None
+    init_one, iter_one, norm_one = proto
+
+    vdt = solver.A.values.dtype
+    v_spec = jax.ShapeDtypeStruct(solver.A.values.shape, vdt)
+    dep_flags, params_treedef = _value_dependent_flags(
+        params_of, template0, v_spec
+    )
+
+    def _merge(shared, batched):
+        """Rebuild the params pytree from split leaf lists."""
+        flat = []
+        si = bi = 0
+        for d in dep_flags:
+            if d:
+                flat.append(batched[bi])
+                bi += 1
+            else:
+                flat.append(shared[si])
+                si += 1
+        return jax.tree_util.tree_unflatten(params_treedef, flat)
+
+    def _wrap(fn):
+        """Per-instance fn(params, ...) -> vmapped over split params
+        with structural leaves shared (in_axes=None)."""
+
+        def inner(shared, batched, *args):
+            return fn(_merge(shared, batched), *args)
+
+        def vmapped(shared, batched, *args):
+            return jax.vmap(
+                inner,
+                in_axes=(None, 0) + (0,) * len(args),
+            )(shared, batched, *args)
+
+        return vmapped
+
+    init_v = _wrap(init_one)
+    iter_v = _wrap(iter_one)
+    norm_v = _wrap(norm_one)
+
+    conv = solver._conv_check
+    max_iters = solver.max_iters
+    rel_div = solver.rel_div_tolerance
+    ncomp = solver.norm_components
+    monitored = solver.monitor_residual
+
+    def _split_params(template, values_B):
+        """(shared_leaves, batched_leaves): structural leaves come from
+        ONE unbatched rebuild, value-dependent leaves from the vmapped
+        rebuild (XLA dead-code-eliminates each side's unused half)."""
+        with named_scope("serve_batch_params"):
+            flat0 = jax.tree_util.tree_leaves(
+                params_of(template, values_B[0])
+            )
+            flatB = jax.tree_util.tree_leaves(
+                jax.vmap(lambda v: params_of(template, v))(values_B)
+            )
+        shared = [l for l, d in zip(flat0, dep_flags) if not d]
+        batched = [l for l, d in zip(flatB, dep_flags) if d]
+        return shared, batched
+
+    def solve_plain(template, values_B, b_B, x0_B):
+        """Unmonitored: fixed max_iters sweeps for every instance."""
+        shared, batched = _split_params(template, values_B)
+        extra_B = init_v(shared, batched, b_B, x0_B)
+
+        def fori_body(i, c):
+            x, extra = c
+            return iter_v(shared, batched, b_B, x, extra)
+
+        x, _ = jax.lax.fori_loop(
+            0, max_iters, fori_body, (x0_B, extra_B)
+        )
+        B = b_B.shape[0]
+        rdt = jnp.real(b_B).dtype
+        zero = jnp.zeros((B, ncomp), rdt)
+        return SolveResult(
+            x=x,
+            iters=jnp.full((B,), max_iters, jnp.int32),
+            status=jnp.full((B,), SUCCESS, jnp.int32),
+            final_norm=zero,
+            initial_norm=zero,
+            history=jnp.full((B, max_iters + 1, ncomp), jnp.nan, rdt),
+        )
+
+    if not monitored:
+        return solve_plain
+
+    def solve(template, values_B, b_B, x0_B):
+        shared, batched = _split_params(template, values_B)
+        B = b_B.shape[0]
+        rdt = jnp.real(b_B).dtype
+        extra_B = init_v(shared, batched, b_B, x0_B)
+        nrm0 = norm_v(shared, batched, b_B, x0_B, extra_B)
+        hist = jnp.full((B, max_iters + 1, ncomp), jnp.nan, rdt)
+        hist = hist.at[:, 0].set(nrm0)
+        done0 = jax.vmap(conv)(nrm0, nrm0, nrm0)
+        status0 = jnp.where(
+            done0, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
+        )
+        iters0 = jnp.zeros((B,), jnp.int32)
+
+        def cond(c):
+            it, status = c[0], c[7]
+            return jnp.any(status == NOT_CONVERGED) & (it < max_iters)
+
+        def body(c):
+            it, x, extra, nrm, ini, mx, hist, status, iters = c
+            active = status == NOT_CONVERGED  # (B,)
+            x_n, extra_n = iter_v(shared, batched, b_B, x, extra)
+            nrm_n = norm_v(shared, batched, b_B, x_n, extra_n)
+            it = it + 1
+
+            def commit(new, old):
+                m = active.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, new, old)
+
+            x = commit(x_n, x)
+            extra = jax.tree_util.tree_map(commit, extra_n, extra)
+            mx_n = jnp.maximum(mx, nrm_n)
+            hist = hist.at[:, it].set(
+                jnp.where(active[:, None], nrm_n, jnp.nan)
+            )
+            done_ok = jax.vmap(conv)(nrm_n, ini, mx_n)
+            bad = ~jnp.all(jnp.isfinite(nrm_n), axis=-1)
+            st_n = jnp.where(
+                done_ok, jnp.int32(SUCCESS), jnp.int32(NOT_CONVERGED)
+            )
+            if rel_div > 0:
+                div = jnp.any(nrm_n > rel_div * ini, axis=-1)
+                st_n = jnp.where(div, jnp.int32(DIVERGED), st_n)
+            st_n = jnp.where(bad, jnp.int32(FAILED), st_n)
+            nrm = commit(nrm_n, nrm)
+            mx = commit(mx_n, mx)
+            iters = jnp.where(active, it, iters)
+            status = jnp.where(active, st_n, status)
+            return (it, x, extra, nrm, ini, mx, hist, status, iters)
+
+        c0 = (
+            jnp.int32(0),
+            x0_B,
+            extra_B,
+            nrm0,
+            nrm0,
+            nrm0,
+            hist,
+            status0,
+            iters0,
+        )
+        _, x, _, nrm, ini, mx, hist, status, iters = jax.lax.while_loop(
+            cond, body, c0
+        )
+        return SolveResult(
+            x=x,
+            iters=iters,
+            status=status,
+            final_norm=nrm,
+            initial_norm=ini,
+            history=hist,
+        )
+
+    return solve

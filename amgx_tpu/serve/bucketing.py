@@ -1,0 +1,211 @@
+"""Shape bucketing for the batched solve service.
+
+XLA compiles one program per argument-shape signature, so a service
+that accepted raw (n, nnz) pairs would recompile for every mesh.  The
+dispatcher therefore pads every request up to a small set of
+(n, nnz, batch) buckets — power-of-two growth, like the device-setup
+SpGEMM buffers (``amg/device_setup._bucket``) — and the compiled-solve
+cache keys on the bucket, not the request.
+
+Padding construction keeps the padded system equivalent to the
+original:
+
+  * rows n..nb-1 get a single unit diagonal entry and rhs 0, so the
+    padded block solves to exactly 0 and cannot couple back (the
+    identity tail is its own invariant subspace);
+  * leftover nnz slots are zero-valued duplicates of each row's LAST
+    stored entry, spread evenly across all rows — duplicates sum in
+    every SpMV path, adding nothing, and spreading keeps the max row
+    length (the ELL width) near the original instead of piling the
+    filler onto one row.
+
+The padded matrix restricts its acceleration structures to
+bucket-friendly ones (``template_matrix``): DIA offsets are
+pattern-dependent STATIC metadata and would fragment the XLA compile
+cache, while ELL/dense carry the pattern in array leaves only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from amgx_tpu.core.matrix import SparseMatrix, sparsity_fingerprint
+
+# Smallest bucket edges: tiny systems all collapse into one bucket
+# instead of generating a compile per handful of rows.
+MIN_ROWS_BUCKET = 64
+MIN_NNZ_BUCKET = 256
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def bucket_size(x: int, floor: int) -> int:
+    """Next power of two >= max(x, floor)."""
+    n = max(int(x), floor)
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_batch(b: int) -> int:
+    """Smallest batch bucket >= b (power-of-two growth continues past
+    the table for services configured with a larger max_batch)."""
+    for cand in BATCH_BUCKETS:
+        if cand >= b:
+            return cand
+    return bucket_size(b, BATCH_BUCKETS[-1])
+
+
+@dataclasses.dataclass(frozen=True)
+class PaddedPattern:
+    """One request pattern padded to its (nb, nnzb) bucket.
+
+    row_offsets/col_indices are the padded host CSR index arrays;
+    ``scatter`` maps the ORIGINAL nnz positions into the padded values
+    array and ``ones_pos`` holds the identity-tail diagonal slots, so
+    per-request coefficient arrays embed with two fancy assignments.
+    """
+
+    row_offsets: np.ndarray
+    col_indices: np.ndarray
+    scatter: np.ndarray  # (nnz,) original entry -> padded position
+    ones_pos: np.ndarray  # (nb - n,) identity-tail diagonal positions
+    n: int  # original rows
+    nnz: int  # original nnz
+    nb: int  # bucketed rows
+    nnzb: int  # bucketed nnz
+    max_row_len: int  # padded max row length (ELL width gate)
+    num_diagonals: int  # distinct (col - row) offsets (DIA gate)
+    fingerprint: str  # fingerprint of the PADDED pattern
+
+    @property
+    def n_pad_diag(self) -> int:
+        return self.nb - self.n
+
+    def embed_values(self, values: np.ndarray, dtype=None) -> np.ndarray:
+        """Original (nnz,) coefficients -> padded (nnzb,) array with
+        unit identity tail and zero filler."""
+        values = np.asarray(values).reshape(-1)
+        if values.shape[0] != self.nnz:
+            raise ValueError(
+                f"expected {self.nnz} coefficients, got {values.shape[0]}"
+            )
+        dt = np.dtype(dtype) if dtype is not None else values.dtype
+        out = np.zeros(self.nnzb, dtype=dt)
+        out[self.scatter] = values
+        out[self.ones_pos] = 1.0
+        return out
+
+    def extract_values(self, padded: np.ndarray) -> np.ndarray:
+        """Inverse of :meth:`embed_values` for the original slots."""
+        return np.asarray(padded).reshape(-1)[self.scatter]
+
+    def embed_vector(self, vec, dtype) -> np.ndarray:
+        """Original (n,) vector -> zero-extended (nb,) array."""
+        out = np.zeros(self.nb, dtype=dtype)
+        if vec is not None:
+            v = np.asarray(vec).reshape(-1)
+            if v.shape[0] != self.n:
+                raise ValueError(
+                    f"expected length-{self.n} vector, got {v.shape[0]}"
+                )
+            out[: self.n] = v
+        return out
+
+    def template_matrix(
+        self, values, dtype, accel_formats=()
+    ) -> SparseMatrix:
+        """Device SparseMatrix for the padded pattern.
+
+        ELL and dense carry the pattern in array LEAVES (covered by
+        the compile-cache signature); DIA offsets are STATIC metadata,
+        so DIA buckets share compiled programs only with
+        matching-offset patterns — the service still prefers DIA for
+        stencil patterns because its slice+FMA SpMV avoids gathers
+        (the throughput/dedup trade, amgx_tpu.serve.service)."""
+        assert set(accel_formats) <= {"dia", "dense", "ell"}, (
+            accel_formats
+        )
+        return SparseMatrix.from_csr(
+            self.row_offsets,
+            self.col_indices,
+            self.embed_values(values, dtype=dtype),
+            n_cols=self.nb,
+            build_ell=bool(accel_formats),
+            accel_formats=tuple(accel_formats),
+        )
+
+
+def pad_pattern(row_offsets, col_indices, n: int) -> PaddedPattern:
+    """Pad a scalar CSR pattern to its (nb, nnzb) bucket.
+
+    Filler entries (zero-valued duplicates of each row's last stored
+    column) are spread evenly over all rows so the padded max row
+    length stays close to the original — that keeps the ELL width
+    small, which is what makes the batched SpMV a gather+FMA instead
+    of a scatter."""
+    row_offsets = np.asarray(row_offsets, dtype=np.int64)
+    col_indices = np.asarray(col_indices, dtype=np.int32)
+    nnz = int(col_indices.shape[0])
+    pad_rows_pre = bucket_size(n, MIN_ROWS_BUCKET) - n
+    nb = n + pad_rows_pre
+    nnzb = bucket_size(nnz + pad_rows_pre, MIN_NNZ_BUCKET)
+    filler = nnzb - nnz - pad_rows_pre
+    # per-row entry counts: original rows keep theirs, padding rows get
+    # their unit diagonal; filler spreads evenly across all nb rows
+    lens = np.empty(nb, dtype=np.int64)
+    lens[:n] = np.diff(row_offsets)
+    lens[n:] = 1
+    base_lens = lens.copy()
+    q, rem = divmod(filler, nb)
+    lens += q
+    # remainder extras go to the SHORTEST rows: keeps the padded max
+    # row length (= ELL width) stable across patterns that share a
+    # row-length multiset (e.g. symmetric permutations of one stencil)
+    if rem:
+        lens[np.argsort(base_lens, kind="stable")[:rem]] += 1
+    ro = np.zeros(nb + 1, dtype=np.int64)
+    np.cumsum(lens, out=ro[1:])
+    assert ro[nb] == nnzb
+    # original entries keep their in-row order at each row's start
+    row_ids = np.repeat(np.arange(n, dtype=np.int64),
+                        np.diff(row_offsets))
+    scatter = (
+        ro[row_ids] + np.arange(nnz, dtype=np.int64) - row_offsets[row_ids]
+    )
+    ones_pos = ro[n:nb]  # padding rows' diagonal slot
+    # filler columns: duplicate each row's LAST stored column (its own
+    # diagonal for padding rows) — appended after the real entries, so
+    # in-row column order stays non-decreasing
+    ci = np.zeros(nnzb, dtype=np.int32)
+    ci[scatter] = col_indices
+    ci[ones_pos] = n + np.arange(pad_rows_pre, dtype=np.int64)
+    last_col = np.zeros(nb, dtype=np.int32)
+    has = np.diff(row_offsets) > 0
+    last_col[:n][has] = col_indices[row_offsets[1:][has] - 1]
+    last_col[n:] = n + np.arange(pad_rows_pre, dtype=np.int64)
+    fill_rows = np.repeat(
+        np.arange(nb, dtype=np.int64), (lens - base_lens)
+    )
+    fill_pos = np.setdiff1d(
+        np.arange(nnzb, dtype=np.int64),
+        np.concatenate([scatter, ones_pos]),
+        assume_unique=False,
+    )
+    ci[fill_pos] = last_col[fill_rows]
+    ro32 = ro.astype(np.int32)
+    fp = sparsity_fingerprint(ro32, ci, nb, nb, 1)
+    pad_row_ids = np.repeat(np.arange(nb, dtype=np.int64), lens)
+    num_diags = int(np.unique(ci.astype(np.int64) - pad_row_ids).size)
+    return PaddedPattern(
+        row_offsets=ro32,
+        col_indices=ci,
+        scatter=scatter,
+        ones_pos=ones_pos.astype(np.int64),
+        n=int(n),
+        nnz=nnz,
+        nb=nb,
+        nnzb=nnzb,
+        max_row_len=int(lens.max()) if nb else 0,
+        num_diagonals=num_diags,
+        fingerprint=fp,
+    )

@@ -1,0 +1,114 @@
+"""Hierarchy cache: one solver setup per (sparsity fingerprint, config)
+pair, shared by every request that reuses the pattern.
+
+This is the service-side generalization of ``AMGX_solver_resetup`` /
+``structure_reuse_levels``: the reference lets ONE solver object reuse
+its setup across coefficient swaps; the cache lets EVERY request with a
+matching sparsity fingerprint reuse one setup — AMG coarsening,
+colorings, Galerkin plans, LU factors — with per-request coefficients
+flowing through the traced batch-params rebuild
+(:mod:`amgx_tpu.serve.batched`).
+
+Cache semantics follow the reference's structure-reuse contract: the
+hierarchy STRUCTURE (aggregates / C-F splitting / transfer-operator
+weights) is the one computed from the first-seen coefficient set; later
+coefficient sets re-evaluate the Galerkin chain values only.  Callers
+whose coefficients drift far from the setup set should evict (the cache
+is LRU-bounded) or use a fresh service.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from amgx_tpu.serve.bucketing import PaddedPattern
+from amgx_tpu.serve.metrics import ServeMetrics
+
+
+def config_hash(cfg) -> str:
+    """Stable content hash of an AMGConfig (scoped key/value map)."""
+    items = sorted(
+        (str(scope), str(name), repr(value))
+        for (scope, name), value in cfg.items().items()
+    )
+    h = hashlib.blake2b(digest_size=12)
+    for scope, name, value in items:
+        h.update(f"{scope}\0{name}\0{value}\1".encode())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class HierarchyEntry:
+    """One cached setup: the template solver, its batch template, and
+    the batched solve fn (unjitted — the service's compile cache owns
+    jitting, keyed by shape bucket)."""
+
+    solver: object  # set-up Solver (on the padded template matrix)
+    template: object  # batch-params template pytree (None: no fast path)
+    batch_fn: Optional[Callable]  # fn(template, vals_B, b_B, x0_B)
+    signature: object  # hashable shape signature of the template pytree
+    pattern: PaddedPattern
+
+
+def template_signature(template) -> tuple:
+    """Hashable (treedef, leaf shapes/dtypes) signature of a template
+    pytree.  Two entries with equal signatures and equal config produce
+    identical traces, so they may share one jitted executable — this is
+    what makes a shape-bucket hit an XLA compile-cache hit."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    return (
+        str(treedef),
+        tuple(
+            (tuple(l.shape), str(l.dtype))
+            for l in leaves
+            if hasattr(l, "shape")
+        ),
+    )
+
+
+class HierarchyCache:
+    """LRU cache: (padded fingerprint, config hash, dtype) -> entry."""
+
+    def __init__(self, max_entries: int = 64,
+                 metrics: Optional[ServeMetrics] = None):
+        self.max_entries = max_entries
+        self.metrics = metrics or ServeMetrics()
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+
+    def __len__(self):
+        return len(self._entries)
+
+    def get_or_build(
+        self, pattern: PaddedPattern, cfg_key: str, dtype,
+        build: Callable[[], HierarchyEntry],
+    ) -> HierarchyEntry:
+        key = (pattern.fingerprint, cfg_key, str(dtype))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.metrics.inc("cache_hits")
+                return entry
+        # build outside the lock: setup is seconds-long and other
+        # fingerprints must not queue behind it
+        self.metrics.inc("cache_misses")
+        self.metrics.inc("setups")
+        entry = build()
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.metrics.inc("cache_evictions")
+        return entry
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()

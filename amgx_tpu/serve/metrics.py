@@ -1,0 +1,104 @@
+"""Serve-layer metrics: counters and per-bucket latency for the batched
+solve service.
+
+The counter set mirrors what an inference-serving stack exports (queue
+depth, batch occupancy, compile-cache behaviour) because the dispatcher
+IS a continuous-batching server — the "kernel launch" it amortizes is
+an XLA dispatch.  Timings route through the existing profiling hooks
+(:class:`amgx_tpu.core.profiling.LevelProfile` for phase attribution,
+``trace_range`` for trace spans) so serve activity shows up in the same
+places solver activity already does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import defaultdict
+
+from amgx_tpu.core.profiling import LevelProfile
+
+
+@dataclasses.dataclass
+class BucketStat:
+    """Latency/occupancy accumulator for one (n, nnz, batch) bucket."""
+
+    calls: int = 0
+    total_s: float = 0.0
+    instances: int = 0  # real (non-padding) instances executed
+    pad_instances: int = 0  # batch-padding dummies executed
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.calls if self.calls else 0.0
+
+
+class ServeMetrics:
+    """Thread-safe counter registry for one BatchedSolveService."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = defaultdict(int)
+        self.buckets: dict = defaultdict(BucketStat)
+        # phase attribution (pad / stack / execute / unpack), reusing
+        # the reference-parity tic/toc machinery
+        self.profile = LevelProfile()
+
+    # -- counters ------------------------------------------------------
+
+    def inc(self, name: str, by: int = 1):
+        with self._lock:
+            self.counters[name] += by
+
+    def set_gauge(self, name: str, value: int):
+        with self._lock:
+            self.counters[name] = value
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self.counters.get(name, 0)
+
+    # -- buckets -------------------------------------------------------
+
+    def record_batch(self, bucket_key, seconds: float, n_real: int,
+                     n_pad: int):
+        with self._lock:
+            st = self.buckets[bucket_key]
+            st.calls += 1
+            st.total_s += seconds
+            st.instances += n_real
+            st.pad_instances += n_pad
+
+    # -- export --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy of every counter plus derived rates."""
+        with self._lock:
+            out = dict(self.counters)
+            out["buckets"] = {
+                str(k): dataclasses.asdict(v)
+                for k, v in self.buckets.items()
+            }
+        hits = out.get("bucket_hits", 0)
+        misses = out.get("compiles", 0)
+        total = hits + misses
+        out["bucket_hit_rate"] = hits / total if total else 0.0
+        padded = out.get("padded_elems", 0)
+        if padded:
+            out["pad_waste_frac"] = 1.0 - out.get("real_elems", 0) / padded
+        return out
+
+    def table(self) -> str:
+        snap = self.snapshot()
+        lines = ["    serve metrics:"]
+        for k in sorted(snap):
+            if k == "buckets":
+                continue
+            lines.append(f"      {k:<28s} {snap[k]}")
+        for bk, st in sorted(snap["buckets"].items()):
+            lines.append(
+                f"      bucket {bk}: calls={st['calls']} "
+                f"mean={st['total_s'] / max(st['calls'], 1):.4f}s "
+                f"real={st['instances']} pad={st['pad_instances']}"
+            )
+        return "\n".join(lines)

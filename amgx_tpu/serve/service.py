@@ -1,0 +1,539 @@
+"""Batched solve service: accept many independent solve requests,
+execute them as a small number of vmapped device calls.
+
+Shape of the system (an inference-server-style continuous batcher):
+
+  submit(A, b) ──┐   group by (padded-pattern fingerprint, dtype)
+  submit(A, b) ──┼─> bounded queue ──flush──> pad to (n, nnz, B) bucket
+  submit(A, b) ──┘   (max_batch / max-wait)    │
+                                               ▼
+                             hierarchy cache (fingerprint + config):
+                             one solver setup per pattern, reused for
+                             every later coefficient set
+                                               │
+                                               ▼
+                             compile cache (shape bucket + config):
+                             one jitted batched solve per bucket
+                                               │
+                                               ▼
+                             vmapped masked-convergence solve
+                             (serve.batched), results unpadded
+
+Solvers without a traced batch path (GMRES, multicolor GS, ...) fall
+back to sequential resetup+solve per request — correct, just not
+amortized; the ``fallback_solves`` counter exposes it.
+
+Scalar (block_size == 1) systems only for now: block coefficient
+layouts don't survive the nnz-padding embedding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.core.profiling import trace_range
+from amgx_tpu.serve.batched import make_batched_solve
+from amgx_tpu.serve.bucketing import (
+    PaddedPattern,
+    bucket_batch,
+    pad_pattern,
+)
+from amgx_tpu.serve.cache import (
+    HierarchyCache,
+    HierarchyEntry,
+    config_hash,
+    template_signature,
+)
+from amgx_tpu.serve.metrics import ServeMetrics
+
+def _host_csr(A):
+    """(row_offsets, col_indices, values, n, raw_fingerprint) host
+    arrays from a SparseMatrix or scipy sparse matrix; scalar matrices
+    only.  The fingerprint keys the padded-pattern cache (SparseMatrix
+    memoizes its own, so repeat submissions skip the hash too)."""
+    from amgx_tpu.core.matrix import sparsity_fingerprint
+
+    if isinstance(A, SparseMatrix):
+        if A.block_size != 1:
+            raise ValueError(
+                "BatchedSolveService: scalar (block_size == 1) "
+                "systems only"
+            )
+        return (
+            np.asarray(A.row_offsets),
+            np.asarray(A.col_indices),
+            np.asarray(A.values),
+            A.n_rows,
+            A.fingerprint(),
+        )
+    try:
+        sp = A.tocsr()
+    except AttributeError:
+        raise TypeError(
+            f"expected SparseMatrix or scipy sparse matrix, got "
+            f"{type(A).__name__}"
+        ) from None
+    sp.sort_indices()
+    fp = sparsity_fingerprint(
+        sp.indptr, sp.indices, sp.shape[0], sp.shape[1], 1
+    )
+    return sp.indptr, sp.indices, sp.data, sp.shape[0], fp
+
+
+# the service's stock configuration — also the workload ci/serve_bench.py
+# and tests/test_serve.py measure
+DEFAULT_CONFIG = (
+    '{"config_version": 2, "solver": {"scope": "main", "solver": "PCG",'
+    ' "max_iters": 200, "tolerance": 1e-8,'
+    ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+    ' "preconditioner": {"scope": "jac", "solver": "BLOCK_JACOBI",'
+    ' "relaxation_factor": 0.9, "max_iters": 2,'
+    ' "monitor_residual": 0}}}'
+)
+
+
+@dataclasses.dataclass
+class SolveTicket:
+    """Handle returned by submit(); result() blocks (flushing the
+    owning group if needed) and returns a per-request SolveResult."""
+
+    _service: "BatchedSolveService"
+    _group_key: tuple
+    _result: object = None
+    _done: bool = False
+    _error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done
+
+    def result(self):
+        if not self._done:
+            self._service._flush_group_of(self)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclasses.dataclass
+class _Request:
+    pattern: PaddedPattern
+    values: np.ndarray  # padded (nnzb,)
+    b: np.ndarray  # padded (nb,)
+    x0: np.ndarray  # padded (nb,)
+    ticket: SolveTicket
+
+
+@dataclasses.dataclass
+class _Group:
+    key: tuple  # (padded fingerprint, dtype str)
+    pattern: PaddedPattern
+    dtype: np.dtype
+    requests: list
+    deadline: float
+
+
+class BatchedSolveService:
+    """Shape-bucketed, vmapped multi-system solver frontend.
+
+    Parameters
+    ----------
+    config: AMGConfig | JSON/kv string | None — solver configuration
+        shared by every request (the service IS one config; run several
+        services for several configs).  Default: Jacobi-PCG.
+    max_batch: flush a group when it reaches this many requests.
+    max_wait_s: flush a group this long after its first request
+        (enforced by poll()/flush(); start() runs a background poller).
+    queue_limit: bound on total queued requests; reaching it flushes
+        everything (backpressure, never unbounded memory).
+    """
+
+    def __init__(
+        self,
+        config=None,
+        max_batch: int = 32,
+        max_wait_s: float = 0.02,
+        queue_limit: int = 1024,
+        cache_entries: int = 64,
+    ):
+        if config is None:
+            config = DEFAULT_CONFIG
+        if isinstance(config, str):
+            config = AMGConfig.from_string(config)
+        self.cfg = config
+        self.cfg_key = config_hash(config)
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.queue_limit = int(queue_limit)
+        self.metrics = ServeMetrics()
+        self.cache = HierarchyCache(
+            max_entries=cache_entries, metrics=self.metrics
+        )
+        self._lock = threading.RLock()
+        self._groups: dict = {}
+        self._queued = 0
+        self._compiled: dict = {}
+        self._patterns: dict = {}
+        self._poller: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    # submission
+
+    def submit(self, A, b, x0=None) -> SolveTicket:
+        """Queue one system; returns a ticket.  ``A`` is a SparseMatrix
+        or scipy sparse matrix (scalar block size)."""
+        ro, ci, vals, n, raw_fp = _host_csr(A)
+        pattern = self._pattern_for(ro, ci, n, raw_fp)
+        dtype = np.dtype(vals.dtype)
+        if not np.issubdtype(dtype, np.inexact):
+            # integer uploads promote; complex dtypes pass through
+            dtype = np.dtype(np.float64)
+        with trace_range("serve_submit"), self.metrics.profile.phase(
+            "pad"
+        ):
+            req_vals = pattern.embed_values(vals, dtype=dtype)
+            req_b = pattern.embed_vector(b, dtype)
+            req_x0 = pattern.embed_vector(x0, dtype)
+        key = (pattern.fingerprint, str(dtype))
+        flush_now = []
+        with self._lock:
+            grp = self._groups.get(key)
+            if grp is None:
+                grp = _Group(
+                    key=key,
+                    pattern=pattern,
+                    dtype=dtype,
+                    requests=[],
+                    deadline=time.monotonic() + self.max_wait_s,
+                )
+                self._groups[key] = grp
+            ticket = SolveTicket(_service=self, _group_key=key)
+            grp.requests.append(
+                _Request(
+                    pattern=pattern,
+                    values=req_vals,
+                    b=req_b,
+                    x0=req_x0,
+                    ticket=ticket,
+                )
+            )
+            self._queued += 1
+            self.metrics.inc("submitted")
+            self.metrics.set_gauge("queue_depth", self._queued)
+            if len(grp.requests) >= self.max_batch:
+                flush_now.append(self._take_group(key))
+            elif self._queued >= self.queue_limit:
+                flush_now.extend(
+                    self._take_group(k) for k in list(self._groups)
+                )
+        for grp in flush_now:
+            self._execute_group(grp)
+        return ticket
+
+    def solve_many(self, systems):
+        """Synchronous convenience: submit every (A, b[, x0]) tuple,
+        flush, and return the per-system SolveResults in order."""
+        tickets = [self.submit(*sys) for sys in systems]
+        self.flush()
+        return [t.result() for t in tickets]
+
+    # ------------------------------------------------------------------
+    # flushing
+
+    def flush(self):
+        """Execute every queued group now."""
+        with self._lock:
+            groups = [self._take_group(k) for k in list(self._groups)]
+        for grp in groups:
+            self._execute_group(grp)
+
+    def poll(self):
+        """Execute groups whose max-wait deadline has passed."""
+        now = time.monotonic()
+        with self._lock:
+            due = [
+                self._take_group(k)
+                for k, g in list(self._groups.items())
+                if g.deadline <= now
+            ]
+        for grp in due:
+            self._execute_group(grp)
+
+    def start(self, interval_s: float = 0.005):
+        """Run a daemon poller enforcing max_wait_s in the background."""
+        if self._poller is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                self.poll()
+
+        self._poller = threading.Thread(
+            target=loop, name="serve-poller", daemon=True
+        )
+        self._poller.start()
+
+    def stop(self):
+        if self._poller is None:
+            return
+        self._stop.set()
+        self._poller.join()
+        self._poller = None
+        self.flush()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------------
+    # internals
+
+    _PATTERN_CACHE_MAX = 512
+
+    def _pattern_for(self, ro, ci, n, raw_fp) -> PaddedPattern:
+        """Padded pattern for a raw fingerprint, cached: re-padding on
+        every submission would cost O(nnz log nnz) host work per
+        request — more than the batched solve itself for small
+        systems."""
+        with self._lock:
+            pat = self._patterns.get(raw_fp)
+        if pat is not None:
+            return pat
+        pat = pad_pattern(ro, ci, n)
+        with self._lock:
+            if len(self._patterns) >= self._PATTERN_CACHE_MAX:
+                self._patterns.clear()
+            self._patterns[raw_fp] = pat
+        return pat
+
+    # total bytes the batched dense copies may occupy (B x nb x nb);
+    # above it a non-ELL bucket stays CSR (segment-sum SpMV)
+    _DENSE_BUDGET_MB = 256
+    # padded max row length up to which the ELL structure is used
+    _ELL_MAX_WIDTH = 64
+
+    def _accel_for(self, pat: PaddedPattern) -> tuple:
+        """Bucket-safe acceleration formats for a padded pattern.
+
+        Preference order mirrors ops.spmv: DIA for stencil-shaped
+        patterns (slice + FMA, no gathers — gathers and scatters are
+        the slow ops on both CPU XLA and TPU), then ELL (gather + FMA,
+        nnz-proportional work), then dense (batched GEMV, n^2 work,
+        small buckets within the byte budget), then CSR segment-sum.
+        DIA's offsets are static metadata, so DIA entries share a
+        compiled program only with matching-offset patterns; the
+        same-fingerprint compile-reuse guarantee is unaffected."""
+        import os
+
+        from amgx_tpu.core.matrix import dia_gate
+
+        if dia_gate(pat.num_diagonals, pat.nb, pat.nnzb):
+            return ("dia",)
+        w = pat.max_row_len
+        if 0 < w <= self._ELL_MAX_WIDTH and w * pat.nb <= 4 * pat.nnzb:
+            return ("ell",)
+        budget = (
+            int(
+                os.environ.get(
+                    "AMGX_TPU_SERVE_DENSE_MB", self._DENSE_BUDGET_MB
+                )
+            )
+            * 2**20
+        )
+        bb = bucket_batch(self.max_batch)
+        if bb * pat.nb * pat.nb * 8 <= budget:
+            return ("dense",)
+        return ()
+
+    def _take_group(self, key) -> _Group:
+        """Remove a group from the queue (caller holds the lock)."""
+        grp = self._groups.pop(key)
+        self._queued -= len(grp.requests)
+        self.metrics.set_gauge("queue_depth", self._queued)
+        return grp
+
+    def _flush_group_of(self, ticket: SolveTicket):
+        with self._lock:
+            grp = self._groups.get(ticket._group_key)
+            if grp is None or ticket not in [
+                r.ticket for r in grp.requests
+            ]:
+                grp = None
+            else:
+                grp = self._take_group(ticket._group_key)
+        if grp is not None:
+            self._execute_group(grp)
+        elif not ticket._done:
+            # another thread is executing the group right now
+            while not ticket._done:
+                time.sleep(0.001)
+
+    def _build_entry(self, grp: _Group) -> HierarchyEntry:
+        """One solver setup for this padded pattern (hierarchy-cache
+        miss path), using the group's first coefficient set."""
+        import amgx_tpu.solvers  # noqa: F401 — registry side effects
+        import amgx_tpu.amg  # noqa: F401 — registers "AMG"
+        from amgx_tpu.solvers.registry import create_solver, make_nested
+
+        with self.metrics.profile.phase("setup"):
+            A = grp.pattern.template_matrix(
+                grp.pattern.extract_values(grp.requests[0].values),
+                grp.dtype,
+                accel_formats=self._accel_for(grp.pattern),
+            )
+            # make_nested: the service owns the solve boundary — no
+            # per-solver rescaling/renumbering of padded systems
+            solver = make_nested(create_solver(self.cfg, "default"))
+            solver.setup(A)
+            bp = solver.make_batch_params()
+            batch_fn = make_batched_solve(solver)
+            template = bp[0] if bp is not None else None
+            sig = (
+                template_signature(template)
+                if batch_fn is not None
+                else None
+            )
+        return HierarchyEntry(
+            solver=solver,
+            template=template,
+            batch_fn=batch_fn,
+            signature=sig,
+            pattern=grp.pattern,
+        )
+
+    def _compiled_fn(self, entry: HierarchyEntry, Bb: int):
+        """Jitted batched solve shared across every hierarchy entry
+        with the same template signature (= shape bucket) and batch
+        bucket — a bucket hit is an XLA compile-cache hit."""
+        import jax
+
+        key = (entry.signature, Bb)
+        with self._lock:
+            fn = self._compiled.get(key)
+            if fn is not None:
+                self.metrics.inc("bucket_hits")
+                return fn
+            self.metrics.inc("compiles")
+            fn = jax.jit(entry.batch_fn)
+            self._compiled[key] = fn
+            return fn
+
+    def _execute_group(self, grp: _Group):
+        if not grp.requests:
+            return
+        try:
+            entry = self.cache.get_or_build(
+                grp.pattern,
+                self.cfg_key,
+                grp.dtype,
+                lambda: self._build_entry(grp),
+            )
+            if entry.batch_fn is None:
+                self._execute_sequential(entry, grp)
+            else:
+                self._execute_batched(entry, grp)
+        except BaseException as e:  # noqa: BLE001 — failures must
+            # reach the tickets, not kill the poller thread (tickets
+            # already completed — e.g. earlier fallback solves — keep
+            # their results)
+            for r in grp.requests:
+                if r.ticket._done:
+                    continue
+                r.ticket._error = e
+                r.ticket._done = True
+            self.metrics.inc("failed_groups")
+
+    def _execute_batched(self, entry: HierarchyEntry, grp: _Group):
+        import jax.numpy as jnp
+
+        # submit() flushes a group at max_batch, so one batch bucket
+        # always covers the whole group
+        chunk = grp.requests
+        Bb = bucket_batch(len(chunk))
+        n_pad = Bb - len(chunk)
+        self.metrics.inc("batches")
+        pat = grp.pattern
+        with self.metrics.profile.phase("stack"):
+            # batch padding: clones of the first system with b=0
+            # converge at iteration 0 and freeze immediately
+            vals = np.stack(
+                [r.values for r in chunk] + [chunk[0].values] * n_pad
+            )
+            bs = np.stack(
+                [r.b for r in chunk]
+                + [np.zeros_like(chunk[0].b)] * n_pad
+            )
+            x0s = np.stack(
+                [r.x0 for r in chunk]
+                + [np.zeros_like(chunk[0].x0)] * n_pad
+            )
+        fn = self._compiled_fn(entry, Bb)
+        t0 = time.perf_counter()
+        with trace_range("serve_batch_execute"), \
+                self.metrics.profile.phase("execute"):
+            res = fn(
+                entry.template,
+                jnp.asarray(vals),
+                jnp.asarray(bs),
+                jnp.asarray(x0s),
+            )
+            res.x.block_until_ready()
+        dt = time.perf_counter() - t0
+        bucket_key = (pat.nb, pat.nnzb, Bb)
+        self.metrics.record_batch(bucket_key, dt, len(chunk), n_pad)
+        self.metrics.inc("solved", len(chunk))
+        self.metrics.inc("padded_elems", Bb * pat.nb)
+        self.metrics.inc(
+            "real_elems", sum(r.pattern.n for r in chunk)
+        )
+        with self.metrics.profile.phase("unpack"):
+            # one device->host transfer per field, then numpy
+            # slicing (per-request device slices would cost ~6
+            # dispatches each and dominate small-system batches)
+            x_h = np.asarray(res.x)
+            iters_h = np.asarray(res.iters)
+            status_h = np.asarray(res.status)
+            fin_h = np.asarray(res.final_norm)
+            ini_h = np.asarray(res.initial_norm)
+            hist_h = np.asarray(res.history)
+            for i, r in enumerate(chunk):
+                r.ticket._result = dataclasses.replace(
+                    res,
+                    x=x_h[i, : r.pattern.n],
+                    iters=iters_h[i],
+                    status=status_h[i],
+                    final_norm=fin_h[i],
+                    initial_norm=ini_h[i],
+                    history=hist_h[i],
+                )
+                r.ticket._done = True
+
+    def _execute_sequential(self, entry: HierarchyEntry, grp: _Group):
+        """Fallback for solvers without a traced batch path."""
+        pat = grp.pattern
+        for r in grp.requests:
+            with self.metrics.profile.phase("fallback"):
+                A = pat.template_matrix(
+                    pat.extract_values(r.values),
+                    grp.dtype,
+                    accel_formats=self._accel_for(pat),
+                )
+                entry.solver.resetup(A)
+                res = entry.solver.solve(r.b, x0=r.x0)
+            r.ticket._result = dataclasses.replace(
+                res, x=res.x[: pat.n]
+            )
+            r.ticket._done = True
+            self.metrics.inc("fallback_solves")
+            self.metrics.inc("solved")

@@ -408,6 +408,29 @@ class Solver:
         """Attempt a values-only refresh; False -> caller runs setup."""
         return False
 
+    def make_batch_params(self):
+        """Traced values-only params rebuild, for batched group solves
+        (:mod:`amgx_tpu.serve`).
+
+        Returns ``(template, fn)`` where ``fn(template, values) ->
+        params`` is a pure jit/vmap-safe function rebuilding this
+        solver's ``apply_params()`` pytree for a coefficient set
+        ``values`` on the SAME sparsity pattern as the setup matrix —
+        the traced analogue of :meth:`resetup`.  ``template`` is a
+        pytree of device arrays holding everything pattern-specific
+        (index structures, transfer operators, SpGEMM plans); it is
+        passed to ``fn`` as an ARGUMENT so the serve layer can hand it
+        to one jit-compiled program per shape bucket instead of baking
+        the pattern into the compiled code.
+
+        Returns None when the solver has no traced values-only rebuild
+        (callers fall back to sequential resetup + solve).  The default
+        covers solvers whose params ARE the matrix.
+        """
+        if self._params is None or self._params is not self.A:
+            return None
+        return self.A, lambda t, v: t.replace_values(v)
+
     def apply_params(self):
         return self._params
 

@@ -24,6 +24,26 @@ class DenseLUSolver(Solver):
         lu, piv = jax.scipy.linalg.lu_factor(dense)
         self._params = (A, lu, piv)
 
+    def make_batch_params(self):
+        A0 = self._params[0]
+        if A0.block_size != 1:
+            return None
+
+        def fn(t, v):
+            A = t.replace_values(v)
+            if A.has_dense:
+                dense = A.dense
+            else:
+                dense = (
+                    jnp.zeros((A.n_rows, A.n_cols), A.values.dtype)
+                    .at[A.row_ids, A.col_indices]
+                    .add(A.values)
+                )
+            lu, piv = jax.scipy.linalg.lu_factor(dense)
+            return A, lu, piv
+
+        return A0, fn
+
     def make_apply(self):
         def apply(params, r):
             _, lu, piv = params

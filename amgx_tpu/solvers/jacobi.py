@@ -63,6 +63,15 @@ class BlockJacobiSolver(_DiagSmootherBase):
     def _setup_impl(self, A):
         self._params = (A, invert_diag(A))
 
+    def make_batch_params(self):
+        from amgx_tpu.ops.diagonal import invert_diag_jnp
+
+        def fn(t, v):
+            A = t.replace_values(v)
+            return A, invert_diag_jnp(A)
+
+        return self._params[0], fn
+
 
 @register_solver("JACOBI_L1")
 class JacobiL1Solver(_DiagSmootherBase):
@@ -80,3 +89,25 @@ class JacobiL1Solver(_DiagSmootherBase):
         with np.errstate(divide="ignore"):
             dinv = np.where(d != 0, 1.0 / d, 1.0)
         self._params = (A, jnp.asarray(dinv.astype(vals.dtype)))
+
+    def make_batch_params(self):
+        A0 = self._params[0]
+        if A0 is not self.A:
+            # block input was scalar-expanded at setup: the incoming
+            # values array no longer maps 1:1 onto the operator
+            return None
+
+        def fn(t, v):
+            A = t.replace_values(v)
+            av = jnp.abs(A.values)
+            offd = jax.ops.segment_sum(
+                av * (A.col_indices != A.row_ids),
+                A.row_ids,
+                num_segments=A.n_rows,
+                indices_are_sorted=True,
+            )
+            d = jnp.abs(A.diag) + offd
+            dinv = jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 1.0)
+            return A, dinv
+
+        return A0, fn

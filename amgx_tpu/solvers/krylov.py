@@ -68,6 +68,21 @@ class KrylovSolver(Solver):
             return lambda Mp, r: r
         return self.precond.make_apply()
 
+    def make_batch_params(self):
+        A0 = self._params[0]
+        if self.precond is None:
+            return A0, lambda t, v: (t.replace_values(v), None)
+        sub = self.precond.make_batch_params()
+        if sub is None:
+            return None
+        ptmpl, pfn = sub
+
+        def fn(t, v):
+            At, pt = t
+            return At.replace_values(v), pfn(pt, v)
+
+        return (A0, ptmpl), fn
+
     # -- iteration protocol (subclasses) --------------------------------
     # extra is solver state; extra[0] must be the current residual r.
 

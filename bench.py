@@ -172,6 +172,36 @@ def _solve_record(n_side):
     }
 
 
+def _serve_record():
+    """Batched solve service throughput (ci/serve_bench.py scenario,
+    small sizes): batched vs sequential-loop solves of pattern-sharing
+    systems.  Guarded — the serve record must never take the headline
+    bench down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.serve_bench import run as serve_run
+
+        rec = serve_run(shape=(16, 16), batch=16, reps=2)
+        return {
+            k: rec[k]
+            for k in (
+                "value",
+                "unit",
+                "problem",
+                "batched_solves_per_s",
+                "sequential_solves_per_s",
+                "bucket_hit_rate",
+                "pad_waste_frac",
+            )
+        }
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: serve record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _backend_responsive(timeout_s=240):
     """Probe backend init in a subprocess: a broken remote tunnel hangs
     jax.devices() indefinitely, which must not take the benchmark run
@@ -351,6 +381,10 @@ def main():
     solve_rec = _solve_record(128 if on_tpu else 24)
     print(f"bench: solve {solve_rec}", file=sys.stderr)
 
+    # ---- batched solve service -------------------------------------
+    serve_rec = _serve_record()
+    print(f"bench: serve {serve_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -368,6 +402,7 @@ def main():
                 "unstructured_rcm_adopted": perm_u is not None,
                 "unstructured_bytes_per_s_lb": round(ell_bw / 1e9, 1),
                 "solve": solve_rec,
+                "serve": serve_rec,
             }
         )
     )

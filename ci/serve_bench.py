@@ -1,0 +1,151 @@
+"""Serve-layer throughput: batched vs sequential-Python-loop solves.
+
+Prints ONE JSON line (same contract as bench.py / BENCH_*.json):
+{"metric": "serve_batched_speedup", "value": <x>, ...} — value is the
+wall-clock throughput ratio of the batched service path over a
+sequential Python loop dispatching the SAME compiled per-system solve
+(the strongest honest baseline: one jitted program, params swapped per
+call — no recompiles charged to the loop).
+
+Run on the CPU backend (the tier the acceptance gate measures):
+
+    JAX_PLATFORMS=cpu python ci/serve_bench.py [--out BENCH_serve.json]
+
+Methodology: B pattern-sharing Jacobi-PCG Poisson systems, warm-up
+call excluded (compile + setup amortize across a service's lifetime,
+which is the serving scenario), best-of-3 timed repetitions.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+# runnable from any cwd: the repo root precedes ci/ on the path
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def run(shape=(16, 16), batch=16, reps=3, config=None):
+    import jax
+    import numpy as np
+
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.io.poisson import jittered_poisson_family
+    from amgx_tpu.serve import DEFAULT_CONFIG, BatchedSolveService
+    from amgx_tpu.solvers.registry import create_solver, make_nested
+
+    if config is None:
+        config = DEFAULT_CONFIG
+    systems = jittered_poisson_family(shape, batch, seed=0)
+    n = systems[0][0].shape[0]
+
+    # ---- batched service path --------------------------------------
+    svc = BatchedSolveService(config=config, max_batch=batch)
+    svc.solve_many(systems)  # warm-up: setup + compile
+    t_batch = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        results = svc.solve_many(systems)
+        t_batch = min(t_batch, time.perf_counter() - t0)
+
+    # ---- sequential Python loop baseline ---------------------------
+    # strongest honest loop: setup and compiles OUTSIDE the loop (one
+    # solver, one jitted solve, one jitted values-only rebuild); the
+    # loop pays what every coefficient-swapping caller pays per
+    # system — upload the new values, rebuild params on device
+    # (replace_coefficients), solve, read the solution back.  The
+    # batched path pays the same stages inside ITS timed region.
+    cfg = AMGConfig.from_string(config)
+    solver = make_nested(create_solver(cfg, "default"))
+    A0 = SparseMatrix.from_scipy(systems[0][0])
+    solver.setup(A0)
+    tmpl, params_of = solver.make_batch_params()
+    solve_one = jax.jit(solver.make_solve())
+    rebuild = jax.jit(params_of)
+    vals = [
+        np.asarray(sp.data, dtype=A0.values.dtype) for sp, _ in systems
+    ]
+    import jax.numpy as jnp
+
+    x0 = jnp.zeros(n, dtype=A0.values.dtype)
+    bs_host = [np.asarray(b, dtype=A0.values.dtype) for _, b in systems]
+    r = solve_one(rebuild(tmpl, jnp.asarray(vals[0])), jnp.asarray(
+        bs_host[0]), x0)
+    r.x.block_until_ready()  # warm-up: compile both programs
+    t_seq = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        seq = []
+        for v, b in zip(vals, bs_host):
+            p = rebuild(tmpl, jnp.asarray(v))
+            r = solve_one(p, jnp.asarray(b), x0)
+            np.asarray(r.x)  # the caller consumes each solution
+            seq.append(r)
+        t_seq = min(t_seq, time.perf_counter() - t0)
+
+    # parity spot-check: the speedup must not come from solving less
+    for r, sref, (sp, b) in zip(results, seq, systems):
+        xa, xb = np.asarray(r.x), np.asarray(sref.x)
+        err = np.linalg.norm(xa - xb) / max(np.linalg.norm(xb), 1e-300)
+        assert err < 1e-8, f"batched/sequential diverged: {err}"
+
+    m = svc.metrics.snapshot()
+    dev = jax.devices()[0]
+    return {
+        "metric": "serve_batched_speedup",
+        "value": round(t_seq / t_batch, 2),
+        "unit": "x vs sequential python loop",
+        "device": f"{dev.platform}"
+        f" ({getattr(dev, 'device_kind', '?')})",
+        "problem": f"poisson5_{shape[0]}x{shape[1]}_B{batch}",
+        "config": "PCG+BLOCK_JACOBI",
+        "n": n,
+        "batch": batch,
+        "t_batched_s": round(t_batch, 5),
+        "t_sequential_s": round(t_seq, 5),
+        "batched_solves_per_s": round(batch / t_batch, 1),
+        "sequential_solves_per_s": round(batch / t_seq, 1),
+        "bucket_hit_rate": round(m["bucket_hit_rate"], 3),
+        "pad_waste_frac": round(m.get("pad_waste_frac", 0.0), 3),
+        "compiles": m.get("compiles", 0),
+        "setups": m.get("setups", 0),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON record to this file")
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--side", type=int, default=16,
+                    help="2D Poisson side length")
+    args = ap.parse_args(argv)
+
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # f64 end-to-end on CPU (the tier-1 configuration): the
+        # batched-vs-sequential parity check is exact there
+        jax.config.update("jax_enable_x64", True)
+    rec = run(shape=(args.side, args.side), batch=args.batch)
+    line = json.dumps(rec)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if rec["value"] < 3.0:
+        print(
+            f"serve_bench: speedup {rec['value']}x below the 3x "
+            "acceptance floor",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

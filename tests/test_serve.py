@@ -1,0 +1,373 @@
+"""Batched solve service (amgx_tpu.serve): batched-vs-sequential
+parity, masked early exit, hierarchy-cache hits, bucket round-trips."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.core.matrix import SparseMatrix, sparsity_fingerprint
+from amgx_tpu.io.poisson import jittered_poisson_family, poisson_scipy
+from amgx_tpu.serve import DEFAULT_CONFIG, BatchedSolveService
+from amgx_tpu.serve.bucketing import bucket_batch, pad_pattern
+from amgx_tpu.solvers.registry import create_solver, make_nested
+
+pytestmark = pytest.mark.serve
+
+PCG_JACOBI = DEFAULT_CONFIG
+
+PCG_AMG = (
+    '{"config_version": 2, "solver": {"scope": "main", "solver": "PCG",'
+    ' "max_iters": 100, "tolerance": 1e-8, "monitor_residual": 1,'
+    ' "convergence": "RELATIVE_INI",'
+    ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+    ' "algorithm": "AGGREGATION", "selector": "SIZE_8",'
+    ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+    ' "relaxation_factor": 0.8, "monitor_residual": 0},'
+    ' "presweeps": 1, "postsweeps": 1, "max_iters": 1,'
+    ' "min_coarse_rows": 32, "max_levels": 10,'
+    ' "structure_reuse_levels": -1,'
+    ' "coarse_solver": "DENSE_LU_SOLVER", "cycle": "V",'
+    ' "monitor_residual": 0}}}'
+)
+
+
+_poisson_family = jittered_poisson_family
+
+
+def _sequential_reference(cfg_str, systems):
+    cfg = AMGConfig.from_string(cfg_str)
+    refs = []
+    for sp, b in systems:
+        s = make_nested(create_solver(cfg, "default"))
+        s.setup(SparseMatrix.from_scipy(sp))
+        refs.append(s.solve(b))
+    return refs
+
+
+# ---------------------------------------------------------------------
+# fingerprint
+
+
+def test_fingerprint_groups_patterns():
+    sp = poisson_scipy((8, 8)).tocsr()
+    A1 = SparseMatrix.from_scipy(sp)
+    sp2 = sp.copy()
+    sp2.data = sp2.data * 2.0
+    A2 = SparseMatrix.from_scipy(sp2)
+    # same pattern, different values -> same fingerprint
+    assert A1.fingerprint() == A2.fingerprint()
+    # memoized
+    assert A1.fingerprint() is A1.fingerprint()
+    A3 = SparseMatrix.from_scipy(poisson_scipy((8, 9)).tocsr())
+    assert A1.fingerprint() != A3.fingerprint()
+    # raw-array form agrees with the method
+    assert A1.fingerprint() == sparsity_fingerprint(
+        sp.indptr, sp.indices, sp.shape[0], sp.shape[1], 1
+    )
+
+
+# ---------------------------------------------------------------------
+# bucketing
+
+
+def test_bucket_padding_roundtrip():
+    sp = poisson_scipy((9, 7)).tocsr()  # n = 63, deliberately offsize
+    n = sp.shape[0]
+    pat = pad_pattern(sp.indptr, sp.indices, n)
+    assert pat.nb >= n and pat.nnzb >= sp.nnz
+    assert pat.nb & (pat.nb - 1) == 0  # power-of-two bucket
+    vals = pat.embed_values(sp.data)
+    # padded system acts exactly like the original on the real block:
+    Ap = sps.csr_matrix(
+        (vals, pat.col_indices, pat.row_offsets), shape=(pat.nb, pat.nb)
+    )
+    Ap.sum_duplicates()
+    x = np.random.default_rng(0).standard_normal(pat.nb)
+    x[n:] = 0.0
+    y = Ap @ x
+    np.testing.assert_allclose(y[:n], sp @ x[:n], rtol=1e-13)
+    np.testing.assert_allclose(y[n:], 0.0)
+    # identity tail: padded diagonal rows are decoupled unit rows
+    xe = np.zeros(pat.nb)
+    xe[n:] = 1.0
+    np.testing.assert_allclose((Ap @ xe)[n:], 1.0)
+    # vector embedding round-trips
+    b = np.random.default_rng(1).standard_normal(n)
+    be = pat.embed_vector(b, np.float64)
+    np.testing.assert_array_equal(be[:n], b)
+    np.testing.assert_array_equal(be[n:], 0.0)
+
+
+def test_bucket_batch_sizes():
+    assert bucket_batch(1) == 1
+    assert bucket_batch(3) == 4
+    assert bucket_batch(16) == 16
+    assert bucket_batch(17) == 32
+    assert bucket_batch(200) == 256
+
+
+# ---------------------------------------------------------------------
+# batched == sequential
+
+
+def test_batched_matches_sequential_pcg_jacobi():
+    """B=16 pattern-sharing systems through one vmapped call match the
+    16 per-system sequential solves (acceptance criterion)."""
+    systems = _poisson_family((10, 10), 16, seed=0)
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=32)
+    results = svc.solve_many(systems)
+    m = svc.metrics.snapshot()
+    assert m["batches"] == 1  # ONE vmapped call
+    assert m.get("fallback_solves", 0) == 0
+    refs = _sequential_reference(PCG_JACOBI, systems)
+    for r, ref in zip(results, refs):
+        assert int(r.status) == 0
+        assert int(r.iters) == int(ref.iters)
+        np.testing.assert_allclose(
+            np.asarray(r.x), np.asarray(ref.x), rtol=0, atol=1e-12
+        )
+
+
+def test_batched_matches_sequential_amg():
+    """AMG-preconditioned batched groups implement the reference
+    structure-reuse contract: the parity reference is ONE solver set up
+    on the first system with sequential resetup per coefficient set.
+    With a bucket-aligned size (16x16 = 256 rows, zero row padding) the
+    batched results are bit-close with EXACT iterate counts.  (Offsize
+    systems gain an identity padding tail that perturbs coarsening by
+    an iteration or two — the documented pad-waste cost.)"""
+    systems = _poisson_family((16, 16), 8, seed=1, jitter=0.05)
+    svc = BatchedSolveService(config=PCG_AMG, max_batch=16)
+    results = svc.solve_many(systems)
+    assert svc.metrics.get("fallback_solves") == 0
+    cfg = AMGConfig.from_string(PCG_AMG)
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(systems[0][0]))
+    for (sp, b), r in zip(systems, results):
+        s.resetup(SparseMatrix.from_scipy(sp))
+        ref = s.solve(b)
+        assert int(r.status) == 0
+        assert int(r.iters) == int(ref.iters)
+        ref_x = np.asarray(ref.x)
+        err = np.linalg.norm(np.asarray(r.x) - ref_x) / np.linalg.norm(
+            ref_x
+        )
+        assert err < 1e-12
+
+
+def test_heterogeneous_sizes_group_and_solve():
+    """Mixed problem sizes split into per-bucket groups; every system
+    still matches its sequential solve."""
+    systems = (
+        _poisson_family((10, 10), 6, seed=2)
+        + _poisson_family((13, 11), 6, seed=3)
+        + _poisson_family((6, 5), 6, seed=4)
+    )
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=32)
+    results = svc.solve_many(systems)
+    assert svc.metrics.get("batches") == 3  # one per pattern group
+    refs = _sequential_reference(PCG_JACOBI, systems)
+    for r, ref in zip(results, refs):
+        assert int(r.iters) == int(ref.iters)
+        np.testing.assert_allclose(
+            np.asarray(r.x), np.asarray(ref.x), rtol=0, atol=1e-11
+        )
+
+
+def test_masked_early_exit_freezes_converged():
+    """One well-conditioned instance in a batch of hard ones freezes at
+    ITS convergence iterate — identical to solving it alone."""
+    rng = np.random.default_rng(5)
+    n = 64
+    easy = sps.eye_array(n, format="csr") * 2.0
+    easy = easy + sps.random(
+        n, n, density=0.01, random_state=rng, format="csr"
+    ) * 1e-3
+    easy = ((easy + easy.T) * 0.5).tocsr()
+    easy.sort_indices()
+    hard_base = poisson_scipy((8, 8)).tocsr()  # same n = 64
+    systems = [(easy, rng.standard_normal(n))]
+    for _ in range(7):
+        sp = hard_base.copy()
+        sp.data = sp.data * (1.0 + 0.05 * rng.standard_normal(sp.nnz))
+        sp = (sp + sp.T) * 0.5 + sps.eye_array(n) * 0.1
+        sp = sp.tocsr()
+        sp.sort_indices()
+        systems.append((sp, rng.standard_normal(n)))
+    # NOTE: easy and hard share NO pattern -> separate groups; put the
+    # easy one among pattern-sharing hard ones instead by embedding its
+    # values in the hard pattern: use hard pattern with easy-ish values
+    sp0 = hard_base.copy()
+    sp0.data = sp0.data * 1e-3
+    sp0 = (sp0 + sps.eye_array(n) * 4.0).tocsr()  # diagonally dominant
+    sp0.sort_indices()
+    # align pattern: diag already present in poisson pattern
+    systems[0] = (sp0, rng.standard_normal(n))
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=16)
+    results = svc.solve_many(systems)
+    refs = _sequential_reference(PCG_JACOBI, systems)
+    iters = [int(r.iters) for r in results]
+    ref_iters = [int(r.iters) for r in refs]
+    assert iters == ref_iters
+    # the easy instance converged strictly earlier than the batch max
+    assert iters[0] < max(iters)
+    # and froze at its own converged iterate (bitwise-close to solo)
+    np.testing.assert_allclose(
+        np.asarray(results[0].x), np.asarray(refs[0].x),
+        rtol=0, atol=1e-12,
+    )
+    # history past the freeze point stays NaN (no post-convergence
+    # updates leaked in)
+    h = np.asarray(results[0].history)
+    assert np.all(np.isnan(h[iters[0] + 1 :]))
+
+
+# ---------------------------------------------------------------------
+# cache / bucket behaviour
+
+
+def test_cache_hit_on_repeated_fingerprints():
+    """Resubmitting the same sparsity fingerprint: 0 new setups, 0 new
+    XLA compiles (acceptance criterion), verified via counters."""
+    systems = _poisson_family((10, 10), 8, seed=6)
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=16)
+    svc.solve_many(systems)
+    m1 = svc.metrics.snapshot()
+    assert m1["setups"] == 1 and m1["compiles"] == 1
+    # same patterns, new coefficients
+    systems2 = [
+        (sps.csr_matrix((sp.data * 1.01, sp.indices, sp.indptr),
+                        shape=sp.shape), b)
+        for sp, b in systems
+    ]
+    results2 = svc.solve_many(systems2)
+    m2 = svc.metrics.snapshot()
+    assert m2["setups"] == m1["setups"]  # 0 new setups
+    assert m2["compiles"] == m1["compiles"]  # 0 new XLA compiles
+    assert m2["cache_hits"] == m1.get("cache_hits", 0) + 1
+    assert m2["bucket_hits"] == m1.get("bucket_hits", 0) + 1
+    assert all(int(r.status) == 0 for r in results2)
+
+
+def test_bucket_shared_across_patterns():
+    """Two DIFFERENT patterns landing in the same (n, nnz, B) bucket
+    with the same acceleration shape share one compiled executable
+    (template-as-argument design).  Two permutations of one stencil
+    keep the row-length multiset (same ELL width) but scatter the
+    diagonals (so neither takes the DIA path, whose offsets are static
+    metadata and legitimately split the compile cache)."""
+    rng = np.random.default_rng(7)
+    n = 80
+    base = poisson_scipy((8, 10)).tocsr()
+
+    def perm_family(seed):
+        prng = np.random.default_rng(seed)
+        p = prng.permutation(n)
+        pbase = base[p][:, p].tocsr()
+        pbase.sort_indices()
+        out = []
+        for _ in range(4):
+            sp = pbase.copy()
+            sp.data = sp.data * (
+                1.0 + 0.05 * prng.standard_normal(sp.nnz)
+            )
+            sp = (sp + sp.T) * 0.5 + sps.eye_array(n) * 0.5
+            sp = sp.tocsr()
+            sp.sort_indices()
+            out.append((sp, prng.standard_normal(n)))
+        return out
+
+    sys_a = perm_family(13)
+    sys_b = perm_family(14)
+    # same n, same nnz, different sparsity
+    assert sys_a[0][0].nnz == sys_b[0][0].nnz
+    assert (sys_a[0][0].indices != sys_b[0][0].indices).any()
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=4)
+    ra = svc.solve_many(sys_a)
+    m1 = svc.metrics.snapshot()
+    rb = svc.solve_many(sys_b)
+    m2 = svc.metrics.snapshot()
+    assert m2["setups"] == m1["setups"] + 1  # new pattern: new setup...
+    assert m2["compiles"] == m1["compiles"]  # ...but NO new compile
+    assert m2["bucket_hits"] == m1.get("bucket_hits", 0) + 1
+    refs = _sequential_reference(PCG_JACOBI, sys_a + sys_b)
+    for r, ref in zip(ra + rb, refs):
+        np.testing.assert_allclose(
+            np.asarray(r.x), np.asarray(ref.x), rtol=0, atol=1e-11
+        )
+
+
+def test_fallback_for_unbatchable_solver():
+    """A solver without a traced batch path (GMRES) still solves
+    correctly through the sequential fallback, and says so."""
+    gmres_cfg = (
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "GMRES", "max_iters": 150, "gmres_n_restart": 30,'
+        ' "tolerance": 1e-8, "monitor_residual": 1,'
+        ' "convergence": "RELATIVE_INI",'
+        ' "preconditioner": "NOSOLVER"}}'
+    )
+    systems = _poisson_family((7, 7), 3, seed=9)
+    svc = BatchedSolveService(config=gmres_cfg)
+    results = svc.solve_many(systems)
+    assert svc.metrics.get("fallback_solves") == 3
+    for (sp, b), r in zip(systems, results):
+        x = np.asarray(r.x)
+        assert np.linalg.norm(b - sp @ x) < 1e-6 * np.linalg.norm(b)
+
+
+# ---------------------------------------------------------------------
+# dispatcher mechanics
+
+
+def test_max_batch_triggers_flush():
+    systems = _poisson_family((10, 10), 5, seed=10)
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=4)
+    tickets = [svc.submit(sp, b) for sp, b in systems]
+    # 4 submissions hit max_batch and flushed; the 5th is queued
+    assert tickets[3].done() and not tickets[4].done()
+    assert svc.metrics.get("queue_depth") == 1
+    svc.flush()
+    assert tickets[4].done()
+    assert svc.metrics.get("queue_depth") == 0
+
+
+def test_ticket_result_flushes_lazily():
+    (sp, b), = _poisson_family((10, 10), 1, seed=11)
+    svc = BatchedSolveService(config=PCG_JACOBI)
+    t = svc.submit(sp, b)
+    assert not t.done()
+    res = t.result()  # triggers the group flush
+    assert t.done() and int(res.status) == 0
+
+
+def test_capi_solver_solve_batch():
+    from amgx_tpu.api import capi
+
+    capi.initialize()
+    cfg_h = capi.config_create(PCG_JACOBI)
+    res_h = capi.resources_create_simple(cfg_h)
+    slv_h = capi.solver_create(res_h, "dDDI", cfg_h)
+    systems = _poisson_family((10, 10), 4, seed=12)
+    mhs, rhs, shs = [], [], []
+    for sp, b in systems:
+        mh = capi.matrix_create(res_h, "dDDI")
+        capi.matrix_upload_all(
+            mh, sp.shape[0], sp.nnz, 1, 1, sp.indptr, sp.indices, sp.data
+        )
+        rh = capi.vector_create(res_h, "dDDI")
+        capi.vector_upload(rh, b.shape[0], 1, b)
+        sh = capi.vector_create(res_h, "dDDI")
+        capi.vector_set_zero(sh, b.shape[0], 1)
+        mhs.append(mh)
+        rhs.append(rh)
+        shs.append(sh)
+    assert capi.solver_solve_batch(slv_h, mhs, rhs, shs) == capi.RC_OK
+    for i, (sp, b) in enumerate(systems):
+        assert capi.solver_get_batch_status(slv_h, i) == 0
+        assert capi.solver_get_batch_iterations_number(slv_h, i) > 0
+        x = capi.vector_download(shs[i])
+        assert np.linalg.norm(b - sp @ x) < 1e-6 * np.linalg.norm(b)
+    m = capi.solver_get_batch_metrics(slv_h)
+    assert m["batches"] == 1 and m["solved"] == 4
