@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgx_tpu.amg.classical import _hash_weights as _hash_weights_raw
+from amgx_tpu.core.errors import ResourceError
 
 # host seconds spent in tie-break hash generation since the last
 # profile reset: the O(n) numpy hashes run between device kernels and
@@ -412,9 +413,12 @@ def _indptr_from_sorted_rows(rows, n):
                             side="left")
 
 
-class DeviceSetupOverflow(RuntimeError):
+class DeviceSetupOverflow(ResourceError):
     """An ESC SpGEMM expansion exceeds int32 addressing; the caller
-    must fall back to the host (scipy) builder for this level."""
+    must fall back to the host (scipy) builder for this level.  A
+    :class:`~amgx_tpu.core.errors.ResourceError`, so the hierarchy's
+    generalized device→host fallback (amg/hierarchy.py) treats it like
+    every other resource-class device-setup failure."""
 
 
 # ESC expansion entries are addressed with (at most) int32 arithmetic

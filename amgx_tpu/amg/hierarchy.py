@@ -158,22 +158,27 @@ class AMGSolver(Solver):
 
             if device_setup_eligible(self.cfg, self.scope, level_id,
                                      dtype=Asp.dtype):
-                from amgx_tpu.amg.device_setup import (
-                    DeviceSetupOverflow,
-                )
-
                 try:
                     out = build_classical_level_device(
                         Asp, self.cfg, self.scope, level_id
                     )
-                except DeviceSetupOverflow as e:
-                    # Galerkin expansion past int32 addressing: the
-                    # host (scipy int64) builder handles this level
+                except (MemoryError, RuntimeError) as e:
+                    # generalized recovery policy (guardrails):
+                    # resource-class device-pipeline failures — ESC
+                    # expansion past int32 addressing
+                    # (DeviceSetupOverflow is a ResourceError, a
+                    # RuntimeError subclass), XLA compile/execute
+                    # errors (XlaRuntimeError), allocation failures —
+                    # fall back to the host (scipy int64) builder for
+                    # this level.  Programming errors (TypeError,
+                    # IndexError, ...) still raise: a silent host
+                    # fallback would mask device-pipeline regressions.
                     import warnings
 
                     warnings.warn(
-                        f"device setup level {level_id}: {e}; "
-                        "falling back to the host builder"
+                        f"device setup level {level_id}: "
+                        f"{type(e).__name__}: {e}; falling back to "
+                        "the host builder"
                     )
                 else:
                     from amgx_tpu.amg import device_setup

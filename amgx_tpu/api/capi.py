@@ -22,25 +22,29 @@ from amgx_tpu.config.amg_config import AMGConfig, ConfigError
 from amgx_tpu.core.matrix import SparseMatrix
 from amgx_tpu.core.types import mode_from_name
 
-# AMGX_RC codes — exact reference values (amgx_c.h:52-69) so host apps
-# compiled against the reference header interpret codes identically.
-# THRUST_FAILURE / NO_MEMORY are kept as placeholders for ABI parity.
-RC_OK = 0
-RC_BAD_PARAMETERS = 1
-RC_UNKNOWN = 2
-RC_NOT_SUPPORTED_TARGET = 3
-RC_NOT_SUPPORTED_BLOCKSIZE = 4
-RC_CUDA_FAILURE = 5
-RC_THRUST_FAILURE = 6
-RC_NO_MEMORY = 7
-RC_IO_ERROR = 8
-RC_BAD_MODE = 9
-RC_CORE = 10
-RC_PLUGIN = 11
-RC_BAD_CONFIGURATION = 12
-RC_NOT_IMPLEMENTED = 13
-RC_LICENSE_NOT_FOUND = 14
-RC_INTERNAL = 15
+# AMGX_RC codes — exact reference values (amgx_c.h:52-69; single
+# source of truth in core/errors.py so taxonomy exceptions can be
+# minted anywhere without importing this layer).  Re-exported here
+# under their historical names for callers and the native shim.
+from amgx_tpu.core.errors import (  # noqa: F401 — public re-exports
+    RC_OK,
+    RC_BAD_PARAMETERS,
+    RC_UNKNOWN,
+    RC_NOT_SUPPORTED_TARGET,
+    RC_NOT_SUPPORTED_BLOCKSIZE,
+    RC_CUDA_FAILURE,
+    RC_THRUST_FAILURE,
+    RC_NO_MEMORY,
+    RC_IO_ERROR,
+    RC_BAD_MODE,
+    RC_CORE,
+    RC_PLUGIN,
+    RC_BAD_CONFIGURATION,
+    RC_NOT_IMPLEMENTED,
+    RC_LICENSE_NOT_FOUND,
+    RC_INTERNAL,
+    rc_for_exception,
+)
 
 # solve status (reference AMGX_SOLVE_*, amgx_c.h:75-80)
 SOLVE_SUCCESS = 0
@@ -69,6 +73,31 @@ def _traced(fn):
         with trace_range(name):
             return fn(*a, **k)
 
+    return wrap
+
+
+def _rc_guard(fn):
+    """Catch-all exception→RC conversion (reference AMGX_TRIES /
+    AMGX_CATCHES, amgx_c.cu).  Every public entry point is wrapped (see
+    ``_install_rc_guards``) so the only exception type that can reach
+    the embedded native shim is :class:`AMGXError` with a valid ``rc``
+    — never a raw Python traceback.  Taxonomy errors
+    (core/errors.AMGXTPUError) keep their class-specific codes;
+    anything unexpected maps to RC_UNKNOWN."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrap(*a, **k):
+        try:
+            return fn(*a, **k)
+        except AMGXError:
+            raise
+        except Exception as e:
+            raise AMGXError(
+                rc_for_exception(e), f"{type(e).__name__}: {e}"
+            ) from e
+
+    wrap._rc_guarded = True
     return wrap
 
 
@@ -785,10 +814,17 @@ def vector_create(res_h: int, mode: str = "dDDI") -> int:
 
 @_traced
 def vector_upload(vec_h: int, n: int, block_dim: int, data):
+    from amgx_tpu.core import errors as _errors
+
     v = _get(vec_h, _Vector)
-    v.data = np.array(
+    arr = np.array(
         _as_array(data, v.mode.vec_dtype, n * block_dim), copy=True
     )
+    if _errors.validation_enabled():
+        # NaN/Inf right-hand sides fail HERE with a typed error, not
+        # as a FAILED status after a full solve
+        _errors.validate_vector(arr, n * block_dim)
+    v.data = arr
     v.block_dim = block_dim
     return RC_OK
 
@@ -969,12 +1005,19 @@ def solver_setup(slv_h: int, mtx_h: int):
 
 
 def _solve_impl(s, rhs_h, sol_h, zero_guess):
+    from amgx_tpu.core import faults
+
     rhs = _get(rhs_h, _Vector)
     sol = _get(sol_h, _Vector)
     if s.solver is None:
         raise AMGXError(RC_BAD_PARAMETERS, "solver not set up")
     if rhs.data is None:
         raise AMGXError(RC_BAD_PARAMETERS, "rhs not uploaded")
+    if faults.should_fire("capi_internal"):
+        # injected internal error: must surface as a clean RC through
+        # the catch-all (_rc_guard), never a traceback across the .so
+        raise RuntimeError("injected internal error (fault site "
+                           "capi_internal)")
     x0 = None if (zero_guess or sol.data is None) else sol.data
     res = s.solver.solve(
         rhs.data.astype(s.mode.vec_dtype),
@@ -1033,6 +1076,14 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
     solution vectors, per-system status via solver_get_batch_status.
     The first call builds the service from the solver's config; later
     calls reuse its hierarchy/compile caches.
+
+    Fault isolation: a poisoned system (validation reject, setup
+    failure, quarantined solve error) fails ONLY itself — its status
+    reads AMGX_SOLVE_FAILED and its solution vector is left as
+    uploaded — while every other system in the batch completes.  The
+    call returns RC_OK as long as the batch executed; per-system
+    health is the status array, mirroring the reference's per-solve
+    status contract.
     """
     s = _get(slv_h, _SolverHandle)
     mtx_handles = list(mtx_handles)
@@ -1069,10 +1120,55 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
             else sol.data.astype(s.mode.vec_dtype)
         )
         systems.append((A, r.data.astype(s.mode.vec_dtype), x0))
-    results = s.batch_service.solve_many(systems)
-    for res, sh in zip(results, sol_handles):
-        v = _get(sh, _Vector)
-        v.data = np.asarray(res.x, dtype=v.mode.vec_dtype)
+
+    def _failed_result(n, dtype):
+        """Typed per-system failure shell: status FAILED, NaN norms —
+        the batch keeps going (reference: a failed solve is a status,
+        not an API error)."""
+        import jax.numpy as jnp
+
+        from amgx_tpu.solvers.base import FAILED, SolveResult
+
+        rdt = np.dtype(dtype)
+        if rdt.kind == "c":
+            rdt = np.dtype(np.float64 if rdt.itemsize == 16
+                           else np.float32)
+        return SolveResult(
+            x=jnp.zeros((n,), dtype),
+            iters=jnp.int32(0),
+            status=jnp.int32(FAILED),
+            final_norm=jnp.full((1,), np.nan, rdt),
+            initial_norm=jnp.full((1,), np.nan, rdt),
+            history=jnp.full((1, 1), np.nan, rdt),
+        )
+
+    from amgx_tpu.core.errors import AMGXTPUError
+
+    # only TYPED taxonomy failures (validation rejects, setup/solve
+    # guardrail errors) become per-system FAILED statuses; anything
+    # unexpected propagates to _rc_guard so host apps still see a
+    # diagnostic RC instead of a silent RC_OK
+    tickets = []
+    for sys_ in systems:
+        try:
+            tickets.append(s.batch_service.submit(*sys_))
+        except AMGXTPUError:
+            tickets.append(None)  # typed reject: fails only itself
+    s.batch_service.flush()
+    results = []
+    for t, sys_, sh in zip(tickets, systems, sol_handles):
+        n = sys_[0].n_rows * sys_[0].block_size
+        if t is None:
+            results.append(_failed_result(n, s.mode.vec_dtype))
+            continue
+        try:
+            res = t.result()
+        except AMGXTPUError:
+            res = _failed_result(n, s.mode.vec_dtype)
+        else:
+            v = _get(sh, _Vector)
+            v.data = np.asarray(res.x, dtype=v.mode.vec_dtype)
+        results.append(res)
     s.batch_results = results
     s.result = results[-1]
     return RC_OK
@@ -1636,3 +1732,27 @@ def write_system_distributed(
     """Reference AMGX_write_system_distributed: the single-process
     embodiment writes the (consolidated) global system."""
     return write_system(mtx_h, rhs_h, sol_h, filename)
+
+
+# ---------------------------------------------------------------------------
+# catch-all installation: wrap EVERY public entry point with the
+# exception→RC conversion so no Python traceback can cross the
+# native/amgx_tpu_c.c boundary.  Done in one auditable sweep instead of
+# per-function decorators — tests/test_capi.py asserts complete
+# coverage, so a new entry point cannot land unguarded.
+
+
+def _install_rc_guards():
+    import types
+
+    for _name, _obj in list(globals().items()):
+        if (
+            isinstance(_obj, types.FunctionType)
+            and not _name.startswith("_")
+            and _obj.__module__ == __name__
+            and not getattr(_obj, "_rc_guarded", False)
+        ):
+            globals()[_name] = _rc_guard(_obj)
+
+
+_install_rc_guards()

@@ -84,6 +84,20 @@ register("subspace_dim_s", I, 8, "IDR(s) shadow-space dimension")
 # --- coarse / dense ---------------------------------------------------------
 register("dense_lu_num_rows", I, 128, "densify when rows <= this")
 register("dense_lu_max_rows", I, 0, "never densify above this (0: unused)")
+register("dense_lu_zero_pivot", S, "REGULARIZE",
+         "zero/tiny-pivot handling in DENSE_LU factorization: "
+         "REGULARIZE refactorizes with a scaled ridge (degraded but "
+         "convergent coarse solve), RAISE raises SetupError",
+         ("REGULARIZE", "RAISE"))
+
+# --- guardrails (core/errors.py taxonomy, solvers/base.py hooks) -----------
+register("solve_retries", I, 0,
+         "retry a FAILED/DIVERGED solve up to N times with a fresh "
+         "trace, halved relaxation_factor, and zero initial guess "
+         "(recovery hook; 0: off)")
+register("stagnation_window", I, 0,
+         "report DIVERGED when the residual has not decreased over "
+         "this many iterations (stagnation detection; 0: off)")
 
 # --- smoother knobs ---------------------------------------------------------
 register("relaxation_factor", F, 0.9, "solver relaxation factor")

@@ -1,0 +1,155 @@
+"""Deterministic, site-keyed fault injection (guardrail subsystem;
+reference src/tests/smoother_nan_random.cu injects NaN into smoother
+output to exercise the failure paths).
+
+Every recovery path in the library has a named *injection site* that
+can force its failure mode on demand, so the recovery logic is
+testable without hunting for a naturally-broken matrix:
+
+  ====================  ===================================================
+  site                  effect when armed
+  ====================  ===================================================
+  smoother_nan          NaN written into the stationary-iteration update
+                        (solvers/base.py monitored loops, make_smooth)
+  dot_breakdown         the next dot product in a traced solve returns 0
+                        (ops/blas.dot — Krylov rho/alpha breakdown)
+  coarse_lu_zero_pivot  the densified coarse matrix is made exactly
+                        singular before factorization (solvers/dense_lu)
+  serve_compile         the serve layer's compile step raises
+                        ResourceError (serve/service._compiled_fn)
+  capi_internal         an internal RuntimeError inside the C API solve
+                        path (api/capi._solve_impl — catch-all test)
+  ====================  ===================================================
+
+Injection is **budgeted and consumed at trace/setup time**: arming a
+site grants it a fire budget (default 1).  Each *trace* (or host-side
+setup) that passes the site consumes one unit and is corrupted; once
+the budget is spent the site is clean again.  Because solvers rebuild
+their jitted functions when their jit cache is cleared, a
+retry-with-fresh-trace (``solve_retries``) naturally escapes a spent
+fault — which is exactly the recovery contract under test.  No
+wall-clock or RNG dependence: behavior is a pure function of
+(armed sites, call order), so determinism re-runs with injection
+disabled are bit-identical.
+
+Arm programmatically (``arm``/``inject``) or via the environment:
+``AMGX_TPU_FAULTS="smoother_nan,dot_breakdown:2"`` arms sites at first
+use (count after ``:``, default 1, ``-1`` = unlimited).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from collections import defaultdict
+
+SITES = (
+    "smoother_nan",
+    "dot_breakdown",
+    "coarse_lu_zero_pivot",
+    "serve_compile",
+    "capi_internal",
+)
+
+_lock = threading.Lock()
+_armed: dict = {}  # site -> remaining budget (-1 = unlimited)
+_fired: dict = defaultdict(int)  # site -> times fired
+_env_loaded = [False]
+
+
+def _load_env():
+    if _env_loaded[0]:
+        return
+    _env_loaded[0] = True
+    spec = os.environ.get("AMGX_TPU_FAULTS", "")
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        site, _, cnt = item.partition(":")
+        if site not in SITES:
+            # a typo here would arm nothing and let every recovery
+            # check pass vacuously — make it loud
+            import warnings
+
+            warnings.warn(
+                f"AMGX_TPU_FAULTS: unknown fault site {site!r} "
+                f"ignored; known sites: {SITES}"
+            )
+            continue
+        _armed[site] = int(cnt) if cnt else 1
+
+
+def arm(site: str, times: int = 1):
+    """Grant ``site`` a fire budget (``-1`` = unlimited)."""
+    if site not in SITES:
+        raise ValueError(f"unknown fault site {site!r}; known: {SITES}")
+    with _lock:
+        _load_env()
+        _armed[site] = times
+
+
+def disarm(site: str | None = None):
+    """Clear one site's budget, or all of them (``site=None``)."""
+    with _lock:
+        _load_env()
+        if site is None:
+            _armed.clear()
+        else:
+            _armed.pop(site, None)
+
+
+def armed(site: str) -> bool:
+    with _lock:
+        _load_env()
+        return _armed.get(site, 0) != 0
+
+
+def should_fire(site: str) -> bool:
+    """Consume one unit of ``site``'s budget; True when the caller
+    must inject its fault.  Called at trace/setup time — never inside
+    compiled code — so firing is deterministic in call order."""
+    with _lock:
+        _load_env()
+        left = _armed.get(site, 0)
+        if left == 0:
+            return False
+        if left > 0:
+            _armed[site] = left - 1
+        _fired[site] += 1
+        return True
+
+
+def fired(site: str) -> int:
+    """How many times ``site`` has fired since the last reset."""
+    with _lock:
+        return _fired.get(site, 0)
+
+
+def reset_counters():
+    with _lock:
+        _fired.clear()
+
+
+@contextlib.contextmanager
+def inject(site: str, times: int = 1):
+    """``with faults.inject("smoother_nan"):`` — arm for the block,
+    disarm (and forget any unspent budget) on exit."""
+    arm(site, times)
+    try:
+        yield
+    finally:
+        disarm(site)
+
+
+def corrupt_nan(site: str, x):
+    """Trace-time NaN corruption: returns ``x`` with its first element
+    NaN when ``site`` fires, ``x`` unchanged otherwise.  The decision
+    is made while TRACING, so the corruption is baked into that
+    compiled executable and a fresh trace after the budget is spent is
+    clean."""
+    if not should_fire(site):
+        return x
+    idx = (0,) * getattr(x, "ndim", 1)
+    return x.at[idx].set(float("nan"))

@@ -272,6 +272,7 @@ class SparseMatrix:
         partition=None,
         dtype=None,
         accel_formats=("dia", "dense", "ell"),
+        validate=None,
     ) -> "SparseMatrix":
         """Build from host CSR arrays (also the upload path — reference
         AMGX_matrix_upload_all, amgx_c.h:262-279).
@@ -282,6 +283,12 @@ class SparseMatrix:
         ``("dense",)``: the dense structure is the only one whose
         static metadata is pattern-independent, so bucketed matrices
         sharing it also share XLA programs.
+
+        ``validate`` (default: on unless ``AMGX_TPU_VALIDATE=0``) runs
+        the cheap structural/numeric guardrails (core/errors.py):
+        malformed CSR raises ``PatternDegeneracyError``, NaN/Inf
+        coefficients raise ``NonFiniteValuesError`` — typed at the
+        upload boundary instead of a NaN solve status much later.
         """
         row_offsets = np.asarray(row_offsets, dtype=np.int32)
         col_indices = np.asarray(col_indices, dtype=np.int32)
@@ -291,6 +298,15 @@ class SparseMatrix:
         n_rows = row_offsets.shape[0] - 1
         if n_cols is None:
             n_cols = n_rows
+        from amgx_tpu.core import errors as _errors
+
+        if validate is None:
+            validate = _errors.validation_enabled()
+        if validate:
+            _errors.validate_csr(
+                row_offsets, col_indices, values, n_rows, n_cols,
+                block_size=block_size,
+            )
         b = block_size
         if b == 1:
             values = values.reshape(-1)

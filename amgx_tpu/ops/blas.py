@@ -35,7 +35,16 @@ def axmb(A, x, b):
 
 
 def dot(x, y):
-    """<x, y> with complex conjugation on the first argument."""
+    """<x, y> with complex conjugation on the first argument.
+
+    Fault site ``dot_breakdown`` (core/faults.py): when armed, the
+    next dot product traced through here returns exactly 0 — the
+    canonical Krylov breakdown (rho/pq = 0) the divergence/stagnation
+    guardrails and retry hook must recover from."""
+    from amgx_tpu.core import faults
+
+    if faults.should_fire("dot_breakdown"):
+        return jnp.zeros((), jnp.result_type(x, y))
     if jnp.iscomplexobj(x):
         return jnp.vdot(x, y)
     return jnp.dot(x, y)

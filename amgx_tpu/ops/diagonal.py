@@ -38,22 +38,59 @@ def scalarized(A, solver_name: str):
 
 
 def invert_diag(A):
-    """Inverse of the (block) diagonal, host-side at setup."""
+    """Inverse of the (block) diagonal, host-side at setup.
+
+    Block policy (reference zero_in_diagonal_handling semantics,
+    extended to blocks): an exactly-zero diagonal BLOCK scales by the
+    identity, and any block whose inverse is singular/non-finite also
+    falls back to identity — the smoother stays finite on that row
+    instead of spraying inf/NaN through every sweep."""
     d = np.asarray(A.diag)
     if A.block_size == 1:
         with np.errstate(divide="ignore"):
             inv = np.where(d != 0, 1.0 / d, 1.0)
         return jnp.asarray(inv)
-    return jnp.asarray(np.linalg.inv(d))
+    b = A.block_size
+    eye = np.eye(b, dtype=d.dtype)
+    zero = ~d.reshape(d.shape[0], -1).any(axis=1)
+    safe = d.copy()
+    safe[zero] = eye
+    try:
+        inv = np.linalg.inv(safe)
+    except np.linalg.LinAlgError:
+        # some non-zero block is exactly singular: invert per block
+        inv = np.empty_like(safe)
+        for i in range(safe.shape[0]):
+            try:
+                inv[i] = np.linalg.inv(safe[i])
+            except np.linalg.LinAlgError:
+                inv[i] = eye
+    bad = ~np.all(
+        np.isfinite(inv.reshape(inv.shape[0], -1)), axis=1
+    )
+    if bad.any():
+        inv[bad] = eye
+    return jnp.asarray(inv)
 
 
 def invert_diag_jnp(A):
-    """Traced twin of :func:`invert_diag` (same zero-pivot policy) for
-    values-only re-setup inside jit/vmap (serve batched params)."""
+    """Traced twin of :func:`invert_diag` (same zero-pivot / singular-
+    block identity policy) for values-only re-setup inside jit/vmap
+    (serve batched params)."""
     d = A.diag
     if A.block_size == 1:
         return jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 1.0)
-    return jnp.linalg.inv(d)
+    b = A.block_size
+    eye = jnp.eye(b, dtype=d.dtype)
+    zero = ~jnp.any(
+        d.reshape(d.shape[0], -1) != 0, axis=1
+    )
+    safe = jnp.where(zero[:, None, None], eye, d)
+    inv = jnp.linalg.inv(safe)
+    bad = ~jnp.all(
+        jnp.isfinite(inv.reshape(inv.shape[0], -1)), axis=1
+    )
+    return jnp.where(bad[:, None, None], eye, inv)
 
 
 def apply_dinv(dinv, r, block_size):

@@ -8,6 +8,14 @@ an XLA dispatch.  Timings route through the existing profiling hooks
 (:class:`amgx_tpu.core.profiling.LevelProfile` for phase attribution,
 ``trace_range`` for trace spans) so serve activity shows up in the same
 places solver activity already does.
+
+Guardrail counters (fault-isolation paths, serve/service.py):
+``validation_rejects`` (non-finite uploads refused at submit),
+``quarantines`` / ``quarantined_solves`` / ``poisoned_requests``
+(group failure → per-request isolation retry), ``breaker_trips`` /
+``breaker_bypasses`` / ``breakers_open`` (per-fingerprint circuit
+breaker), ``deadline_expired`` (per-ticket deadlines), and
+``failed_groups`` (batched attempts that raised).
 """
 
 from __future__ import annotations

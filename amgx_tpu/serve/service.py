@@ -23,6 +23,14 @@ Solvers without a traced batch path (GMRES, multicolor GS, ...) fall
 back to sequential resetup+solve per request — correct, just not
 amortized; the ``fallback_solves`` counter exposes it.
 
+Fault isolation (guardrails): non-finite uploads are rejected at
+submit() with a typed SetupError; a group that fails as a unit is
+QUARANTINED — every member retries in per-request isolation so only
+the actually-poisoned requests fail; a per-fingerprint circuit breaker
+bypasses batching for patterns that keep failing; optional per-ticket
+deadlines fail late tickets without touching their group.  All of it
+is counted in serve/metrics.py.
+
 Scalar (block_size == 1) systems only for now: block coefficient
 layouts don't survive the nnz-padding embedding.
 """
@@ -128,6 +136,9 @@ class _Request:
     b: np.ndarray  # padded (nb,)
     x0: np.ndarray  # padded (nb,)
     ticket: SolveTicket
+    # optional absolute monotonic deadline; the flusher fails the
+    # ticket with ResourceError when execution starts after it
+    deadline: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -152,6 +163,15 @@ class BatchedSolveService:
         (enforced by poll()/flush(); start() runs a background poller).
     queue_limit: bound on total queued requests; reaching it flushes
         everything (backpressure, never unbounded memory).
+    validate: reject non-finite uploads at submit() with a typed
+        SetupError instead of letting one poisoned request fail (or
+        quarantine) its whole batch group later (``validation_rejects``
+        counter).
+    breaker_threshold: per-fingerprint circuit breaker — after this
+        many consecutive group failures for one pattern, batching is
+        bypassed for that pattern and its requests run in per-request
+        isolation (``breaker_trips`` / ``breaker_bypasses`` counters;
+        a successful batched group resets the count).
     """
 
     def __init__(
@@ -161,6 +181,8 @@ class BatchedSolveService:
         max_wait_s: float = 0.02,
         queue_limit: int = 1024,
         cache_entries: int = 64,
+        validate: bool = True,
+        breaker_threshold: int = 3,
     ):
         if config is None:
             config = DEFAULT_CONFIG
@@ -182,14 +204,42 @@ class BatchedSolveService:
         self._patterns: dict = {}
         self._poller: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self.validate = bool(validate)
+        self.breaker_threshold = int(breaker_threshold)
+        # circuit breaker: padded fingerprint -> consecutive group
+        # failures; fingerprints in _broken bypass batching (with a
+        # periodic half-open probe so transient failures don't cost a
+        # pattern its batching forever)
+        self._fail_counts: dict = {}
+        self._broken: set = set()
+        self._bypass_counts: dict = {}
 
     # ------------------------------------------------------------------
     # submission
 
-    def submit(self, A, b, x0=None) -> SolveTicket:
+    def submit(self, A, b, x0=None, deadline_s=None) -> SolveTicket:
         """Queue one system; returns a ticket.  ``A`` is a SparseMatrix
-        or scipy sparse matrix (scalar block size)."""
+        or scipy sparse matrix (scalar block size).  ``deadline_s``
+        (optional, seconds from now): if the group executes after the
+        deadline, THIS ticket fails with ResourceError while the rest
+        of the group proceeds."""
         ro, ci, vals, n, raw_fp = _host_csr(A)
+        if self.validate:
+            # typed rejection at the door: one poisoned request must
+            # never reach a batch group (guardrails acceptance)
+            from amgx_tpu.core.errors import NonFiniteValuesError
+
+            bad = not np.all(np.isfinite(vals))
+            bad = bad or (b is not None
+                          and not np.all(np.isfinite(np.asarray(b))))
+            bad = bad or (x0 is not None
+                          and not np.all(np.isfinite(np.asarray(x0))))
+            if bad:
+                self.metrics.inc("validation_rejects")
+                raise NonFiniteValuesError(
+                    "BatchedSolveService.submit: system contains "
+                    "NaN/Inf (validation reject)"
+                )
         pattern = self._pattern_for(ro, ci, n, raw_fp)
         dtype = np.dtype(vals.dtype)
         if not np.issubdtype(dtype, np.inexact):
@@ -222,6 +272,11 @@ class BatchedSolveService:
                     b=req_b,
                     x0=req_x0,
                     ticket=ticket,
+                    deadline=(
+                        None
+                        if deadline_s is None
+                        else time.monotonic() + float(deadline_s)
+                    ),
                 )
             )
             self._queued += 1
@@ -418,20 +473,94 @@ class BatchedSolveService:
         bucket — a bucket hit is an XLA compile-cache hit."""
         import jax
 
+        from amgx_tpu.core import faults
+        from amgx_tpu.core.errors import ResourceError
+
         key = (entry.signature, Bb)
         with self._lock:
             fn = self._compiled.get(key)
             if fn is not None:
                 self.metrics.inc("bucket_hits")
                 return fn
+            if faults.should_fire("serve_compile"):
+                raise ResourceError(
+                    "injected serve compile failure (fault site "
+                    "serve_compile)"
+                )
             self.metrics.inc("compiles")
             fn = jax.jit(entry.batch_fn)
             self._compiled[key] = fn
             return fn
 
+    def _expire_deadlines(self, grp: _Group):
+        """Fail (only) the tickets whose deadline already passed; the
+        rest of the group executes normally."""
+        from amgx_tpu.core.errors import ResourceError
+
+        now = time.monotonic()
+        live = []
+        for r in grp.requests:
+            if r.deadline is not None and now > r.deadline:
+                r.ticket._error = ResourceError(
+                    "serve deadline exceeded before execution"
+                )
+                r.ticket._done = True
+                self.metrics.inc("deadline_expired")
+            else:
+                live.append(r)
+        grp.requests = live
+
+    def _breaker_failure(self, fp: str):
+        """Count a group failure; trip the breaker at the threshold."""
+        if self.breaker_threshold <= 0 or fp in self._broken:
+            return
+        with self._lock:
+            n = self._fail_counts.get(fp, 0) + 1
+            self._fail_counts[fp] = n
+            if n >= self.breaker_threshold:
+                self._broken.add(fp)
+                self.metrics.inc("breaker_trips")
+                self.metrics.set_gauge(
+                    "breakers_open", len(self._broken)
+                )
+
+    def _breaker_success(self, fp: str):
+        """A batched group completed: reset the failure count and — if
+        this was a half-open probe — close the breaker."""
+        with self._lock:
+            self._fail_counts.pop(fp, None)
+            if fp in self._broken:
+                self._broken.discard(fp)
+                self._bypass_counts.pop(fp, None)
+                self.metrics.inc("breaker_closes")
+                self.metrics.set_gauge(
+                    "breakers_open", len(self._broken)
+                )
+
+    # every Nth group for an open-breaker pattern retries batching
+    # (half-open probe): success closes the breaker, failure keeps it
+    # open and recounts toward nothing (already open)
+    _BREAKER_PROBE_EVERY = 8
+
     def _execute_group(self, grp: _Group):
         if not grp.requests:
             return
+        self._expire_deadlines(grp)
+        if not grp.requests:
+            return
+        fp = grp.pattern.fingerprint
+        if fp in self._broken:
+            with self._lock:
+                probes = self._bypass_counts.get(fp, 0) + 1
+                self._bypass_counts[fp] = probes
+            if probes % self._BREAKER_PROBE_EVERY != 0:
+                # breaker open: this pattern keeps poisoning its batch
+                # groups — serve its requests in per-request isolation
+                # without attempting a batched execution
+                self.metrics.inc("breaker_bypasses")
+                self._execute_quarantined(grp)
+                return
+            # fall through: half-open probe attempts one batched group
         try:
             entry = self.cache.get_or_build(
                 grp.pattern,
@@ -443,16 +572,56 @@ class BatchedSolveService:
                 self._execute_sequential(entry, grp)
             else:
                 self._execute_batched(entry, grp)
-        except BaseException as e:  # noqa: BLE001 — failures must
-            # reach the tickets, not kill the poller thread (tickets
-            # already completed — e.g. earlier fallback solves — keep
-            # their results)
-            for r in grp.requests:
-                if r.ticket._done:
-                    continue
+        except BaseException:  # noqa: BLE001 — failures must reach the
+            # tickets, not kill the poller thread (tickets already
+            # completed — e.g. earlier fallback solves — keep their
+            # results).  Quarantine: the group failed as a unit (a
+            # poisoned member sabotaged shared setup, or compile/
+            # execute died) — retry every member in isolation so only
+            # the actually-poisoned requests fail.
+            self.metrics.inc("failed_groups")
+            self._breaker_failure(fp)
+            self.metrics.inc("quarantines")
+            self._execute_quarantined(grp)
+        else:
+            self._breaker_success(fp)
+
+    def _execute_quarantined(self, grp: _Group):
+        """Per-request isolation: each request gets its own solver
+        setup on its OWN coefficients (the cached group entry may have
+        been built from a poisoned member), so exactly the poisoned
+        requests fail — with typed errors — and the rest complete."""
+        import amgx_tpu.solvers  # noqa: F401 — registry side effects
+        import amgx_tpu.amg  # noqa: F401 — registers "AMG"
+        from amgx_tpu.solvers.registry import create_solver, make_nested
+
+        pat = grp.pattern
+        for r in grp.requests:
+            if r.ticket._done:
+                continue
+            try:
+                with self.metrics.profile.phase("quarantine"):
+                    A = pat.template_matrix(
+                        pat.extract_values(r.values),
+                        grp.dtype,
+                        accel_formats=self._accel_for(pat),
+                    )
+                    solver = make_nested(
+                        create_solver(self.cfg, "default")
+                    )
+                    solver.setup(A)
+                    res = solver.solve(r.b, x0=r.x0)
+            except BaseException as e:  # noqa: BLE001 — per-request
                 r.ticket._error = e
                 r.ticket._done = True
-            self.metrics.inc("failed_groups")
+                self.metrics.inc("poisoned_requests")
+            else:
+                r.ticket._result = dataclasses.replace(
+                    res, x=res.x[: pat.n]
+                )
+                r.ticket._done = True
+                self.metrics.inc("quarantined_solves")
+                self.metrics.inc("solved")
 
     def _execute_batched(self, entry: HierarchyEntry, grp: _Group):
         import jax.numpy as jnp

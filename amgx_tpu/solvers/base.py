@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from amgx_tpu.core import faults
 from amgx_tpu.core.matrix import SparseMatrix
 from amgx_tpu.core.printing import emit
 from amgx_tpu.core.types import NormType
@@ -112,6 +113,11 @@ class Solver:
         self.convergence_analysis = int(g("convergence_analysis"))
         self.rel_div_tolerance = float(g("rel_div_tolerance"))
         self.alt_rel_tolerance = float(g("alt_rel_tolerance"))
+        # guardrails (core/errors.py taxonomy): stagnation detection
+        # window and the retry-once-with-safer-config recovery hook
+        self.stagnation_window = int(g("stagnation_window"))
+        self.solve_retries = int(g("solve_retries"))
+        self.solve_retries_used = 0
         self.scaling = str(g("scaling"))
         # overwritten to NONE by make_nested: only the outermost solve()
         # boundary may renumber unknowns
@@ -178,7 +184,9 @@ class Solver:
 
                 def body(c):
                     it, x, (r,), nrm, ini, mx, hist, st = c
-                    x = rstep(params, b, x, r)
+                    x = faults.corrupt_nan(
+                        "smoother_nan", rstep(params, b, x, r)
+                    )
                     r = b - spmv(A, x)
                     nrm = norm_of(r)
                     return self._monitor_update(
@@ -201,7 +209,7 @@ class Solver:
 
             def body(c):
                 it, x, extra, nrm, ini, mx, hist, st = c
-                x = step(params, b, x)
+                x = faults.corrupt_nan("smoother_nan", step(params, b, x))
                 nrm = compute_nrm(x)
                 it = it + 1
                 return self._monitor_update(
@@ -236,10 +244,11 @@ class Solver:
             if sweeps <= self._UNROLL_LIMIT:
                 for _ in range(sweeps):
                     x = step(params, b, x)
-                return x
-            return jax.lax.fori_loop(
+                return faults.corrupt_nan("smoother_nan", x)
+            x = jax.lax.fori_loop(
                 0, sweeps, lambda i, x: step(params, b, x), x
             )
+            return faults.corrupt_nan("smoother_nan", x)
 
         return smooth
 
@@ -283,18 +292,42 @@ class Solver:
         if self.rel_div_tolerance > 0:
             div = jnp.any(nrm > self.rel_div_tolerance * nrm_ini)
             status = jnp.where(div, jnp.int32(DIVERGED), status)
+        if self.stagnation_window > 0:
+            # stagnation guardrail: the current residual is no better
+            # than the BEST of the previous w iterations (min over the
+            # window — robust to non-monotone Krylov residuals) —
+            # reported as DIVERGED (the nearest reference status) so
+            # the solve stops early and the retry hook can act
+            w = min(self.stagnation_window, self.max_iters + 1)
+            window = jax.lax.dynamic_slice_in_dim(
+                hist, jnp.maximum(it - w, 0), w, axis=0
+            )
+            best = jnp.min(window, axis=0)
+            stalled = (it >= w) & jnp.all(nrm >= best)
+            status = jnp.where(
+                stalled & (status == NOT_CONVERGED),
+                jnp.int32(DIVERGED),
+                status,
+            )
         status = jnp.where(bad, jnp.int32(FAILED), status)
         return (it, x, extra, nrm, nrm_ini, nrm_max, hist, status)
 
     def _fixed_result(self, x, b, iters) -> SolveResult:
-        """Result shell for unmonitored fixed-iteration solves."""
+        """Result shell for unmonitored fixed-iteration solves.  Even
+        unmonitored solves must never return NaN as SUCCESS (guardrail
+        invariant): one cheap all-finite check derives the status."""
         rdt = jnp.real(b).dtype
         ncomp = self.norm_components
         zero = jnp.zeros((ncomp,), rdt)
+        status = jnp.where(
+            jnp.all(jnp.isfinite(x)),
+            jnp.int32(SUCCESS),
+            jnp.int32(FAILED),
+        )
         return SolveResult(
             x=x,
             iters=jnp.int32(iters),
-            status=jnp.int32(SUCCESS),
+            status=status,
             final_norm=zero,
             initial_norm=zero,
             history=jnp.full((self.max_iters + 1, ncomp), jnp.nan, rdt),
@@ -338,6 +371,14 @@ class Solver:
 
     def setup(self, A: SparseMatrix):
         t0 = time.perf_counter()
+        from amgx_tpu.core import errors as _errors
+
+        if _errors.validation_enabled():
+            # typed setup guardrail: NaN/Inf coefficients fail HERE
+            # with SetupError, not as a NaN status many layers later
+            _errors.validate_operator(
+                A, where=f"{self.registry_name} setup"
+            )
         if self.solver_verbose:
             # reference solver.cu:349: dump the solver settings
             emit(
@@ -456,7 +497,10 @@ class Solver:
             fn = jax.jit(self.make_solve())
             self._jit_cache[key] = fn
         t0 = time.perf_counter()
+        self.solve_retries_used = 0
         res = fn(self.apply_params(), b, x0)
+        if self.solve_retries > 0:
+            res = self._retry_if_failed(res, key, b)
         if self._reorder is not None:
             res = dataclasses.replace(res, x=res.x[self._reorder[1]])
         if self._scale_vecs is not None:
@@ -489,6 +533,43 @@ class Solver:
                     f"    Mem Usage: {mem[0] / 2**30:10.4f} GB in use, "
                     f"peak {mem[1] / 2**30:10.4f} GB"
                 )
+        return res
+
+    # result-status preference order for the retry hook: a retry's
+    # outcome replaces the original only when strictly better
+    _STATUS_RANK = {FAILED: 0, DIVERGED: 1, NOT_CONVERGED: 2, SUCCESS: 3}
+
+    def _retry_if_failed(self, res: SolveResult, key, b) -> SolveResult:
+        """Retry-with-safer-config recovery hook (``solve_retries``).
+
+        A FAILED/DIVERGED solve retries up to ``solve_retries`` times,
+        each attempt evicting the possibly-defective compiled
+        executable (a fresh trace escapes spent fault injections and
+        any trace-level corruption) and restarting from a zero initial
+        guess.  The first retry keeps the configuration — it targets
+        transient/trace corruption; further retries halve the
+        relaxation factor each time (under-relaxation is the classic
+        safer setting for stationary/smoothed iterations) — they
+        target genuine divergence.  The best result by status wins;
+        healthy solves pay only one scalar status sync."""
+        attempt = 0
+        while (
+            attempt < self.solve_retries
+            and int(res.status) in (FAILED, DIVERGED)
+        ):
+            attempt += 1
+            self.solve_retries_used = attempt
+            self._jit_cache.pop(key, None)
+            old_omega = self.relaxation_factor
+            self.relaxation_factor = old_omega * 0.5 ** (attempt - 1)
+            try:
+                fn = jax.jit(self.make_solve())
+            finally:
+                self.relaxation_factor = old_omega
+            retry = fn(self.apply_params(), b, jnp.zeros_like(b))
+            if self._STATUS_RANK.get(int(retry.status), 0) > \
+                    self._STATUS_RANK.get(int(res.status), 0):
+                res = retry
         return res
 
     def _print_stats(self, res: SolveResult):

@@ -5,7 +5,19 @@ TPU form: densify at setup (host), LU-factorize once with
 ``jax.scipy.linalg.lu_factor`` (batched MXU-friendly), apply is a pair of
 triangular solves inside the jitted cycle.  Size guards
 dense_lu_num_rows/dense_lu_max_rows live in the AMG driver (amg.cu:76-85).
-"""
+
+Zero-pivot guardrail: ``jax.scipy.linalg.lu_factor`` does not signal
+singularity — a zero pivot silently propagates NaN into every coarse
+correction (and from there into the whole V-cycle).  Setup therefore
+checks the U diagonal on host; per ``dense_lu_zero_pivot`` policy a
+singular factorization either raises :class:`SingularDiagonalError`
+(RAISE) or switches the coarse solve to the pseudoinverse
+(REGULARIZE): the correction becomes the least-squares solution,
+exact on the range of the coarse operator and zero on its null space
+— a degraded-but-convergent coarse solve, justified by inexact-
+coarse-solver analysis (the outer iteration absorbs a bounded
+coarse-solve perturbation, unlike a ridge whose 1/delta null-space
+response would blow the cycle up)."""
 
 from __future__ import annotations
 
@@ -13,18 +25,79 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from amgx_tpu.core import faults
+from amgx_tpu.core.errors import SingularDiagonalError
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import register_solver
 
 
+def _bad_pivots(lu) -> bool:
+    """Host check of the factorization's U diagonal: exact zeros, NaNs
+    (LAPACK writes NaN past a breakdown), or pivots tiny enough that
+    back-substitution amplifies into overflow."""
+    d = np.abs(np.diag(np.asarray(lu)))
+    if d.size == 0:
+        return False
+    if not np.all(np.isfinite(np.asarray(lu))):
+        return True
+    dmax = float(d.max())
+    if dmax == 0.0:
+        return True
+    tiny = np.finfo(d.dtype).eps * d.shape[0] * dmax
+    return bool(np.any(d <= tiny))
+
+
 @register_solver("DENSE_LU_SOLVER")
 class DenseLUSolver(Solver):
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.zero_pivot_policy = str(
+            cfg.get("dense_lu_zero_pivot", scope)
+        ).upper()
+        self._pinv_mode = False
+
     def _setup_impl(self, A):
-        dense = jnp.asarray(A.to_dense())
-        lu, piv = jax.scipy.linalg.lu_factor(dense)
+        dense = np.asarray(A.to_dense())
+        if faults.should_fire("coarse_lu_zero_pivot"):
+            # injected singularity: zero the last row/column so the
+            # factorization hits an exact zero pivot deterministically
+            dense = dense.copy()
+            dense[-1, :] = 0.0
+            dense[:, -1] = 0.0
+        self._pinv_mode = False
+        lu, piv = jax.scipy.linalg.lu_factor(jnp.asarray(dense))
+        if _bad_pivots(lu):
+            if self.zero_pivot_policy == "RAISE":
+                raise SingularDiagonalError(
+                    f"DENSE_LU: singular coarse matrix "
+                    f"({A.n_rows} rows): zero/tiny pivot in LU"
+                )
+            # REGULARIZE: least-squares coarse solve via the
+            # pseudoinverse (exact on the range, zero on the null
+            # space); the apply becomes one dense matvec
+            import warnings
+
+            warnings.warn(
+                f"DENSE_LU: singular coarse matrix ({A.n_rows} rows); "
+                "switching to pseudoinverse coarse solve "
+                "(dense_lu_zero_pivot=REGULARIZE)"
+            )
+            self._pinv_mode = True
+            pinv = np.linalg.pinv(dense)
+            if not np.all(np.isfinite(pinv)):
+                raise SingularDiagonalError(
+                    f"DENSE_LU: pseudoinverse of the coarse matrix "
+                    f"({A.n_rows} rows) is non-finite"
+                )
+            self._params = (A, jnp.asarray(pinv), piv)
+            return
         self._params = (A, lu, piv)
 
     def make_batch_params(self):
+        if self._pinv_mode:
+            # the traced rebuild refactorizes with plain LU, which is
+            # exactly what just failed — no batch fast path
+            return None
         A0 = self._params[0]
         if A0.block_size != 1:
             return None
@@ -45,6 +118,14 @@ class DenseLUSolver(Solver):
         return A0, fn
 
     def make_apply(self):
+        if self._pinv_mode:
+
+            def apply_pinv(params, r):
+                _, pinv, _ = params
+                return pinv @ r
+
+            return apply_pinv
+
         def apply(params, r):
             _, lu, piv = params
             return jax.scipy.linalg.lu_solve((lu, piv), r)

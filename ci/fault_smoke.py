@@ -1,0 +1,258 @@
+"""Fault-injection smoke matrix (guardrails CI).
+
+Runs every injection site (core/faults.py) against its recovery path
+on the CPU backend and emits ONE JSON line per site:
+
+    {"site": "smoother_nan", "ok": true, "detail": "..."}
+
+Pass condition per site: the solve either RECOVERS (SUCCESS via the
+fallback/retry policy) or fails with the correct typed error / status
+— never a silent NaN result.  A final "baseline" line re-runs with
+every site disarmed and asserts determinism (two identical solves).
+
+Exit code is the number of failing sites, so ci/test.sh turns any
+recovery-path regression into a CI failure, and the JSON lines are
+grep-able from the bench trajectory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import warnings
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import amgx_tpu  # noqa: E402
+
+amgx_tpu.initialize()
+
+from amgx_tpu.config.amg_config import AMGConfig  # noqa: E402
+from amgx_tpu.core import faults  # noqa: E402
+from amgx_tpu.core.errors import AMGXTPUError  # noqa: E402
+from amgx_tpu.io.poisson import poisson_2d_5pt, poisson_scipy  # noqa: E402
+from amgx_tpu.solvers import create_solver  # noqa: E402
+from amgx_tpu.solvers.base import DIVERGED, SUCCESS  # noqa: E402
+
+JACOBI_RETRY = (
+    '{"config_version": 2, "solver": {"scope": "m",'
+    ' "solver": "BLOCK_JACOBI", "monitor_residual": 1,'
+    ' "tolerance": 1e-6, "convergence": "RELATIVE_INI",'
+    ' "max_iters": 800, "relaxation_factor": 0.9,'
+    ' "solve_retries": 1}}'
+)
+PCG_STAG = (
+    '{"config_version": 2, "solver": {"scope": "m", "solver": "PCG",'
+    ' "monitor_residual": 1, "tolerance": 1e-8,'
+    ' "convergence": "RELATIVE_INI", "max_iters": 100,'
+    ' "stagnation_window": 5,'
+    ' "preconditioner": {"scope": "j", "solver": "BLOCK_JACOBI",'
+    ' "max_iters": 2, "monitor_residual": 0}}}'
+)
+PCG_AMG_LU = (
+    '{"config_version": 2, "solver": {"scope": "m", "solver": "PCG",'
+    ' "max_iters": 100, "tolerance": 1e-6, "monitor_residual": 1,'
+    ' "convergence": "RELATIVE_INI",'
+    ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+    ' "algorithm": "AGGREGATION", "selector": "SIZE_2",'
+    ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+    ' "monitor_residual": 0},'
+    ' "coarse_solver": "DENSE_LU_SOLVER", "min_coarse_rows": 16,'
+    ' "max_iters": 1, "monitor_residual": 0}}}'
+)
+
+
+def _fresh(cfg_text, A):
+    s = create_solver(AMGConfig.from_string(cfg_text), "default")
+    s.setup(A)
+    return s
+
+
+def site_smoother_nan():
+    """NaN in smoother output recovers via the retry policy."""
+    A = poisson_2d_5pt(8)
+    b = np.ones(A.n_rows)
+    s = _fresh(JACOBI_RETRY, A)
+    with faults.inject("smoother_nan", times=1):
+        res = s.solve(b)
+    ok = (
+        int(res.status) == SUCCESS
+        and s.solve_retries_used == 1
+        and bool(np.all(np.isfinite(np.asarray(res.x))))
+    )
+    return ok, (
+        f"status={int(res.status)} retries={s.solve_retries_used}"
+    )
+
+
+def site_dot_breakdown():
+    """Permanent dot breakdown is detected as stagnation (DIVERGED),
+    finite result — never NaN-as-SUCCESS."""
+    A = poisson_2d_5pt(8)
+    b = np.ones(A.n_rows)
+    s = _fresh(PCG_STAG, A)
+    with faults.inject("dot_breakdown", times=-1):
+        res = s.solve(b)
+    ok = int(res.status) == DIVERGED and bool(
+        np.all(np.isfinite(np.asarray(res.x)))
+    )
+    return ok, f"status={int(res.status)} iters={int(res.iters)}"
+
+
+def site_coarse_lu_zero_pivot():
+    """Singular coarse LU falls back to the pseudoinverse coarse
+    solve; the outer PCG still converges."""
+    A = poisson_2d_5pt(16)
+    b = np.ones(A.n_rows)
+    s = create_solver(AMGConfig.from_string(PCG_AMG_LU), "default")
+    with faults.inject("coarse_lu_zero_pivot", times=1):
+        s.setup(A)
+    res = s.solve(b)
+    ok = int(res.status) == SUCCESS and bool(
+        np.all(np.isfinite(np.asarray(res.x)))
+    )
+    return ok, f"status={int(res.status)} iters={int(res.iters)}"
+
+
+def site_serve_compile():
+    """Serve compile failure quarantines; every request completes."""
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(0)
+    svc = BatchedSolveService(max_batch=2)
+    b1, b2 = rng.standard_normal(n), rng.standard_normal(n)
+    with faults.inject("serve_compile", times=1):
+        t1 = svc.submit(sp, b1)
+        t2 = svc.submit(sp, b2)
+        svc.flush()
+    oks = []
+    for t, b in ((t1, b1), (t2, b2)):
+        res = t.result()
+        rel = np.linalg.norm(sp @ np.asarray(res.x) - b) / max(
+            np.linalg.norm(b), 1e-300
+        )
+        oks.append(int(res.status) == SUCCESS and rel < 1e-6)
+    ok = all(oks) and svc.metrics.get("quarantines") == 1
+    return ok, (
+        f"quarantines={svc.metrics.get('quarantines')} "
+        f"solved={svc.metrics.get('solved')}"
+    )
+
+
+def site_serve_poisoned_request():
+    """A batch with one poisoned member completes everyone else and
+    fails exactly the poisoned one (typed)."""
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(1)
+    svc = BatchedSolveService(max_batch=4, validate=False)
+    bad = sp.copy()
+    bad.data = bad.data.copy()
+    bad.data[0] = np.nan
+    t_bad = svc.submit(bad, np.ones(n))
+    good = []
+    for _ in range(3):
+        b = rng.standard_normal(n)
+        good.append((b, svc.submit(sp, b)))
+    svc.flush()
+    try:
+        t_bad.result()
+        poisoned_typed = False
+    except AMGXTPUError:
+        poisoned_typed = True
+    healthy_ok = all(
+        int(t.result().status) == SUCCESS
+        and np.linalg.norm(sp @ np.asarray(t.result().x) - b)
+        / np.linalg.norm(b) < 1e-6
+        for b, t in good
+    )
+    ok = poisoned_typed and healthy_ok
+    return ok, (
+        f"poisoned_typed={poisoned_typed} "
+        f"quarantined_solves={svc.metrics.get('quarantined_solves')}"
+    )
+
+
+def site_capi_internal():
+    """Forced internal error through AMGX_solver_solve yields a clean
+    RC_UNKNOWN AMGXError (never a raw traceback type)."""
+    from amgx_tpu.api import capi
+
+    capi.initialize()
+    cfg = capi.config_create(PCG_STAG)
+    res_h = capi.resources_create_simple(cfg)
+    sp = poisson_scipy((8, 8)).tocsr()
+    sp.sort_indices()
+    m = capi.matrix_create(res_h)
+    capi.matrix_upload_all(
+        m, sp.shape[0], sp.nnz, 1, 1,
+        sp.indptr.astype(np.int32), sp.indices.astype(np.int32),
+        sp.data,
+    )
+    r = capi.vector_create(res_h)
+    capi.vector_upload(r, sp.shape[0], 1, np.ones(sp.shape[0]))
+    x = capi.vector_create(res_h)
+    capi.vector_set_zero(x, sp.shape[0], 1)
+    slv = capi.solver_create(res_h, "dDDI", cfg)
+    capi.solver_setup(slv, m)
+    with faults.inject("capi_internal", times=1):
+        try:
+            capi.solver_solve(slv, r, x)
+            return False, "no error raised"
+        except capi.AMGXError as e:
+            clean_rc = e.rc == capi.RC_UNKNOWN
+    rc_after = capi.solver_solve(slv, r, x)
+    ok = clean_rc and rc_after == capi.RC_OK
+    return ok, f"rc_clean={clean_rc} rc_after={rc_after}"
+
+
+def baseline_determinism():
+    """All sites disarmed: two fresh solves are bit-identical."""
+    faults.disarm()
+    A = poisson_2d_5pt(10)
+    b = np.ones(A.n_rows)
+    xs = [np.asarray(_fresh(PCG_STAG, A).solve(b).x) for _ in range(2)]
+    ok = bool(np.array_equal(xs[0], xs[1]))
+    return ok, "bit-identical re-run"
+
+
+MATRIX = [
+    ("smoother_nan", site_smoother_nan),
+    ("dot_breakdown", site_dot_breakdown),
+    ("coarse_lu_zero_pivot", site_coarse_lu_zero_pivot),
+    ("serve_compile", site_serve_compile),
+    ("serve_poisoned_request", site_serve_poisoned_request),
+    ("capi_internal", site_capi_internal),
+    ("baseline_determinism", baseline_determinism),
+]
+
+
+def main() -> int:
+    failures = 0
+    for name, fn in MATRIX:
+        faults.disarm()
+        faults.reset_counters()
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ok, detail = fn()
+        except Exception as e:  # a site harness crash is a failure
+            ok, detail = False, f"{type(e).__name__}: {e}"
+        failures += 0 if ok else 1
+        print(json.dumps({"site": name, "ok": ok, "detail": detail}),
+              flush=True)
+    return failures
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,11 @@ cd "$(dirname "$0")/.."
 python -m pytest tests/ -q
 python -m pytest tests/ -q -m slow
 
+# ---- guardrails: fault-injection matrix ------------------------------
+# One JSON line of pass/fail per injection site (CPU backend); a
+# recovery-path regression fails CI here before the bench runs.
+JAX_PLATFORMS=cpu python ci/fault_smoke.py
+
 # ---- native C ABI (VERDICT r4 #9) -----------------------------------
 # Build from source and run both demos on CPU; assert exit 0 and the
 # expected iteration count from the reference README sample (1 iter).

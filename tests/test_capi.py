@@ -486,3 +486,132 @@ def test_capi_partial_upload_trailing_empty_rank():
     m = capi._get(A, capi._Matrix)
     assert m.pending_parts is None  # no stale accumulation
     assert (m.global_sp != sp).nnz == 0
+
+
+# ---------------------------------------------------------------------------
+# guardrails: exception→RC boundary (no Python traceback may cross the
+# native/amgx_tpu_c.c boundary)
+
+
+def test_internal_error_yields_clean_rc():
+    """A forced internal error inside AMGX_solver_solve must surface as
+    AMGXError with a valid RC (the native shim converts .rc to a return
+    code) — never an arbitrary exception type."""
+    from amgx_tpu.core import faults
+
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    A, sp = _upload_poisson(res)
+    n = sp.shape[0]
+    b = capi.vector_create(res, "dDDI")
+    x = capi.vector_create(res, "dDDI")
+    capi.vector_upload(b, n, 1, np.ones(n))
+    capi.vector_set_zero(x, n, 1)
+    slv = capi.solver_create(res, "dDDI", cfg)
+    capi.solver_setup(slv, A)
+    with faults.inject("capi_internal", times=1):
+        with pytest.raises(capi.AMGXError) as ei:
+            capi.solver_solve(slv, b, x)
+    assert ei.value.rc == capi.RC_UNKNOWN
+    # the handle is still usable: the failure did not corrupt state
+    assert capi.solver_solve(slv, b, x) == capi.RC_OK
+    assert capi.solver_get_status(slv) == capi.SOLVE_SUCCESS
+
+
+def test_all_entry_points_rc_guarded():
+    """Audit: every public function in the C API module carries the
+    catch-all exception→RC wrapper, so a new entry point cannot land
+    unguarded."""
+    import types
+
+    unguarded = [
+        name
+        for name, obj in vars(capi).items()
+        if isinstance(obj, types.FunctionType)
+        and not name.startswith("_")
+        and obj.__module__ == capi.__name__
+        and not getattr(obj, "_rc_guarded", False)
+    ]
+    assert not unguarded, f"unguarded C API entry points: {unguarded}"
+
+
+def test_typed_errors_keep_their_rc():
+    """Taxonomy errors crossing an entry point keep their class RC
+    (SetupError family → RC_CORE / RC_BAD_PARAMETERS), and plain bad
+    handles still map to RC_BAD_PARAMETERS."""
+    from amgx_tpu.core.errors import rc_for_exception
+
+    with pytest.raises(capi.AMGXError) as ei:
+        capi.vector_download(999999)
+    assert ei.value.rc == capi.RC_BAD_PARAMETERS
+    # non-finite upload: typed NonFiniteValuesError → RC_CORE
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    A = capi.matrix_create(res, "dDDI")
+    bad = np.array([np.nan, 1.0, 1.0])
+    with pytest.raises(capi.AMGXError) as ei:
+        capi.matrix_upload_all(
+            A, 2, 3, 1, 1,
+            np.array([0, 2, 3], np.int32),
+            np.array([0, 1, 1], np.int32),
+            bad,
+        )
+    assert ei.value.rc == capi.RC_CORE
+    # mapping helper sanity
+    assert rc_for_exception(MemoryError()) == capi.RC_NO_MEMORY
+    assert rc_for_exception(KeyError("x")) == capi.RC_BAD_CONFIGURATION
+
+
+def test_batch_poisoned_request_fails_only_itself():
+    """solver_solve_batch with one NaN-poisoned system: the batch
+    completes, the poisoned index reads SOLVE_FAILED, every other
+    system solves to SUCCESS."""
+    import warnings
+
+    cfg = capi.config_create(CFG)
+    res = capi.resources_create_simple(cfg)
+    n_side = 8
+    n = n_side * n_side
+    sp = poisson_scipy((n_side, n_side)).tocsr()
+    sp.sort_indices()
+    mtxs, rhss, sols = [], [], []
+    rng = np.random.default_rng(3)
+    for i in range(3):
+        data = sp.data.copy()
+        if i == 1:
+            data[0] = np.nan  # poisoned
+        m = capi.matrix_create(res, "dDDI")
+        # bypass upload validation so the poison reaches the batch
+        # (the serve layer's own guardrails must isolate it)
+        import os
+
+        os.environ["AMGX_TPU_VALIDATE"] = "0"
+        try:
+            capi.matrix_upload_all(
+                m, n, sp.nnz, 1, 1,
+                sp.indptr.astype(np.int32),
+                sp.indices.astype(np.int32),
+                data,
+            )
+        finally:
+            del os.environ["AMGX_TPU_VALIDATE"]
+        r = capi.vector_create(res, "dDDI")
+        capi.vector_upload(r, n, 1, rng.standard_normal(n))
+        x = capi.vector_create(res, "dDDI")
+        capi.vector_set_zero(x, n, 1)
+        mtxs.append(m)
+        rhss.append(r)
+        sols.append(x)
+    slv = capi.solver_create(res, "dDDI", cfg)
+    capi.solver_setup(slv, mtxs[0])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert capi.solver_solve_batch(slv, mtxs, rhss, sols) == capi.RC_OK
+    statuses = [
+        capi.solver_get_batch_status(slv, i) for i in range(3)
+    ]
+    assert statuses[1] == capi.SOLVE_FAILED
+    assert statuses[0] == capi.SOLVE_SUCCESS
+    assert statuses[2] == capi.SOLVE_SUCCESS
+    x0 = capi.vector_download(sols[0])
+    assert np.all(np.isfinite(x0))

@@ -34,13 +34,13 @@ JACOBI_CFG = (
 
 def test_zero_in_diagonal_no_crash():
     """Zero diagonal entries must not produce inf/nan in smoother setup
-    (reference zero_in_diagonal_handling.cu)."""
+    (reference zero_in_diagonal_handling.cu): the zero pivot scales by
+    identity, so the sweep stays FINITE — not merely status-honest."""
     sp = poisson_2d_5pt(8).to_scipy().tolil()
     sp[3, 3] = 0.0
     A = SparseMatrix.from_scipy(sp.tocsr())
     b = np.ones(A.n_rows)
     s, res = _solve(JACOBI_CFG, A, b)
-    # may not converge, but never NaN silently: status reflects reality
     from amgx_tpu.solvers.base import (
         DIVERGED,
         FAILED,
@@ -49,10 +49,52 @@ def test_zero_in_diagonal_no_crash():
     )
 
     assert int(res.status) in (SUCCESS, FAILED, DIVERGED, NOT_CONVERGED)
-    # the solver detected the failure rather than propagating NaN as
-    # "success"
-    if not np.all(np.isfinite(np.asarray(res.x))):
-        assert int(res.status) == FAILED
+    # identity scaling of the zero pivot keeps every sweep finite
+    assert np.all(np.isfinite(np.asarray(res.x)))
+    assert int(res.status) != FAILED
+
+
+def test_zero_diagonal_block_identity_scaling():
+    """An exactly-zero diagonal BLOCK scales by identity (reference
+    zero_in_diagonal_handling.cu semantics extended to blocks): the
+    inverted block diagonal is finite and the zero block's slot is the
+    identity."""
+    import scipy.sparse as sps
+
+    from amgx_tpu.ops.diagonal import invert_diag, invert_diag_jnp
+
+    rng = np.random.default_rng(0)
+    n_blocks, b = 6, 2
+    dense = np.kron(np.eye(n_blocks), np.ones((b, b))) * 0.0
+    blocks = []
+    for i in range(n_blocks):
+        blk = rng.standard_normal((b, b)) + 3 * np.eye(b)
+        blocks.append(blk)
+    blocks[2] = np.zeros((b, b))  # exactly-zero diagonal block
+    dense = np.zeros((n_blocks * b, n_blocks * b))
+    for i, blk in enumerate(blocks):
+        dense[i * b:(i + 1) * b, i * b:(i + 1) * b] = blk
+    A = SparseMatrix.from_scipy(sps.csr_matrix(dense), block_size=b)
+    for inv_fn in (invert_diag, invert_diag_jnp):
+        dinv = np.asarray(inv_fn(A))
+        assert np.all(np.isfinite(dinv))
+        np.testing.assert_allclose(dinv[2], np.eye(b))
+        # healthy blocks invert exactly
+        np.testing.assert_allclose(
+            dinv[0] @ blocks[0], np.eye(b), atol=1e-12
+        )
+
+
+def test_l1_jacobi_zero_row_identity():
+    """JACOBI_L1 with an all-zero row: d_i = 0 takes the identity
+    reciprocal, the sweep stays finite."""
+    sp = poisson_2d_5pt(6).to_scipy().tolil()
+    sp[7, :] = 0.0
+    A = SparseMatrix.from_scipy(sp.tocsr())
+    b = np.ones(A.n_rows)
+    cfg_text = JACOBI_CFG.replace("BLOCK_JACOBI", "JACOBI_L1")
+    s, res = _solve(cfg_text, A, b)
+    assert np.all(np.isfinite(np.asarray(res.x)))
 
 
 def test_zero_off_diagonal_rows():
@@ -147,3 +189,337 @@ def test_coloring_validity_random():
         assert validate_coloring(
             np.asarray(A.row_offsets), np.asarray(A.col_indices), colors
         )
+
+
+# ---------------------------------------------------------------------------
+# guardrails: typed taxonomy, fault injection, recovery policies
+# (core/errors.py, core/faults.py; reference smoother_nan_random.cu)
+
+
+RETRY_JACOBI_CFG = (
+    '{"config_version": 2, "solver": {"scope": "m",'
+    ' "solver": "BLOCK_JACOBI", "monitor_residual": 1,'
+    ' "tolerance": 1e-6, "convergence": "RELATIVE_INI",'
+    ' "max_iters": 800, "relaxation_factor": 0.9,'
+    ' "solve_retries": 1}}'
+)
+
+PCG_STAG_CFG = (
+    '{"config_version": 2, "solver": {"scope": "m", "solver": "PCG",'
+    ' "monitor_residual": 1, "tolerance": 1e-8,'
+    ' "convergence": "RELATIVE_INI", "max_iters": 100,'
+    ' "stagnation_window": 5,'
+    ' "preconditioner": {"scope": "j", "solver": "BLOCK_JACOBI",'
+    ' "max_iters": 2, "monitor_residual": 0}}}'
+)
+
+
+def test_upload_validation_typed_errors():
+    """from_csr guardrails: NaN values and malformed CSR raise typed
+    SetupError subclasses carrying their RC codes."""
+    from amgx_tpu.core.errors import (
+        RC_BAD_PARAMETERS,
+        RC_CORE,
+        NonFiniteValuesError,
+        PatternDegeneracyError,
+    )
+
+    sp = poisson_2d_5pt(6).to_scipy().tocsr()
+    bad = sp.copy()
+    bad.data = bad.data.copy()
+    bad.data[0] = np.inf
+    with pytest.raises(NonFiniteValuesError) as ei:
+        SparseMatrix.from_scipy(bad)
+    assert ei.value.rc == RC_CORE
+    with pytest.raises(PatternDegeneracyError) as ei:
+        SparseMatrix.from_csr(
+            np.array([0, 2, 1], np.int32),  # non-monotone
+            np.array([0, 1], np.int32),
+            np.array([1.0, 1.0]),
+        )
+    assert ei.value.rc == RC_BAD_PARAMETERS
+    with pytest.raises(PatternDegeneracyError):
+        SparseMatrix.from_csr(
+            np.array([0, 1, 2], np.int32),
+            np.array([0, 7], np.int32),  # column out of range
+            np.array([1.0, 1.0]),
+        )
+
+
+def test_setup_rejects_nonfinite_operator():
+    """Solver.setup on a NaN operator fails with SetupError, not a NaN
+    solve status later (validation can be bypassed for injection)."""
+    import os
+
+    from amgx_tpu.core.errors import SetupError
+
+    sp = poisson_2d_5pt(6).to_scipy().tocsr()
+    sp.data = sp.data.copy()
+    sp.data[3] = np.nan
+    os.environ["AMGX_TPU_VALIDATE"] = "0"
+    try:
+        A = SparseMatrix.from_scipy(sp)
+    finally:
+        del os.environ["AMGX_TPU_VALIDATE"]
+    cfg = AMGConfig.from_string(JACOBI_CFG)
+    s = create_solver(cfg, "default")
+    with pytest.raises(SetupError):
+        s.setup(A)
+
+
+def test_smoother_nan_recovers_via_retry():
+    """Fault site smoother_nan: the first solve's trace is corrupted
+    (status FAILED without the policy); with solve_retries=1 the retry
+    re-traces cleanly and converges (reference smoother_nan_random.cu
+    + the recovery hook)."""
+    from amgx_tpu.core import faults
+
+    A = poisson_2d_5pt(8)
+    b = np.ones(A.n_rows)
+    cfg = AMGConfig.from_string(RETRY_JACOBI_CFG)
+    s = create_solver(cfg, "default")
+    s.setup(A)
+    with faults.inject("smoother_nan", times=1):
+        res = s.solve(b)
+    assert faults.fired("smoother_nan") >= 1
+    assert s.solve_retries_used == 1
+    assert int(res.status) == 0
+    assert np.all(np.isfinite(np.asarray(res.x)))
+    # no-retry control: the same fault is a detected FAILED, never a
+    # silent NaN-as-SUCCESS
+    s2 = create_solver(
+        AMGConfig.from_string(
+            RETRY_JACOBI_CFG.replace('"solve_retries": 1',
+                                     '"solve_retries": 0')
+        ),
+        "default",
+    )
+    s2.setup(A)
+    with faults.inject("smoother_nan", times=1):
+        res2 = s2.solve(b)
+    from amgx_tpu.solvers.base import FAILED
+
+    assert int(res2.status) == FAILED
+
+
+def test_dot_breakdown_stagnation_detected():
+    """Fault site dot_breakdown (armed unlimited): PCG makes no
+    progress; the stagnation window reports DIVERGED — finite result,
+    typed status, never NaN-as-SUCCESS."""
+    from amgx_tpu.core import faults
+    from amgx_tpu.solvers.base import DIVERGED, SUCCESS
+
+    A = poisson_2d_5pt(8)
+    b = np.ones(A.n_rows)
+    s = create_solver(AMGConfig.from_string(PCG_STAG_CFG), "default")
+    s.setup(A)
+    with faults.inject("dot_breakdown", times=-1):
+        res = s.solve(b)
+    assert int(res.status) == DIVERGED
+    assert int(res.iters) <= 10  # stopped at the window, not max_iters
+    assert np.all(np.isfinite(np.asarray(res.x)))
+    # disarmed: same solver solves cleanly (fresh instance, fresh trace)
+    s3 = create_solver(AMGConfig.from_string(PCG_STAG_CFG), "default")
+    s3.setup(A)
+    assert int(s3.solve(b).status) == SUCCESS
+
+
+def test_coarse_lu_zero_pivot_policies():
+    """Fault site coarse_lu_zero_pivot: REGULARIZE switches the coarse
+    solve to the pseudoinverse and the outer PCG still converges;
+    RAISE surfaces SingularDiagonalError at setup."""
+    import warnings
+
+    from amgx_tpu.core import faults
+    from amgx_tpu.core.errors import SingularDiagonalError
+
+    amg = (
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG", "max_iters": 100, "tolerance": 1e-6,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+        ' "algorithm": "AGGREGATION", "selector": "SIZE_2",'
+        ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+        ' "monitor_residual": 0},'
+        ' "coarse_solver": "DENSE_LU_SOLVER", "min_coarse_rows": 16,'
+        ' "max_iters": 1, "monitor_residual": 0%s}}}'
+    )
+    A = poisson_2d_5pt(16)
+    b = np.ones(A.n_rows)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = create_solver(AMGConfig.from_string(amg % ""), "default")
+        with faults.inject("coarse_lu_zero_pivot", times=1):
+            s.setup(A)
+        res = s.solve(b)
+    assert int(res.status) == 0
+    assert np.all(np.isfinite(np.asarray(res.x)))
+    raise_cfg = amg % ', "dense_lu_zero_pivot": "RAISE"'
+    s2 = create_solver(AMGConfig.from_string(raise_cfg), "default")
+    with pytest.raises(SingularDiagonalError):
+        with faults.inject("coarse_lu_zero_pivot", times=1):
+            s2.setup(A)
+
+
+def test_injection_disabled_determinism():
+    """Determinism re-run: with every fault disarmed, two fresh solves
+    are bit-identical (injection leaves no residue — reference
+    determinism_checker.h under the guardrail subsystem)."""
+    from amgx_tpu.core import faults
+
+    faults.disarm()
+    A = poisson_2d_5pt(10)
+    b = poisson_rhs(A.n_rows)
+    xs = []
+    for _ in range(2):
+        s = create_solver(
+            AMGConfig.from_string(PCG_STAG_CFG), "default"
+        )
+        s.setup(A)
+        xs.append(np.asarray(s.solve(b).x))
+    np.testing.assert_array_equal(xs[0], xs[1])
+
+
+# ---------------------------------------------------------------------------
+# serve-layer fault isolation (amgx_tpu.serve guardrails)
+
+
+def _poisson_csr(n_side=8):
+    return poisson_2d_5pt(n_side).to_scipy().tocsr()
+
+
+def test_serve_quarantine_isolates_poisoned_request():
+    """A batch whose FIRST request is poisoned (NaN values poison the
+    shared hierarchy build) quarantines: the poisoned ticket fails
+    with a typed error, every other request completes with a correct
+    solution."""
+    import warnings
+
+    from amgx_tpu.core.errors import AMGXTPUError
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = _poisson_csr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(0)
+    svc = BatchedSolveService(max_batch=4, validate=False)
+    bad = sp.copy()
+    bad.data = bad.data.copy()
+    bad.data[5] = np.nan
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        tickets = [svc.submit(bad, np.ones(n))]
+        systems = []
+        for i in range(3):
+            good = sp.copy()
+            good.data = good.data * (1.0 + 0.1 * i)
+            b = rng.standard_normal(n)
+            systems.append((good, b))
+            tickets.append(svc.submit(good, b))
+        svc.flush()
+    with pytest.raises(AMGXTPUError):
+        tickets[0].result()
+    for (good, b), t in zip(systems, tickets[1:]):
+        res = t.result()
+        assert int(res.status) == 0
+        relres = np.linalg.norm(
+            good @ np.asarray(res.x) - b
+        ) / np.linalg.norm(b)
+        assert relres < 1e-6
+    snap = svc.metrics.snapshot()
+    assert snap["quarantines"] == 1
+    assert snap["poisoned_requests"] == 1
+    assert snap["quarantined_solves"] == 3
+
+
+def test_serve_validation_rejects_nonfinite():
+    from amgx_tpu.core.errors import NonFiniteValuesError
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = _poisson_csr()
+    bad = sp.copy()
+    bad.data = bad.data.copy()
+    bad.data[0] = np.inf
+    svc = BatchedSolveService()
+    with pytest.raises(NonFiniteValuesError):
+        svc.submit(bad, np.ones(sp.shape[0]))
+    with pytest.raises(NonFiniteValuesError):
+        svc.submit(sp, np.full(sp.shape[0], np.nan))
+    assert svc.metrics.get("validation_rejects") == 2
+
+
+def test_serve_breaker_trips_after_repeated_failures():
+    """Per-fingerprint circuit breaker: after N consecutive group
+    failures the pattern bypasses batching (breaker_bypasses) and its
+    healthy requests still complete."""
+    import warnings
+
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = _poisson_csr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(1)
+    svc = BatchedSolveService(
+        max_batch=2, validate=False, breaker_threshold=2
+    )
+
+    def poisoned_group():
+        bad = sp.copy()
+        bad.data = bad.data.copy()
+        bad.data[0] = np.inf
+        t_bad = svc.submit(bad, np.ones(n))
+        t_ok = svc.submit(sp, rng.standard_normal(n))
+        svc.flush()
+        return t_bad, t_ok
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(3):
+            _, t_ok = poisoned_group()
+            assert int(t_ok.result().status) == 0
+    snap = svc.metrics.snapshot()
+    assert snap["breaker_trips"] == 1
+    assert snap["breaker_bypasses"] >= 1
+    assert snap["failed_groups"] == 2  # round 3 bypassed batching
+
+
+def test_serve_compile_failure_recovers_via_quarantine():
+    """Fault site serve_compile: the batched compile raises
+    ResourceError; quarantine completes every request correctly."""
+    from amgx_tpu.core import faults
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = _poisson_csr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(2)
+    svc = BatchedSolveService(max_batch=2)
+    b1, b2 = rng.standard_normal(n), rng.standard_normal(n)
+    with faults.inject("serve_compile", times=1):
+        t1 = svc.submit(sp, b1)
+        t2 = svc.submit(sp, b2)
+        svc.flush()
+    for t, b in ((t1, b1), (t2, b2)):
+        res = t.result()
+        assert int(res.status) == 0
+        relres = np.linalg.norm(
+            sp @ np.asarray(res.x) - b
+        ) / np.linalg.norm(b)
+        assert relres < 1e-6
+    assert svc.metrics.get("quarantines") == 1
+
+
+def test_serve_deadline_expires_only_late_ticket():
+    """A ticket with an already-passed deadline fails with
+    ResourceError at flush; its groupmates execute normally."""
+    from amgx_tpu.core.errors import ResourceError
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = _poisson_csr()
+    n = sp.shape[0]
+    svc = BatchedSolveService(max_batch=8)
+    t_late = svc.submit(sp, np.ones(n), deadline_s=-1.0)
+    t_ok = svc.submit(sp, np.ones(n))
+    svc.flush()
+    with pytest.raises(ResourceError):
+        t_late.result()
+    assert int(t_ok.result().status) == 0
+    assert svc.metrics.get("deadline_expired") == 1
