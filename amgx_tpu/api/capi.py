@@ -207,6 +207,10 @@ class _SolverHandle:
         # batched solve state (solver_solve_batch)
         self.batch_service = None
         self.batch_results = None
+        # in-flight tickets of a non-blocking solver_solve_batch call:
+        # (ticket-or-None, n, sol_handle) triples, drained on the first
+        # status/iterations/metrics/download accessor
+        self.batch_pending = None
 
 
 # ---------------------------------------------------------------------------
@@ -847,6 +851,11 @@ def vector_set_random(vec_h: int, n: int):
 @_traced
 def vector_download(vec_h: int) -> np.ndarray:
     v = _get(vec_h, _Vector)
+    owner = getattr(v, "_batch_owner", None)
+    if owner is not None:
+        # this vector is the solution slot of an in-flight batched
+        # solve: materialize it (and its groupmates) now
+        _drain_batch(owner)
     if v.data is None:
         raise AMGXError(RC_BAD_PARAMETERS, "vector empty")
     # always the mode's dtype: the C caller sizes its buffer by the mode
@@ -1041,6 +1050,7 @@ def solver_solve_with_0_initial_guess(slv_h: int, rhs_h: int, sol_h: int):
 
 def solver_get_status(slv_h: int) -> int:
     s = _get(slv_h, _SolverHandle)
+    _drain_batch(s)  # a pending batch updates s.result (last system)
     if s.result is None:
         raise AMGXError(RC_BAD_PARAMETERS, "no solve yet")
     return int(s.result.status)
@@ -1048,6 +1058,7 @@ def solver_get_status(slv_h: int) -> int:
 
 def solver_get_iterations_number(slv_h: int) -> int:
     s = _get(slv_h, _SolverHandle)
+    _drain_batch(s)
     if s.result is None:
         raise AMGXError(RC_BAD_PARAMETERS, "no solve yet")
     return int(s.result.iters)
@@ -1055,6 +1066,7 @@ def solver_get_iterations_number(slv_h: int) -> int:
 
 def solver_get_iteration_residual(slv_h: int, it: int, idx: int = 0):
     s = _get(slv_h, _SolverHandle)
+    _drain_batch(s)
     if s.result is None:
         raise AMGXError(RC_BAD_PARAMETERS, "no solve yet")
     hist = np.asarray(s.result.history)
@@ -1077,6 +1089,15 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
     The first call builds the service from the solver's config; later
     calls reuse its hierarchy/compile caches.
 
+    NON-BLOCKING (PR 3): the call returns at device DISPATCH.  Results
+    materialize — one blocking fetch per pattern group — on the first
+    accessor: ``solver_get_batch_status`` /
+    ``solver_get_batch_iterations_number`` /
+    ``solver_get_batch_metrics``, or ``vector_download`` of any of the
+    batch's solution vectors.  Host apps that interleave independent
+    work between solve_batch and the status reads get the device time
+    for free.
+
     Fault isolation: a poisoned system (validation reject, setup
     failure, quarantined solve error) fails ONLY itself — its status
     reads AMGX_SOLVE_FAILED and its solution vector is left as
@@ -1094,6 +1115,7 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
             RC_BAD_PARAMETERS,
             "solver_solve_batch: handle lists must have equal length",
         )
+    _drain_batch(s)  # settle any previous in-flight batch first
     if not mtx_handles:
         s.batch_results = []
         return RC_OK
@@ -1121,61 +1143,91 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
         )
         systems.append((A, r.data.astype(s.mode.vec_dtype), x0))
 
-    def _failed_result(n, dtype):
-        """Typed per-system failure shell: status FAILED, NaN norms —
-        the batch keeps going (reference: a failed solve is a status,
-        not an API error)."""
-        import jax.numpy as jnp
-
-        from amgx_tpu.solvers.base import FAILED, SolveResult
-
-        rdt = np.dtype(dtype)
-        if rdt.kind == "c":
-            rdt = np.dtype(np.float64 if rdt.itemsize == 16
-                           else np.float32)
-        return SolveResult(
-            x=jnp.zeros((n,), dtype),
-            iters=jnp.int32(0),
-            status=jnp.int32(FAILED),
-            final_norm=jnp.full((1,), np.nan, rdt),
-            initial_norm=jnp.full((1,), np.nan, rdt),
-            history=jnp.full((1, 1), np.nan, rdt),
-        )
-
     from amgx_tpu.core.errors import AMGXTPUError
 
     # only TYPED taxonomy failures (validation rejects, setup/solve
     # guardrail errors) become per-system FAILED statuses; anything
     # unexpected propagates to _rc_guard so host apps still see a
     # diagnostic RC instead of a silent RC_OK
-    tickets = []
-    for sys_ in systems:
-        try:
-            tickets.append(s.batch_service.submit(*sys_))
-        except AMGXTPUError:
-            tickets.append(None)  # typed reject: fails only itself
-    s.batch_service.flush()
-    results = []
-    for t, sys_, sh in zip(tickets, systems, sol_handles):
+    pending = []
+    for sys_, sh in zip(systems, sol_handles):
         n = sys_[0].n_rows * sys_[0].block_size
+        try:
+            t = s.batch_service.submit(*sys_)
+        except AMGXTPUError:
+            t = None  # typed reject: fails only itself
+        else:
+            _get(sh, _Vector)._batch_owner = s
+        pending.append((t, n, sh))
+    # dispatch without fetching: the device executes while the host
+    # app goes on; results land on the first batch accessor
+    s.batch_service.flush()
+    s.batch_pending = pending
+    s.batch_results = None
+    return RC_OK
+
+
+def _batch_failed_result(n, dtype):
+    """Typed per-system failure shell: status FAILED, NaN norms — the
+    batch keeps going (reference: a failed solve is a status, not an
+    API error)."""
+    import jax.numpy as jnp
+
+    from amgx_tpu.solvers.base import FAILED, SolveResult
+
+    rdt = np.dtype(dtype)
+    if rdt.kind == "c":
+        rdt = np.dtype(np.float64 if rdt.itemsize == 16
+                       else np.float32)
+    return SolveResult(
+        x=jnp.zeros((n,), dtype),
+        iters=jnp.int32(0),
+        status=jnp.int32(FAILED),
+        final_norm=jnp.full((1,), np.nan, rdt),
+        initial_norm=jnp.full((1,), np.nan, rdt),
+        history=jnp.full((1, 1), np.nan, rdt),
+    )
+
+
+def _drain_batch(s):
+    """Materialize an in-flight solver_solve_batch: one blocking fetch
+    per pattern group, solutions written to their vectors, per-system
+    results recorded.  Idempotent; a no-op when nothing is pending."""
+    from amgx_tpu.core.errors import AMGXTPUError
+
+    if s.batch_pending is None:
+        return
+    pending, s.batch_pending = s.batch_pending, None
+    results = []
+    for t, n, sh in pending:
+        try:
+            v = _get(sh, _Vector)
+        except AMGXError:
+            # the host app destroyed this solution vector while the
+            # batch was in flight: its result is unreceivable but the
+            # REST of the batch must still drain
+            v = None
+        if v is not None and getattr(v, "_batch_owner", None) is s:
+            v._batch_owner = None
         if t is None:
-            results.append(_failed_result(n, s.mode.vec_dtype))
+            results.append(_batch_failed_result(n, s.mode.vec_dtype))
             continue
         try:
             res = t.result()
         except AMGXTPUError:
-            res = _failed_result(n, s.mode.vec_dtype)
+            res = _batch_failed_result(n, s.mode.vec_dtype)
         else:
-            v = _get(sh, _Vector)
-            v.data = np.asarray(res.x, dtype=v.mode.vec_dtype)
+            if v is not None:
+                v.data = np.asarray(res.x, dtype=v.mode.vec_dtype)
         results.append(res)
     s.batch_results = results
-    s.result = results[-1]
-    return RC_OK
+    if results:
+        s.result = results[-1]
 
 
 def solver_get_batch_status(slv_h: int, idx: int) -> int:
     s = _get(slv_h, _SolverHandle)
+    _drain_batch(s)
     if s.batch_results is None:
         raise AMGXError(RC_BAD_PARAMETERS, "no batch solve yet")
     if not (0 <= idx < len(s.batch_results)):
@@ -1185,6 +1237,7 @@ def solver_get_batch_status(slv_h: int, idx: int) -> int:
 
 def solver_get_batch_iterations_number(slv_h: int, idx: int) -> int:
     s = _get(slv_h, _SolverHandle)
+    _drain_batch(s)
     if s.batch_results is None:
         raise AMGXError(RC_BAD_PARAMETERS, "no batch solve yet")
     if not (0 <= idx < len(s.batch_results)):
@@ -1194,10 +1247,13 @@ def solver_get_batch_iterations_number(slv_h: int, idx: int) -> int:
 
 def solver_get_batch_metrics(slv_h: int) -> dict:
     """Snapshot of the solver handle's serve-layer counters (queue
-    depth, cache/bucket hits, compiles, per-bucket latency)."""
+    depth, cache/bucket hits, compiles, per-bucket and per-ticket
+    latency).  Drains any in-flight batch first so ``solved`` /
+    latency reservoirs reflect it."""
     s = _get(slv_h, _SolverHandle)
     if s.batch_service is None:
         return {}
+    _drain_batch(s)
     return s.batch_service.metrics.snapshot()
 
 

@@ -31,6 +31,65 @@ def named_scope(name: str):
     return jax.named_scope(name)
 
 
+def percentile(samples, q: float) -> float:
+    """Linear-interpolated percentile of a sequence (q in [0, 100]).
+    Small-sample friendly: with one sample every percentile IS it."""
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return float(xs[0])
+    pos = (len(xs) - 1) * (q / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return float(xs[lo] * (1.0 - frac) + xs[hi] * frac)
+
+
+class LatencyReservoir:
+    """Bounded ring of latency samples for tail-quantile reporting
+    (p50/p99 of per-ticket serve latency).  A ring — not a sketch —
+    because serve traffic is bursty and the QUESTION is always about
+    recent behaviour; ``cap`` bounds memory regardless of uptime.
+    Thread safety is the caller's job (ServeMetrics holds its lock
+    around add/summary)."""
+
+    def __init__(self, cap: int = 2048):
+        self.cap = int(cap)
+        self._samples: list = []
+        self._next = 0
+        self.count = 0  # lifetime samples, beyond the ring
+
+    def add(self, seconds: float):
+        s = float(seconds)
+        if len(self._samples) < self.cap:
+            self._samples.append(s)
+        else:
+            self._samples[self._next] = s
+            self._next = (self._next + 1) % self.cap
+        self.count += 1
+
+    def clear(self):
+        """Drop all samples (e.g. to exclude warm-up tickets from a
+        steady-state quantile window)."""
+        self._samples.clear()
+        self._next = 0
+        self.count = 0
+
+    def percentile(self, q: float) -> float:
+        return percentile(self._samples, q)
+
+    def summary(self) -> dict:
+        xs = self._samples
+        return {
+            "count": self.count,
+            "mean_s": sum(xs) / len(xs) if xs else 0.0,
+            "p50_s": percentile(xs, 50.0),
+            "p99_s": percentile(xs, 99.0),
+            "max_s": max(xs) if xs else 0.0,
+        }
+
+
 class LevelProfile:
     """Accumulating tic/toc phase map (reference amgx_timer.h:46-60)."""
 

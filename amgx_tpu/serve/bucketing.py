@@ -95,6 +95,32 @@ class PaddedPattern:
         out[self.ones_pos] = 1.0
         return out
 
+    def embed_values_into(self, out: np.ndarray, values: np.ndarray):
+        """In-place :meth:`embed_values` into a resident staging row.
+        The row must come from a :class:`StagingSlot` primed for THIS
+        pattern: filler slots are zero and identity-tail slots are one
+        from priming, so the steady-state write is only the scatter
+        assignment of the real coefficients."""
+        values = np.asarray(values).reshape(-1)
+        if values.shape[0] != self.nnz:
+            raise ValueError(
+                f"expected {self.nnz} coefficients, got {values.shape[0]}"
+            )
+        out[self.scatter] = values
+
+    def embed_vector_into(self, out: np.ndarray, vec):
+        """In-place :meth:`embed_vector` into a staging row whose tail
+        [n:] is already zero (slot invariant)."""
+        if vec is None:
+            out[: self.n] = 0
+            return
+        v = np.asarray(vec).reshape(-1)
+        if v.shape[0] != self.n:
+            raise ValueError(
+                f"expected length-{self.n} vector, got {v.shape[0]}"
+            )
+        out[: self.n] = v
+
     def extract_values(self, padded: np.ndarray) -> np.ndarray:
         """Inverse of :meth:`embed_values` for the original slots."""
         return np.asarray(padded).reshape(-1)[self.scatter]
@@ -133,6 +159,63 @@ class PaddedPattern:
             build_ell=bool(accel_formats),
             accel_formats=tuple(accel_formats),
         )
+
+
+class StagingSlot:
+    """Persistent, reused host staging for one (pattern, dtype) group:
+    ``vals (rows, nnzb)`` / ``bs (rows, nb)`` / ``x0s (rows, nb)``,
+    written row-at-a-time at submit() and shipped to the device as one
+    contiguous slice at flush — no per-request allocation, no stack
+    copy.  Slot invariants after :meth:`__init__`: every vals row has
+    zeros at filler slots and ones at the identity tail (only the
+    scatter positions are ever rewritten), and vector rows are zero
+    past ``pattern.n``.  The service double-buffers slots per group key
+    so padding of group N+1 can start while group N's slot is still
+    being shipped."""
+
+    __slots__ = (
+        "pattern", "vals", "bs", "x0s", "rows", "in_use",
+        "x0_used", "x0_dirty",
+    )
+
+    def __init__(self, pattern: PaddedPattern, dtype, rows: int):
+        self.pattern = pattern
+        self.rows = int(rows)
+        dt = np.dtype(dtype)
+        self.vals = np.zeros((rows, pattern.nnzb), dtype=dt)
+        self.vals[:, pattern.ones_pos] = 1.0
+        self.bs = np.zeros((rows, pattern.nb), dtype=dt)
+        self.x0s = np.zeros((rows, pattern.nb), dtype=dt)
+        self.in_use = False
+        # x0_used: a request of the CURRENT group supplied a warm
+        # start — when False the dispatcher ships a cached
+        # device-resident zero block instead of transferring x0s at
+        # all.  x0_dirty: some PAST group wrote warm starts, so
+        # zero-x0 rows must be re-zeroed before reuse.
+        self.x0_used = False
+        self.x0_dirty = False
+
+    def write_row(self, i: int, values, b, x0):
+        """Embed one request into row ``i`` (exclusively owned by the
+        writing thread until the group flushes)."""
+        pat = self.pattern
+        pat.embed_values_into(self.vals[i], values)
+        pat.embed_vector_into(self.bs[i], b)
+        if x0 is not None:
+            self.x0_used = True
+            self.x0_dirty = True
+            pat.embed_vector_into(self.x0s[i], x0)
+        elif self.x0_dirty:
+            pat.embed_vector_into(self.x0s[i], None)
+
+    def fill_batch_padding(self, n_real: int, batch: int):
+        """Rows [n_real:batch] become batch-padding clones of row 0
+        with b = x0 = 0: they converge at iteration 0 and freeze."""
+        if batch > n_real:
+            self.vals[n_real:batch] = self.vals[0]
+            n = self.pattern.n
+            self.bs[n_real:batch, :n] = 0
+            self.x0s[n_real:batch, :n] = 0
 
 
 def pad_pattern(row_offsets, col_indices, n: int) -> PaddedPattern:

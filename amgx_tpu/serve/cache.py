@@ -19,6 +19,7 @@ is LRU-bounded) or use a fresh service.
 
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
 import hashlib
 import threading
@@ -52,6 +53,12 @@ class HierarchyEntry:
     batch_fn: Optional[Callable]  # fn(template, vals_B, b_B, x0_B)
     signature: object  # hashable shape signature of the template pytree
     pattern: PaddedPattern
+    # serializes resetup+solve on the SHARED template solver (the
+    # sequential fallback and quarantine-reuse paths mutate it; two
+    # concurrent groups of one fingerprint must not interleave)
+    solver_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock
+    )
 
 
 def template_signature(template) -> tuple:
@@ -85,6 +92,19 @@ class HierarchyCache:
     def __len__(self):
         return len(self._entries)
 
+    def peek(
+        self, fingerprint: str, cfg_key: str, dtype
+    ) -> Optional[HierarchyEntry]:
+        """Cached entry or None — never builds.  Used by the flusher's
+        quarantine path (reuse the pattern's hierarchy for isolated
+        re-solves) and by submit-time compile warm-up."""
+        key = (fingerprint, cfg_key, str(dtype))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+            return entry
+
     def get_or_build(
         self, pattern: PaddedPattern, cfg_key: str, dtype,
         build: Callable[[], HierarchyEntry],
@@ -112,3 +132,142 @@ class HierarchyCache:
     def clear(self):
         with self._lock:
             self._entries.clear()
+
+
+# process-wide compile worker: AOT warm-ups from every service share one
+# background thread, so a cold bucket's compile never runs on a flush
+# path or on the dispatch worker (head-of-line isolation), and idle
+# services don't each pin a thread
+_COMPILE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_COMPILE_POOL_LOCK = threading.Lock()
+
+
+def _compile_pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _COMPILE_POOL
+    with _COMPILE_POOL_LOCK:
+        if _COMPILE_POOL is None:
+            _COMPILE_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="serve-compile"
+            )
+        return _COMPILE_POOL
+
+
+class CompileCache:
+    """(template signature, batch bucket) -> compiled batched-solve
+    executable.
+
+    Two entries with equal signatures produce identical traces (the
+    template is an ARGUMENT), so a bucket hit is an XLA compile-cache
+    hit — same dedup contract as the old service-internal dict, plus:
+
+    * **AOT compiles** (``jit(...).lower(...).compile()``) against
+      ShapeDtypeStructs, so compilation needs no concrete batch and can
+      run BEFORE the first flush of a bucket;
+    * **background warm-up**: :meth:`warm` schedules the compile on a
+      shared single-thread pool; a flush that arrives first blocks on
+      the in-flight future instead of compiling again, and flushes of
+      already-warm buckets never queue behind a cold compile;
+    * **buffer donation**: the batched x0 is donated
+      (``donate_argnums``) so XLA reuses its buffer for the solution
+      output instead of allocating a fresh ``(B, n)`` array per flush.
+      ``donate=None`` defers to the platform default
+      (:func:`amgx_tpu.solvers.base.donation_enabled`: accelerators
+      yes, CPU no — donation serializes CPU dispatch); True/False
+      force it, e.g. for bitwise A/B tests.
+    """
+
+    def __init__(self, metrics: Optional[ServeMetrics] = None,
+                 donate: Optional[bool] = None):
+        self.metrics = metrics or ServeMetrics()
+        self.donate = donate
+        self._lock = threading.Lock()
+        self._fns: dict = {}
+        self._futures: dict = {}
+
+    def __len__(self):
+        return len(self._fns)
+
+    def _donate(self) -> bool:
+        if self.donate is not None:
+            return bool(self.donate)
+        from amgx_tpu.solvers.base import donation_enabled
+
+        return donation_enabled()
+
+    def _compile(self, entry: HierarchyEntry, Bb: int):
+        import jax
+
+        pat = entry.pattern
+        dt = entry.solver.A.values.dtype
+        jitted = jax.jit(
+            entry.batch_fn,
+            donate_argnums=(3,) if self._donate() else (),
+        )
+        try:
+            return jitted.lower(
+                entry.template,
+                jax.ShapeDtypeStruct((Bb, pat.nnzb), dt),
+                jax.ShapeDtypeStruct((Bb, pat.nb), dt),
+                jax.ShapeDtypeStruct((Bb, pat.nb), dt),
+            ).compile()
+        except Exception:
+            # AOT unavailable for this template pytree (exotic leaves):
+            # fall back to the tracing jit wrapper — compiled on first
+            # call, still cached here
+            self.metrics.inc("aot_fallbacks")
+            return jitted
+
+    def _resolve(self, key, entry: HierarchyEntry, Bb: int, fut):
+        try:
+            fn = self._compile(entry, Bb)
+        except BaseException as e:  # propagate to every waiter
+            with self._lock:
+                self._futures.pop(key, None)
+            fut.set_exception(e)
+            raise
+        with self._lock:
+            self._fns[key] = fn
+            self._futures.pop(key, None)
+        self.metrics.inc("compiles")
+        fut.set_result(fn)
+        return fn
+
+    def get(self, entry: HierarchyEntry, Bb: int):
+        """Executable for (entry.signature, Bb): cached, or joined from
+        an in-flight warm-up, or compiled inline on the CALLER (the
+        flusher thread — never the dispatch worker)."""
+        key = (entry.signature, Bb)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                self.metrics.inc("bucket_hits")
+                return fn
+            fut = self._futures.get(key)
+            if fut is None:
+                fut = concurrent.futures.Future()
+                self._futures[key] = fut
+                mine = True
+            else:
+                mine = False
+        if mine:
+            return self._resolve(key, entry, Bb, fut)
+        return fut.result()
+
+    def warm(self, entry: HierarchyEntry, Bb: int):
+        """Schedule a background AOT compile for (entry.signature, Bb)
+        if neither an executable nor an in-flight compile exists."""
+        key = (entry.signature, Bb)
+        with self._lock:
+            if key in self._fns or key in self._futures:
+                return
+            fut = concurrent.futures.Future()
+            self._futures[key] = fut
+        self.metrics.inc("compile_warmups")
+
+        def job():
+            try:
+                self._resolve(key, entry, Bb, fut)
+            except BaseException:  # noqa: BLE001 — recorded on future
+                pass
+
+        _compile_pool().submit(job)

@@ -16,6 +16,16 @@ Guardrail counters (fault-isolation paths, serve/service.py):
 ``breaker_bypasses`` / ``breakers_open`` (per-fingerprint circuit
 breaker), ``deadline_expired`` (per-ticket deadlines), and
 ``failed_groups`` (batched attempts that raised).
+
+Latency observability (async pipeline, PR 3): every ticket that rides
+a batched group records a queue→pad→dispatch→device→fetch stage
+breakdown plus its end-to-end latency into bounded reservoirs
+(:class:`amgx_tpu.core.profiling.LatencyReservoir`); ``snapshot()``
+exports per-stage p50/p99 and the convenience keys ``ticket_p50_s`` /
+``ticket_p99_s``.  ``host_busy_s`` / ``device_busy_s`` accumulate the
+host-stage and device-execution spans so callers (ci/serve_bench.py)
+can compute a host/device overlap ratio, and ``host_syncs`` counts the
+steady-state blocking fetches — exactly one per batched group.
 """
 
 from __future__ import annotations
@@ -24,7 +34,10 @@ import dataclasses
 import threading
 from collections import defaultdict
 
-from amgx_tpu.core.profiling import LevelProfile
+from amgx_tpu.core.profiling import LatencyReservoir, LevelProfile
+
+# per-ticket pipeline stages, in order
+TICKET_STAGES = ("queue", "pad", "dispatch", "device", "fetch", "total")
 
 
 @dataclasses.dataclass
@@ -51,12 +64,39 @@ class ServeMetrics:
         # phase attribution (pad / stack / execute / unpack), reusing
         # the reference-parity tic/toc machinery
         self.profile = LevelProfile()
+        # float accumulators (host_busy_s / device_busy_s overlap
+        # accounting) — separate from the int counters
+        self.times = defaultdict(float)
+        # per-ticket pipeline-stage latency reservoirs
+        self.latency = {s: LatencyReservoir() for s in TICKET_STAGES}
 
     # -- counters ------------------------------------------------------
 
     def inc(self, name: str, by: int = 1):
         with self._lock:
             self.counters[name] += by
+
+    def add_time(self, name: str, seconds: float):
+        with self._lock:
+            self.times[name] += float(seconds)
+
+    def record_ticket(self, stages: dict):
+        """Record one ticket's stage breakdown (seconds per stage name
+        from TICKET_STAGES; missing stages are skipped)."""
+        with self._lock:
+            for name, s in stages.items():
+                res = self.latency.get(name)
+                if res is not None:
+                    res.add(s)
+
+    def reset_latency(self):
+        """Drop latency samples and busy-time accumulators — excludes
+        warm-up (setup/compile) tickets from a steady-state window
+        (ci/serve_bench.py)."""
+        with self._lock:
+            for res in self.latency.values():
+                res.clear()
+            self.times.clear()
 
     def set_gauge(self, name: str, value: int):
         with self._lock:
@@ -87,6 +127,14 @@ class ServeMetrics:
                 str(k): dataclasses.asdict(v)
                 for k, v in self.buckets.items()
             }
+            for k, v in self.times.items():
+                out[k] = v
+            out["latency"] = {
+                name: res.summary() for name, res in self.latency.items()
+            }
+        tot = out["latency"]["total"]
+        out["ticket_p50_s"] = tot["p50_s"]
+        out["ticket_p99_s"] = tot["p99_s"]
         hits = out.get("bucket_hits", 0)
         misses = out.get("compiles", 0)
         total = hits + misses
@@ -100,9 +148,15 @@ class ServeMetrics:
         snap = self.snapshot()
         lines = ["    serve metrics:"]
         for k in sorted(snap):
-            if k == "buckets":
+            if k in ("buckets", "latency"):
                 continue
             lines.append(f"      {k:<28s} {snap[k]}")
+        for name, summ in snap["latency"].items():
+            if summ["count"]:
+                lines.append(
+                    f"      latency/{name:<20s} p50={summ['p50_s']:.6f}s"
+                    f" p99={summ['p99_s']:.6f}s n={summ['count']}"
+                )
         for bk, st in sorted(snap["buckets"].items()):
             lines.append(
                 f"      bucket {bk}: calls={st['calls']} "

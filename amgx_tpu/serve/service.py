@@ -4,8 +4,9 @@ execute them as a small number of vmapped device calls.
 Shape of the system (an inference-server-style continuous batcher):
 
   submit(A, b) ──┐   group by (padded-pattern fingerprint, dtype)
-  submit(A, b) ──┼─> bounded queue ──flush──> pad to (n, nnz, B) bucket
-  submit(A, b) ──┘   (max_batch / max-wait)    │
+  submit(A, b) ──┼─> bounded queue ──flush──> resident staging slot
+  submit(A, b) ──┘   (max_batch / max-wait)    │ (padded rows written
+                                               │  in place at submit)
                                                ▼
                              hierarchy cache (fingerprint + config):
                              one solver setup per pattern, reused for
@@ -13,11 +14,26 @@ Shape of the system (an inference-server-style continuous batcher):
                                                │
                                                ▼
                              compile cache (shape bucket + config):
-                             one jitted batched solve per bucket
+                             one AOT-compiled batched solve per
+                             bucket, warmed in the background
                                                │
                                                ▼
-                             vmapped masked-convergence solve
-                             (serve.batched), results unpadded
+                             single-worker dispatch stage: ship the
+                             staging slot, launch the vmapped solve
+                             (x0 donated), return WITHOUT blocking
+                                               │
+                                               ▼
+                             SolveTicket.result(): ONE blocking fetch
+                             per group, results unpadded lazily
+
+Async pipeline (PR 3): ``submit`` pads straight into a persistent,
+double-buffered staging slot; the flusher splits into a host stage
+(deadlines, hierarchy/compile resolution — caller thread) and a device
+stage (ship + launch — single-worker executor), so padding of group
+N+1 overlaps device execution of group N.  Nothing in the steady-state
+path blocks on the device: the ONLY host sync is the shared per-group
+fetch inside ``SolveTicket.result()`` (counted by the ``host_syncs``
+metric and asserted by tests/test_serve.py).
 
 Solvers without a traced batch path (GMRES, multicolor GS, ...) fall
 back to sequential resetup+solve per request — correct, just not
@@ -25,8 +41,9 @@ amortized; the ``fallback_solves`` counter exposes it.
 
 Fault isolation (guardrails): non-finite uploads are rejected at
 submit() with a typed SetupError; a group that fails as a unit is
-QUARANTINED — every member retries in per-request isolation so only
-the actually-poisoned requests fail; a per-fingerprint circuit breaker
+QUARANTINED — every member retries in per-request isolation (reusing
+the pattern's cached hierarchy when one exists) so only the
+actually-poisoned requests fail; a per-fingerprint circuit breaker
 bypasses batching for patterns that keep failing; optional per-ticket
 deadlines fail late tickets without touching their group.  All of it
 is counted in serve/metrics.py.
@@ -37,6 +54,7 @@ layouts don't survive the nnz-padding embedding.
 
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
 import threading
 import time
@@ -50,22 +68,30 @@ from amgx_tpu.core.profiling import trace_range
 from amgx_tpu.serve.batched import make_batched_solve
 from amgx_tpu.serve.bucketing import (
     PaddedPattern,
+    StagingSlot,
     bucket_batch,
     pad_pattern,
 )
 from amgx_tpu.serve.cache import (
+    CompileCache,
     HierarchyCache,
     HierarchyEntry,
+    _compile_pool,
     config_hash,
     template_signature,
 )
 from amgx_tpu.serve.metrics import ServeMetrics
+from amgx_tpu.solvers.base import SolveResult
+
 
 def _host_csr(A):
     """(row_offsets, col_indices, values, n, raw_fingerprint) host
     arrays from a SparseMatrix or scipy sparse matrix; scalar matrices
-    only.  The fingerprint keys the padded-pattern cache (SparseMatrix
-    memoizes its own, so repeat submissions skip the hash too)."""
+    only.  The fingerprint keys the padded-pattern cache; it is
+    memoized on the object (SparseMatrix has its own memo; for scipy
+    CSR inputs it is stashed as an attribute) so repeat submissions of
+    one matrix skip the pattern hash — callers that mutate a CSR's
+    index arrays IN PLACE after a submit must pass a fresh matrix."""
     from amgx_tpu.core.matrix import sparsity_fingerprint
 
     if isinstance(A, SparseMatrix):
@@ -89,10 +115,33 @@ def _host_csr(A):
             f"{type(A).__name__}"
         ) from None
     sp.sort_indices()
-    fp = sparsity_fingerprint(
-        sp.indptr, sp.indices, sp.shape[0], sp.shape[1], 1
-    )
+    fp = getattr(sp, "_amgx_tpu_fp", None)
+    if fp is None:
+        fp = sparsity_fingerprint(
+            sp.indptr, sp.indices, sp.shape[0], sp.shape[1], 1
+        )
+        try:
+            sp._amgx_tpu_fp = fp
+        except AttributeError:
+            pass
     return sp.indptr, sp.indices, sp.data, sp.shape[0], fp
+
+
+_DTYPE_MEMO: dict = {}
+
+
+def _resolve_dtype(dt):
+    """(resolved np.dtype, str) for an upload dtype — integer uploads
+    promote to f64, complex passes through.  Memoized: dtype object
+    construction and str() are measurable at submit rates."""
+    ent = _DTYPE_MEMO.get(dt)
+    if ent is None:
+        rdt = np.dtype(dt)
+        if not np.issubdtype(rdt, np.inexact):
+            rdt = np.dtype(np.float64)
+        ent = (rdt, str(rdt))
+        _DTYPE_MEMO[dt] = ent
+    return ent
 
 
 # the service's stock configuration — also the workload ci/serve_bench.py
@@ -107,16 +156,62 @@ DEFAULT_CONFIG = (
 )
 
 
+# process-wide single-worker device-dispatch stage: ship-and-launch of
+# batched groups serializes here (device_put + async XLA dispatch, no
+# blocking), which keeps the flusher's caller free to pad the next
+# group while the device executes the current one
+_DISPATCH_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_DISPATCH_LOCK = threading.Lock()
+
+
+def _dispatch_pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _DISPATCH_POOL
+    with _DISPATCH_LOCK:
+        if _DISPATCH_POOL is None:
+            _DISPATCH_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="serve-dispatch"
+            )
+        return _DISPATCH_POOL
+
+
+def _block_ready(x):
+    """THE steady-state device sync: wait for a dispatched group's
+    solution.  Kept as a module hook so tests can count that it runs
+    exactly once per batched group."""
+    import jax
+
+    return jax.block_until_ready(x)
+
+
+def _fetch_host(tree):
+    """Device→host copy of a (ready) batched result pytree — the
+    second half of the per-group sync, also test-countable."""
+    import jax
+
+    return jax.device_get(tree)
+
+
 @dataclasses.dataclass
 class SolveTicket:
-    """Handle returned by submit(); result() blocks (flushing the
-    owning group if needed) and returns a per-request SolveResult."""
+    """Handle returned by submit().
+
+    ``done()`` is non-blocking: True once the ticket has settled
+    (result or error) OR its group has been dispatched to the device —
+    the result itself may still be in flight.  ``result()`` flushes
+    the owning group if needed, then performs the pipeline's single
+    per-group blocking fetch (shared by every groupmate, whichever
+    ticket asks first) and returns this request's SolveResult."""
 
     _service: "BatchedSolveService"
     _group_key: tuple
+    _row: int = 0
+    _pattern: object = None
     _result: object = None
     _done: bool = False
     _error: Optional[BaseException] = None
+    _batch: object = None  # _BatchResult after dispatch
+    _t_submit: float = 0.0
+    _pad_s: float = 0.0
 
     def done(self) -> bool:
         return self._done
@@ -126,19 +221,21 @@ class SolveTicket:
             self._service._flush_group_of(self)
         if self._error is not None:
             raise self._error
+        if self._result is None and self._batch is not None:
+            self._result = self._batch.result_for(self)
         return self._result
 
 
 @dataclasses.dataclass
 class _Request:
-    pattern: PaddedPattern
-    values: np.ndarray  # padded (nnzb,)
-    b: np.ndarray  # padded (nb,)
-    x0: np.ndarray  # padded (nb,)
     ticket: SolveTicket
+    row: int  # staging-slot row owned by this request
     # optional absolute monotonic deadline; the flusher fails the
     # ticket with ResourceError when execution starts after it
     deadline: Optional[float] = None
+    # row write finished (writes happen outside the service lock; the
+    # flusher's host stage waits on this)
+    ready: bool = False
 
 
 @dataclasses.dataclass
@@ -148,6 +245,115 @@ class _Group:
     dtype: np.dtype
     requests: list
     deadline: float
+    slot: StagingSlot
+
+
+class _BatchResult:
+    """One dispatched batched group: the device-resident SolveResult
+    plus the bookkeeping to distribute per-request results lazily.
+
+    ``fetch()`` performs the pipeline's ONLY steady-state host sync —
+    once per group, whichever ticket asks first — then records the
+    queue→pad→dispatch→device→fetch breakdown for every groupmate.
+
+    Timing semantics: the ``device`` stage is measured dispatch→ready
+    AT FETCH TIME, so it is exact when the consumer fetches promptly
+    (solve_many, the serve bench) and an UPPER BOUND including consumer
+    idle when results are collected late — measuring true completion
+    would need a watcher thread performing a second per-group sync,
+    which the one-sync-per-group contract deliberately forbids."""
+
+    __slots__ = (
+        "_service", "res", "pattern", "tickets", "Bb",
+        "t_flush", "t_dispatch", "_lock", "_host", "_error",
+    )
+
+    def __init__(self, service, res, pattern, tickets, Bb,
+                 t_flush, t_dispatch):
+        self._service = service
+        self.res = res
+        self.pattern = pattern
+        self.tickets = tickets
+        self.Bb = Bb
+        self.t_flush = t_flush
+        self.t_dispatch = t_dispatch
+        self._lock = threading.Lock()
+        self._host = None
+        self._error = None
+
+    def fetch(self):
+        with self._lock:
+            if self._host is not None:
+                return self._host
+            if self._error is not None:
+                raise self._error
+            m = self._service.metrics
+            try:
+                _block_ready(self.res.x)
+                t_done = time.perf_counter()
+                host = _fetch_host(self.res)
+            except BaseException as e:  # noqa: BLE001 — async runtime
+                # failure (OOM, XLA runtime error) surfacing at the
+                # fetch, after the staging rows are gone: convert to a
+                # typed error for EVERY groupmate (the C API maps it to
+                # per-system FAILED statuses) and count it against the
+                # pattern's breaker
+                from amgx_tpu.core.errors import ResourceError
+
+                err = ResourceError(
+                    "batched group execution failed after dispatch: "
+                    f"{type(e).__name__}: {e}"
+                )
+                err.__cause__ = e
+                self._error = err
+                self.res = None  # drop the (possibly poisoned) buffers
+                m.inc("failed_groups")
+                self._service._breaker_failure(self.pattern.fingerprint)
+                raise err
+            t_fetch = time.perf_counter()
+            self._host = host
+            self.res = None  # host copy cached: free the device batch
+            device_s = max(t_done - self.t_dispatch, 0.0)
+            fetch_s = t_fetch - t_done
+            dispatch_s = self.t_dispatch - self.t_flush
+            pat = self.pattern
+            m.inc("host_syncs")
+            m.add_time("device_busy_s", device_s)
+            m.add_time("host_busy_s", fetch_s)
+            m.record_batch(
+                (pat.nb, pat.nnzb, self.Bb),
+                device_s,
+                len(self.tickets),
+                self.Bb - len(self.tickets),
+            )
+            m.inc("solved", len(self.tickets))
+            m.inc("padded_elems", self.Bb * pat.nb)
+            m.inc("real_elems", len(self.tickets) * pat.n)
+            for t in self.tickets:
+                m.record_ticket({
+                    "queue": max(
+                        self.t_flush - t._t_submit - t._pad_s, 0.0
+                    ),
+                    "pad": t._pad_s,
+                    "dispatch": dispatch_s,
+                    "device": device_s,
+                    "fetch": fetch_s,
+                    "total": max(t_fetch - t._t_submit, 0.0),
+                })
+            return self._host
+
+    def result_for(self, ticket: SolveTicket) -> SolveResult:
+        host = self.fetch()
+        i = ticket._row
+        n = self.pattern.n
+        return SolveResult(
+            x=host.x[i, :n],
+            iters=host.iters[i],
+            status=host.status[i],
+            final_norm=host.final_norm[i],
+            initial_norm=host.initial_norm[i],
+            history=host.history[i],
+        )
 
 
 class BatchedSolveService:
@@ -172,6 +378,16 @@ class BatchedSolveService:
         bypassed for that pattern and its requests run in per-request
         isolation (``breaker_trips`` / ``breaker_bypasses`` counters;
         a successful batched group resets the count).
+    donate: donate the batched x0 buffer to the compiled solve
+        (``donate_argnums``) so XLA writes the solution in place
+        instead of allocating a fresh (B, n) output per flush.  The
+        service always owns that buffer, so donation is always SAFE;
+        the default (None) follows the platform
+        (:func:`amgx_tpu.solvers.base.donation_enabled`: accelerators
+        donate, CPU doesn't — donation serializes CPU dispatch and
+        would defeat the async pipeline).  True/False force it, e.g.
+        for the bitwise donation-on/off A/B test in
+        tests/test_serve.py.
     """
 
     def __init__(
@@ -183,6 +399,7 @@ class BatchedSolveService:
         cache_entries: int = 64,
         validate: bool = True,
         breaker_threshold: int = 3,
+        donate: Optional[bool] = None,
     ):
         if config is None:
             config = DEFAULT_CONFIG
@@ -197,11 +414,21 @@ class BatchedSolveService:
         self.cache = HierarchyCache(
             max_entries=cache_entries, metrics=self.metrics
         )
+        self.donate = donate
+        self.compile_cache = CompileCache(
+            metrics=self.metrics, donate=donate
+        )
         self._lock = threading.RLock()
         self._groups: dict = {}
         self._queued = 0
-        self._compiled: dict = {}
         self._patterns: dict = {}
+        self._staging: dict = {}
+        # device-resident zero warm-start blocks, shared across flushes
+        # (and across same-shape patterns) when no request warm-starts
+        # and donation is off — one device_put saved per flush
+        self._zeros_x0: dict = {}
+        # signature -> batch bucket of its last flush (warm-up target)
+        self._last_bucket: dict = {}
         self._poller: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.validate = bool(validate)
@@ -223,6 +450,7 @@ class BatchedSolveService:
         (optional, seconds from now): if the group executes after the
         deadline, THIS ticket fails with ResourceError while the rest
         of the group proceeds."""
+        t_submit = time.perf_counter()
         ro, ci, vals, n, raw_fp = _host_csr(A)
         if self.validate:
             # typed rejection at the door: one poisoned request must
@@ -241,18 +469,10 @@ class BatchedSolveService:
                     "NaN/Inf (validation reject)"
                 )
         pattern = self._pattern_for(ro, ci, n, raw_fp)
-        dtype = np.dtype(vals.dtype)
-        if not np.issubdtype(dtype, np.inexact):
-            # integer uploads promote; complex dtypes pass through
-            dtype = np.dtype(np.float64)
-        with trace_range("serve_submit"), self.metrics.profile.phase(
-            "pad"
-        ):
-            req_vals = pattern.embed_values(vals, dtype=dtype)
-            req_b = pattern.embed_vector(b, dtype)
-            req_x0 = pattern.embed_vector(x0, dtype)
-        key = (pattern.fingerprint, str(dtype))
+        dtype, dtype_s = _resolve_dtype(vals.dtype)
+        key = (pattern.fingerprint, dtype_s)
         flush_now = []
+        new_group = False
         with self._lock:
             grp = self._groups.get(key)
             if grp is None:
@@ -262,23 +482,27 @@ class BatchedSolveService:
                     dtype=dtype,
                     requests=[],
                     deadline=time.monotonic() + self.max_wait_s,
+                    slot=self._acquire_slot(key, pattern, dtype),
                 )
                 self._groups[key] = grp
-            ticket = SolveTicket(_service=self, _group_key=key)
-            grp.requests.append(
-                _Request(
-                    pattern=pattern,
-                    values=req_vals,
-                    b=req_b,
-                    x0=req_x0,
-                    ticket=ticket,
-                    deadline=(
-                        None
-                        if deadline_s is None
-                        else time.monotonic() + float(deadline_s)
-                    ),
-                )
+                new_group = True
+            ticket = SolveTicket(
+                _service=self,
+                _group_key=key,
+                _row=len(grp.requests),
+                _pattern=pattern,
             )
+            ticket._t_submit = t_submit
+            req = _Request(
+                ticket=ticket,
+                row=ticket._row,
+                deadline=(
+                    None
+                    if deadline_s is None
+                    else time.monotonic() + float(deadline_s)
+                ),
+            )
+            grp.requests.append(req)
             self._queued += 1
             self.metrics.inc("submitted")
             self.metrics.set_gauge("queue_depth", self._queued)
@@ -288,8 +512,33 @@ class BatchedSolveService:
                 flush_now.extend(
                     self._take_group(k) for k in list(self._groups)
                 )
-        for grp in flush_now:
-            self._execute_group(grp)
+        # pad: write the request into its staging row — OUTSIDE the
+        # lock (the row is exclusively this thread's until the group
+        # flushes; the flusher waits on req.ready)
+        t0 = time.perf_counter()
+        try:
+            with trace_range("serve_submit"):
+                grp.slot.write_row(req.row, vals, b, x0)
+        except BaseException as e:
+            # malformed request (wrong length, bad dtype): fail ONLY
+            # this ticket; its garbage row rides along inert.  Any
+            # groups already taken for flushing MUST still execute —
+            # their tickets would otherwise spin forever.
+            ticket._error = e
+            ticket._done = True
+            req.ready = True
+            for g in flush_now:
+                self._execute_group(g)
+            raise
+        req.ready = True
+        ticket._pad_s = time.perf_counter() - t0
+        prof = self.metrics.profile
+        prof.times["pad"] += ticket._pad_s
+        prof.counts["pad"] += 1
+        if new_group:
+            self._maybe_warm(pattern, dtype)
+        for g in flush_now:
+            self._execute_group(g)
         return ticket
 
     def solve_many(self, systems):
@@ -299,18 +548,49 @@ class BatchedSolveService:
         self.flush()
         return [t.result() for t in tickets]
 
+    def prewarm(self, A, batch: Optional[int] = None):
+        """Eliminate a pattern's cold start in the background: build
+        (or fetch) the hierarchy entry for ``A``'s sparsity and
+        AOT-compile its batched solve for the ``batch`` bucket
+        (default: this service's max_batch), all on the shared compile
+        worker — no flush ever head-of-line-blocks behind it."""
+        ro, ci, vals, n, raw_fp = _host_csr(A)
+        pattern = self._pattern_for(ro, ci, n, raw_fp)
+        dtype = _resolve_dtype(vals.dtype)[0]
+        Bb = bucket_batch(self.max_batch if batch is None else batch)
+        vals = np.asarray(vals).copy()
+
+        def job():
+            try:
+                entry = self.cache.get_or_build(
+                    pattern,
+                    self.cfg_key,
+                    dtype,
+                    lambda: self._build_entry(pattern, vals, dtype),
+                )
+                if entry.batch_fn is not None:
+                    self.compile_cache.warm(entry, Bb)
+                self.metrics.inc("prewarms")
+            except BaseException:  # noqa: BLE001 — warm-up best-effort
+                self.metrics.inc("prewarm_failures")
+
+        _compile_pool().submit(job)
+
     # ------------------------------------------------------------------
     # flushing
 
     def flush(self):
-        """Execute every queued group now."""
+        """Execute every queued group now (dispatch completes before
+        return; results are fetched lazily by the tickets)."""
         with self._lock:
             groups = [self._take_group(k) for k in list(self._groups)]
         for grp in groups:
             self._execute_group(grp)
 
     def poll(self):
-        """Execute groups whose max-wait deadline has passed."""
+        """Execute groups whose max-wait deadline has passed.  Poller
+        flushes don't wait for the dispatch stage — padding of the next
+        group proceeds while the worker ships this one."""
         now = time.monotonic()
         with self._lock:
             due = [
@@ -319,7 +599,7 @@ class BatchedSolveService:
                 if g.deadline <= now
             ]
         for grp in due:
-            self._execute_group(grp)
+            self._execute_group(grp, wait_dispatch=False)
 
     def start(self, interval_s: float = 0.005):
         """Run a daemon poller enforcing max_wait_s in the background."""
@@ -355,6 +635,9 @@ class BatchedSolveService:
     # internals
 
     _PATTERN_CACHE_MAX = 512
+    # double-buffered staging: two resident slots per group key so the
+    # next group pads while the previous one ships
+    _STAGING_SLOTS_PER_KEY = 2
 
     def _pattern_for(self, ro, ci, n, raw_fp) -> PaddedPattern:
         """Padded pattern for a raw fingerprint, cached: re-padding on
@@ -371,6 +654,56 @@ class BatchedSolveService:
                 self._patterns.clear()
             self._patterns[raw_fp] = pat
         return pat
+
+    def _acquire_slot(self, key, pattern, dtype) -> StagingSlot:
+        """Resident staging slot for a new group (caller holds the
+        lock).  Reuses a free pooled slot; allocates (and pools, up to
+        the double-buffer depth) otherwise."""
+        pool = self._staging.setdefault(key, [])
+        for s in pool:
+            if not s.in_use:
+                s.in_use = True
+                s.x0_used = False
+                self.metrics.inc("staging_reuses")
+                return s
+        s = StagingSlot(pattern, dtype, bucket_batch(self.max_batch))
+        s.in_use = True
+        if len(pool) < self._STAGING_SLOTS_PER_KEY:
+            pool.append(s)
+        else:
+            self.metrics.inc("staging_overflows")
+        if len(self._staging) > self._PATTERN_CACHE_MAX:
+            for k in list(self._staging):
+                if k != key and not any(
+                    x.in_use for x in self._staging[k]
+                ):
+                    del self._staging[k]
+        return s
+
+    def _release_slot(self, slot: StagingSlot):
+        with self._lock:
+            slot.in_use = False
+
+    def _release_group_slot(self, grp: "_Group"):
+        """Release a group's slot exactly once: `grp.slot` is the
+        ownership token — whoever nulls it did the release, so a
+        failure path running after a success-path release can't free
+        (or read) a slot a newer group already owns."""
+        slot, grp.slot = grp.slot, None
+        if slot is not None:
+            self._release_slot(slot)
+
+    def _maybe_warm(self, pattern: PaddedPattern, dtype):
+        """Background AOT warm-up at group creation: if this pattern's
+        hierarchy is already cached, schedule the compile for its
+        last-seen batch bucket now, so it overlaps the group's queue
+        wait instead of blocking its flush."""
+        entry = self.cache.peek(pattern.fingerprint, self.cfg_key, dtype)
+        if entry is None or entry.batch_fn is None:
+            return
+        bb = self._last_bucket.get(entry.signature)
+        if bb:
+            self.compile_cache.warm(entry, bb)
 
     # total bytes the batched dense copies may occupy (B x nb x nb);
     # above it a non-ELL bucket stays CSR (segment-sum SpMV)
@@ -434,18 +767,30 @@ class BatchedSolveService:
             while not ticket._done:
                 time.sleep(0.001)
 
-    def _build_entry(self, grp: _Group) -> HierarchyEntry:
+    @staticmethod
+    def _wait_ready(grp: _Group):
+        """Staging-row writes happen outside the service lock; the
+        flusher's host stage waits (µs-scale) until every submitter in
+        the group has finished its write."""
+        for r in grp.requests:
+            while not r.ready:
+                time.sleep(0.0001)
+
+    def _build_entry(
+        self, pattern: PaddedPattern, values, dtype
+    ) -> HierarchyEntry:
         """One solver setup for this padded pattern (hierarchy-cache
-        miss path), using the group's first coefficient set."""
+        miss path) from a representative coefficient set ``values``
+        (original (nnz,) layout)."""
         import amgx_tpu.solvers  # noqa: F401 — registry side effects
         import amgx_tpu.amg  # noqa: F401 — registers "AMG"
         from amgx_tpu.solvers.registry import create_solver, make_nested
 
         with self.metrics.profile.phase("setup"):
-            A = grp.pattern.template_matrix(
-                grp.pattern.extract_values(grp.requests[0].values),
-                grp.dtype,
-                accel_formats=self._accel_for(grp.pattern),
+            A = pattern.template_matrix(
+                values,
+                dtype,
+                accel_formats=self._accel_for(pattern),
             )
             # make_nested: the service owns the solve boundary — no
             # per-solver rescaling/renumbering of padded systems
@@ -464,51 +809,27 @@ class BatchedSolveService:
             template=template,
             batch_fn=batch_fn,
             signature=sig,
-            pattern=grp.pattern,
+            pattern=pattern,
         )
 
-    def _compiled_fn(self, entry: HierarchyEntry, Bb: int):
-        """Jitted batched solve shared across every hierarchy entry
-        with the same template signature (= shape bucket) and batch
-        bucket — a bucket hit is an XLA compile-cache hit."""
-        import jax
-
-        from amgx_tpu.core import faults
-        from amgx_tpu.core.errors import ResourceError
-
-        key = (entry.signature, Bb)
-        with self._lock:
-            fn = self._compiled.get(key)
-            if fn is not None:
-                self.metrics.inc("bucket_hits")
-                return fn
-            if faults.should_fire("serve_compile"):
-                raise ResourceError(
-                    "injected serve compile failure (fault site "
-                    "serve_compile)"
-                )
-            self.metrics.inc("compiles")
-            fn = jax.jit(entry.batch_fn)
-            self._compiled[key] = fn
-            return fn
-
     def _expire_deadlines(self, grp: _Group):
-        """Fail (only) the tickets whose deadline already passed; the
-        rest of the group executes normally."""
+        """Fail (only) the tickets whose deadline already passed; their
+        staged rows ride along inert while the rest of the group
+        executes normally."""
         from amgx_tpu.core.errors import ResourceError
 
         now = time.monotonic()
-        live = []
         for r in grp.requests:
-            if r.deadline is not None and now > r.deadline:
+            if (
+                r.deadline is not None
+                and now > r.deadline
+                and not r.ticket._done
+            ):
                 r.ticket._error = ResourceError(
                     "serve deadline exceeded before execution"
                 )
                 r.ticket._done = True
                 self.metrics.inc("deadline_expired")
-            else:
-                live.append(r)
-        grp.requests = live
 
     def _breaker_failure(self, fp: str):
         """Count a group failure; trip the breaker at the threshold."""
@@ -542,11 +863,21 @@ class BatchedSolveService:
     # open and recounts toward nothing (already open)
     _BREAKER_PROBE_EVERY = 8
 
-    def _execute_group(self, grp: _Group):
+    def _execute_group(self, grp: _Group, wait_dispatch: bool = True):
+        """Host stage of the flusher: deadlines, hierarchy/compile
+        resolution, then hand-off to the single-worker dispatch stage.
+        ``wait_dispatch`` waits for the DISPATCH (not the device) so
+        tickets read done() immediately after a synchronous flush; the
+        poller passes False and pipelines."""
         if not grp.requests:
+            self._release_group_slot(grp)
             return
+        self._wait_ready(grp)
+        t_flush = time.perf_counter()
         self._expire_deadlines(grp)
-        if not grp.requests:
+        live = [r for r in grp.requests if not r.ticket._done]
+        if not live:
+            self._release_group_slot(grp)
             return
         fp = grp.pattern.fingerprint
         if fp in self._broken:
@@ -562,147 +893,230 @@ class BatchedSolveService:
                 return
             # fall through: half-open probe attempts one batched group
         try:
+            vals0 = grp.pattern.extract_values(
+                grp.slot.vals[live[0].row]
+            )
             entry = self.cache.get_or_build(
                 grp.pattern,
                 self.cfg_key,
                 grp.dtype,
-                lambda: self._build_entry(grp),
+                lambda: self._build_entry(grp.pattern, vals0, grp.dtype),
             )
             if entry.batch_fn is None:
-                self._execute_sequential(entry, grp)
-            else:
-                self._execute_batched(entry, grp)
+                self._execute_sequential(entry, grp, live)
+                self._breaker_success(fp)
+                return
+            from amgx_tpu.core import faults
+            from amgx_tpu.core.errors import ResourceError
+
+            if faults.should_fire("serve_compile"):
+                raise ResourceError(
+                    "injected serve compile failure (fault site "
+                    "serve_compile)"
+                )
+            Bb = bucket_batch(len(grp.requests))
+            fn = self.compile_cache.get(entry, Bb)
+            with self._lock:
+                if len(self._last_bucket) >= self._PATTERN_CACHE_MAX:
+                    self._last_bucket.clear()
+                self._last_bucket[entry.signature] = Bb
         except BaseException:  # noqa: BLE001 — failures must reach the
-            # tickets, not kill the poller thread (tickets already
-            # completed — e.g. earlier fallback solves — keep their
-            # results).  Quarantine: the group failed as a unit (a
-            # poisoned member sabotaged shared setup, or compile/
-            # execute died) — retry every member in isolation so only
-            # the actually-poisoned requests fail.
-            self.metrics.inc("failed_groups")
-            self._breaker_failure(fp)
-            self.metrics.inc("quarantines")
-            self._execute_quarantined(grp)
+            # tickets, not kill the poller thread.  Quarantine: the
+            # group failed as a unit (a poisoned member sabotaged
+            # shared setup, or the compile died) — retry every member
+            # in isolation so only the actually-poisoned requests fail.
+            self._group_failed(grp, fp)
+            return
+        if wait_dispatch:
+            # synchronous flush (submit()-triggered, flush()): the
+            # caller would wait for the dispatch anyway — run the
+            # device stage inline and skip the worker hop.  The launch
+            # itself is non-blocking, so padding of the NEXT group
+            # still overlaps this group's device execution.
+            self._dispatch_batched(entry, fn, grp, live, t_flush)
         else:
+            # pipelined flush (poller/server mode): the device stage
+            # runs on the single-worker executor; this thread returns
+            # to padding immediately
+            _dispatch_pool().submit(
+                self._dispatch_batched, entry, fn, grp, live, t_flush
+            )
+
+    def _group_failed(self, grp: _Group, fp: str):
+        self.metrics.inc("failed_groups")
+        self._breaker_failure(fp)
+        self.metrics.inc("quarantines")
+        self._execute_quarantined(grp)
+
+    def _dispatch_batched(self, entry, fn, grp, live, t_flush):
+        """Device stage (single-worker executor): ship the staging
+        slot, launch the compiled batched solve, attach the lazy
+        result.  Returns at DISPATCH — the only block_until_ready in
+        steady state is inside SolveTicket.result().  Never raises:
+        failures quarantine the group right here in the worker."""
+        fp = grp.pattern.fingerprint
+        try:
+            import jax.numpy as jnp
+
+            pat = grp.pattern
+            slot = grp.slot
+            nreq = len(grp.requests)
+            Bb = bucket_batch(nreq)
+            with trace_range("serve_batch_dispatch"), \
+                    self.metrics.profile.phase("dispatch"):
+                # batch padding: clones of a live system with b = 0
+                # converge at iteration 0 and freeze immediately
+                slot.fill_batch_padding(nreq, Bb)
+                if live[0].row != 0:
+                    slot.vals[nreq:Bb] = slot.vals[live[0].row]
+                vals_d = jnp.asarray(slot.vals[:Bb])
+                bs_d = jnp.asarray(slot.bs[:Bb])
+                if slot.x0_used or self.compile_cache._donate():
+                    # warm starts (or a donated buffer, which the
+                    # compiled call consumes) need a fresh transfer
+                    x0_d = jnp.asarray(slot.x0s[:Bb])
+                else:
+                    # all-zero initial guesses: reuse one resident
+                    # device block instead of shipping zeros per flush
+                    zk = (Bb, pat.nb, str(grp.dtype))
+                    with self._lock:
+                        x0_d = self._zeros_x0.get(zk)
+                    if x0_d is None:
+                        x0_d = jnp.zeros((Bb, pat.nb), grp.dtype)
+                        with self._lock:
+                            if len(self._zeros_x0) >= 64:
+                                self._zeros_x0.clear()
+                            self._zeros_x0[zk] = x0_d
+                self.metrics.inc("batches")
+                res = fn(entry.template, vals_d, bs_d, x0_d)
+                # host buffers were copied to the device and the solve
+                # is launched: release ONLY now, so a pre-launch
+                # failure still leaves the rows intact for quarantine
+                self._release_group_slot(grp)
+            t_dispatch = time.perf_counter()
+            self.metrics.add_time(
+                "host_busy_s",
+                (t_dispatch - t_flush)
+                + sum(r.ticket._pad_s for r in live),
+            )
+            br = _BatchResult(
+                self, res, pat, [r.ticket for r in live], Bb,
+                t_flush, t_dispatch,
+            )
+            for r in live:
+                r.ticket._batch = br
+                r.ticket._done = True
             self._breaker_success(fp)
+        except BaseException:  # noqa: BLE001 — worker must not die
+            self._group_failed(grp, fp)
 
     def _execute_quarantined(self, grp: _Group):
-        """Per-request isolation: each request gets its own solver
-        setup on its OWN coefficients (the cached group entry may have
-        been built from a poisoned member), so exactly the poisoned
-        requests fail — with typed errors — and the rest complete."""
+        """Per-request isolation: each request re-solves on its OWN
+        coefficients so exactly the poisoned requests fail — with
+        typed errors — and the rest complete.  When the pattern's
+        hierarchy entry is already cached (the group failure happened
+        AFTER a healthy build), the re-solve reuses it via a
+        values-only resetup instead of re-deriving the whole setup per
+        request; a fresh isolated setup remains the fallback."""
         import amgx_tpu.solvers  # noqa: F401 — registry side effects
         import amgx_tpu.amg  # noqa: F401 — registers "AMG"
         from amgx_tpu.solvers.registry import create_solver, make_nested
 
         pat = grp.pattern
-        for r in grp.requests:
-            if r.ticket._done:
-                continue
-            try:
-                with self.metrics.profile.phase("quarantine"):
-                    A = pat.template_matrix(
-                        pat.extract_values(r.values),
-                        grp.dtype,
-                        accel_formats=self._accel_for(pat),
-                    )
-                    solver = make_nested(
-                        create_solver(self.cfg, "default")
-                    )
-                    solver.setup(A)
-                    res = solver.solve(r.b, x0=r.x0)
-            except BaseException as e:  # noqa: BLE001 — per-request
-                r.ticket._error = e
-                r.ticket._done = True
-                self.metrics.inc("poisoned_requests")
-            else:
-                r.ticket._result = dataclasses.replace(
-                    res, x=res.x[: pat.n]
-                )
-                r.ticket._done = True
-                self.metrics.inc("quarantined_solves")
-                self.metrics.inc("solved")
-
-    def _execute_batched(self, entry: HierarchyEntry, grp: _Group):
-        import jax.numpy as jnp
-
-        # submit() flushes a group at max_batch, so one batch bucket
-        # always covers the whole group
-        chunk = grp.requests
-        Bb = bucket_batch(len(chunk))
-        n_pad = Bb - len(chunk)
-        self.metrics.inc("batches")
-        pat = grp.pattern
-        with self.metrics.profile.phase("stack"):
-            # batch padding: clones of the first system with b=0
-            # converge at iteration 0 and freeze immediately
-            vals = np.stack(
-                [r.values for r in chunk] + [chunk[0].values] * n_pad
-            )
-            bs = np.stack(
-                [r.b for r in chunk]
-                + [np.zeros_like(chunk[0].b)] * n_pad
-            )
-            x0s = np.stack(
-                [r.x0 for r in chunk]
-                + [np.zeros_like(chunk[0].x0)] * n_pad
-            )
-        fn = self._compiled_fn(entry, Bb)
-        t0 = time.perf_counter()
-        with trace_range("serve_batch_execute"), \
-                self.metrics.profile.phase("execute"):
-            res = fn(
-                entry.template,
-                jnp.asarray(vals),
-                jnp.asarray(bs),
-                jnp.asarray(x0s),
-            )
-            res.x.block_until_ready()
-        dt = time.perf_counter() - t0
-        bucket_key = (pat.nb, pat.nnzb, Bb)
-        self.metrics.record_batch(bucket_key, dt, len(chunk), n_pad)
-        self.metrics.inc("solved", len(chunk))
-        self.metrics.inc("padded_elems", Bb * pat.nb)
-        self.metrics.inc(
-            "real_elems", sum(r.pattern.n for r in chunk)
+        accel = self._accel_for(pat)
+        entry = self.cache.peek(
+            pat.fingerprint, self.cfg_key, grp.dtype
         )
-        with self.metrics.profile.phase("unpack"):
-            # one device->host transfer per field, then numpy
-            # slicing (per-request device slices would cost ~6
-            # dispatches each and dominate small-system batches)
-            x_h = np.asarray(res.x)
-            iters_h = np.asarray(res.iters)
-            status_h = np.asarray(res.status)
-            fin_h = np.asarray(res.final_norm)
-            ini_h = np.asarray(res.initial_norm)
-            hist_h = np.asarray(res.history)
-            for i, r in enumerate(chunk):
-                r.ticket._result = dataclasses.replace(
-                    res,
-                    x=x_h[i, : r.pattern.n],
-                    iters=iters_h[i],
-                    status=status_h[i],
-                    final_norm=fin_h[i],
-                    initial_norm=ini_h[i],
-                    history=hist_h[i],
-                )
-                r.ticket._done = True
+        if grp.slot is None:
+            # the slot was already handed back (failure after a
+            # successful dispatch release): the staged coefficients
+            # are gone, so the requests cannot be re-solved
+            from amgx_tpu.core.errors import ResourceError
 
-    def _execute_sequential(self, entry: HierarchyEntry, grp: _Group):
-        """Fallback for solvers without a traced batch path."""
+            for r in grp.requests:
+                if not r.ticket._done:
+                    r.ticket._error = ResourceError(
+                        "serve group failed after its staging was "
+                        "released; request not recoverable"
+                    )
+                    r.ticket._done = True
+                    self.metrics.inc("poisoned_requests")
+            return
+        try:
+            for r in grp.requests:
+                if r.ticket._done:
+                    continue
+                vals = pat.extract_values(grp.slot.vals[r.row])
+                b = grp.slot.bs[r.row]
+                x0 = grp.slot.x0s[r.row]
+                try:
+                    with self.metrics.profile.phase("quarantine"):
+                        res = None
+                        if entry is not None:
+                            try:
+                                A = pat.template_matrix(
+                                    vals, grp.dtype, accel_formats=accel
+                                )
+                                # the cached template solver is shared
+                                # mutable state: serialize its
+                                # resetup+solve pair
+                                with entry.solver_lock:
+                                    entry.solver.resetup(A)
+                                    res = entry.solver.solve(b, x0=x0)
+                                self.metrics.inc(
+                                    "quarantine_entry_reuses"
+                                )
+                            except BaseException:  # noqa: BLE001
+                                res = None  # isolated setup decides
+                        if res is None:
+                            A = pat.template_matrix(
+                                vals, grp.dtype, accel_formats=accel
+                            )
+                            solver = make_nested(
+                                create_solver(self.cfg, "default")
+                            )
+                            solver.setup(A)
+                            res = solver.solve(b, x0=x0)
+                except BaseException as e:  # noqa: BLE001 — per-request
+                    r.ticket._error = e
+                    r.ticket._done = True
+                    self.metrics.inc("poisoned_requests")
+                else:
+                    r.ticket._result = dataclasses.replace(
+                        res, x=res.x[: pat.n]
+                    )
+                    r.ticket._done = True
+                    self.metrics.inc("quarantined_solves")
+                    self.metrics.inc("solved")
+        finally:
+            self._release_group_slot(grp)
+
+    def _execute_sequential(self, entry: HierarchyEntry, grp: _Group,
+                            live: list):
+        """Fallback for solvers without a traced batch path.  The slot
+        is released only on full success — a mid-loop failure keeps the
+        rows staged so the quarantine path can re-solve them (it owns
+        the release then)."""
         pat = grp.pattern
-        for r in grp.requests:
+        for r in live:
             with self.metrics.profile.phase("fallback"):
+                vals = pat.extract_values(grp.slot.vals[r.row])
                 A = pat.template_matrix(
-                    pat.extract_values(r.values),
+                    vals,
                     grp.dtype,
                     accel_formats=self._accel_for(pat),
                 )
-                entry.solver.resetup(A)
-                res = entry.solver.solve(r.b, x0=r.x0)
+                with entry.solver_lock:
+                    entry.solver.resetup(A)
+                    res = entry.solver.solve(
+                        grp.slot.bs[r.row],
+                        x0=grp.slot.x0s[r.row],
+                        block=False,
+                    )
             r.ticket._result = dataclasses.replace(
                 res, x=res.x[: pat.n]
             )
             r.ticket._done = True
             self.metrics.inc("fallback_solves")
             self.metrics.inc("solved")
+        self._release_group_slot(grp)

@@ -66,6 +66,21 @@ DIVERGED = 2  # rel_div_tolerance exceeded
 NOT_CONVERGED = 3
 
 
+def donation_enabled() -> bool:
+    """Buffer-donation default for jitted solve entry points.  ON for
+    accelerator backends — donating x0 lets XLA alias the solution
+    output onto it, saving an HBM buffer per solve.  OFF on CPU, where
+    donation measurably serializes the otherwise-async XLA dispatch
+    (~2ms blocking call vs ~0.3ms, see doc/SERVING.md) and buys
+    nothing.  ``AMGX_TPU_DONATE=1/0`` overrides either way."""
+    import os
+
+    v = os.environ.get("AMGX_TPU_DONATE")
+    if v is not None:
+        return v != "0"
+    return jax.default_backend() != "cpu"
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class SolveResult:
@@ -130,6 +145,10 @@ class Solver:
         self._jit_cache: dict = {}
         self.setup_time = 0.0
         self.solve_time = 0.0
+        # compile-vs-execute split (PR 3): lifetime compile seconds and
+        # the compile cost of the LAST solve() call (0 on warm calls)
+        self.compile_time = 0.0
+        self.last_compile_s = 0.0
 
     # ------------------------------------------------------------------
     # overridables
@@ -475,10 +494,30 @@ class Solver:
     def apply_params(self):
         return self._params
 
-    def solve(self, b, x0=None, zero_initial_guess=False) -> SolveResult:
+    def solve(self, b, x0=None, zero_initial_guess=False,
+              block=True) -> SolveResult:
+        """Monitored solve.  ``block=False`` is the async mode (PR 3):
+        the call returns right after the device dispatch with a
+        SolveResult backed by on-device arrays — status / iterations /
+        history materialize lazily when first read — and performs no
+        host sync of its own.  Sync-requiring features (solve stats
+        printing, obtain_timings, convergence analysis; a triggered
+        retry) still synchronize even with ``block=False``.
+
+        Buffer donation: when this call OWNS the initial-guess buffer
+        (x0 omitted, zero_initial_guess, a host array, or a
+        scaled/reordered copy), the jitted solve donates it
+        (``donate_argnums``) so XLA writes the solution in place.  A
+        caller-owned device x0 is never donated — that aliasing caveat
+        is the one documented in doc/SERVING.md."""
         if self.A is None:
             raise RuntimeError("solve() before setup()")
         b = jnp.asarray(b)
+        donate = (
+            x0 is None
+            or zero_initial_guess
+            or not isinstance(x0, jax.Array)
+        ) and donation_enabled()
         if x0 is None or zero_initial_guess:
             x0 = jnp.zeros_like(b)
         else:
@@ -487,15 +526,19 @@ class Solver:
             r_s, c_s = self._scale_vecs
             b = r_s * b
             x0 = x0 / jnp.where(c_s != 0, c_s, 1.0)
+            # the scaled x0 is a fresh array we own
+            donate = donation_enabled()
         if self._reorder is not None:
             perm, _ = self._reorder
             b = b[perm]
             x0 = x0[perm]
-        key = (b.shape, b.dtype.name)
+            donate = donation_enabled()  # likewise the permuted copy
+        key = (b.shape, b.dtype.name, x0.dtype.name, donate)
         fn = self._jit_cache.get(key)
         if fn is None:
-            fn = jax.jit(self.make_solve())
-            self._jit_cache[key] = fn
+            fn = self._compile_solve(key, b, x0, donate)
+        else:
+            self.last_compile_s = 0.0
         t0 = time.perf_counter()
         self.solve_retries_used = 0
         res = fn(self.apply_params(), b, x0)
@@ -505,7 +548,15 @@ class Solver:
             res = dataclasses.replace(res, x=res.x[self._reorder[1]])
         if self._scale_vecs is not None:
             res = dataclasses.replace(res, x=self._scale_vecs[1] * res.x)
-        res.x.block_until_ready()
+        # async mode skips the device sync unless a reporting feature
+        # needs concrete numbers anyway
+        if (
+            block
+            or self.print_solve_stats
+            or self.obtain_timings
+            or self.convergence_analysis > 0
+        ):
+            res.x.block_until_ready()
         self.solve_time = time.perf_counter() - t0
         if self.print_solve_stats and self.verbosity > 2:
             self._print_stats(res)
@@ -518,9 +569,14 @@ class Solver:
         if self.convergence_analysis > 0 and res.history is not None:
             self._print_convergence_analysis(res)
         if self.obtain_timings:
+            # compile reported SEPARATELY from solve: the first call's
+            # jit tracing/compilation is a one-off cost and folding it
+            # into solve seconds misstates per-iteration cost (warm
+            # calls report compile: 0)
             emit(
-                f"Total Time: {self.setup_time + self.solve_time:10.6f}\n"
+                f"Total Time: {self.setup_time + self.last_compile_s + self.solve_time:10.6f}\n"
                 f"    setup: {self.setup_time:10.6f} s\n"
+                f"    compile: {self.last_compile_s:10.6f} s\n"
                 f"    solve: {self.solve_time:10.6f} s\n"
                 f"    solve(per iteration): "
                 f"{self.solve_time / max(1, int(res.iters)):10.6f} s"
@@ -535,6 +591,25 @@ class Solver:
                 )
         return res
 
+    def _compile_solve(self, key, b, x0, donate):
+        """AOT-compile the jitted solve for this signature, timing the
+        compile separately from execution (``last_compile_s`` /
+        ``compile_time``); falls back to the tracing jit wrapper when
+        AOT rejects the params pytree."""
+        t0 = time.perf_counter()
+        jitted = jax.jit(
+            self.make_solve(),
+            donate_argnums=(2,) if donate else (),
+        )
+        try:
+            fn = jitted.lower(self.apply_params(), b, x0).compile()
+        except Exception:
+            fn = jitted
+        self._jit_cache[key] = fn
+        self.last_compile_s = time.perf_counter() - t0
+        self.compile_time += self.last_compile_s
+        return fn
+
     # result-status preference order for the retry hook: a retry's
     # outcome replaces the original only when strictly better
     _STATUS_RANK = {FAILED: 0, DIVERGED: 1, NOT_CONVERGED: 2, SUCCESS: 3}
@@ -543,15 +618,19 @@ class Solver:
         """Retry-with-safer-config recovery hook (``solve_retries``).
 
         A FAILED/DIVERGED solve retries up to ``solve_retries`` times,
-        each attempt evicting the possibly-defective compiled
-        executable (a fresh trace escapes spent fault injections and
-        any trace-level corruption) and restarting from a zero initial
-        guess.  The first retry keeps the configuration — it targets
-        transient/trace corruption; further retries halve the
-        relaxation factor each time (under-relaxation is the classic
-        safer setting for stationary/smoothed iterations) — they
-        target genuine divergence.  The best result by status wins;
-        healthy solves pay only one scalar status sync."""
+        each attempt evicting the possibly-defective MAIN executable (a
+        fresh trace escapes spent fault injections and any trace-level
+        corruption) and restarting from a zero initial guess.  The
+        first retry keeps the configuration — it targets transient/
+        trace corruption; further retries halve the relaxation factor
+        each time (under-relaxation is the classic safer setting for
+        stationary/smoothed iterations) — they target genuine
+        divergence.  Retry executables are cached under their own
+        (key, attempt) slot: the first failing solve traces them fresh
+        (that's the corruption escape), repeated failing solves reuse
+        the clean trace instead of paying a recompile per retry.  The
+        best result by status wins; healthy solves pay only one scalar
+        status sync."""
         attempt = 0
         while (
             attempt < self.solve_retries
@@ -560,12 +639,16 @@ class Solver:
             attempt += 1
             self.solve_retries_used = attempt
             self._jit_cache.pop(key, None)
-            old_omega = self.relaxation_factor
-            self.relaxation_factor = old_omega * 0.5 ** (attempt - 1)
-            try:
-                fn = jax.jit(self.make_solve())
-            finally:
-                self.relaxation_factor = old_omega
+            rkey = ("retry", key, attempt)
+            fn = self._jit_cache.get(rkey)
+            if fn is None:
+                old_omega = self.relaxation_factor
+                self.relaxation_factor = old_omega * 0.5 ** (attempt - 1)
+                try:
+                    fn = jax.jit(self.make_solve())
+                finally:
+                    self.relaxation_factor = old_omega
+                self._jit_cache[rkey] = fn
             retry = fn(self.apply_params(), b, jnp.zeros_like(b))
             if self._STATUS_RANK.get(int(retry.status), 0) > \
                     self._STATUS_RANK.get(int(res.status), 0):

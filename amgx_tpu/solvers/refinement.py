@@ -138,11 +138,13 @@ class IterativeRefinementSolver(Solver):
 
         return solve
 
-    def solve(self, b, x0=None, zero_initial_guess=False):
+    def solve(self, b, x0=None, zero_initial_guess=False, block=True):
         """Pair-preserving solve: the hi/lo parts are combined in f64
         on HOST, so the returned x carries the refined accuracy even
         when the device works in f32.  Mirrors the base solve's
-        scaling/stats handling (base.py Solver.solve)."""
+        scaling/stats handling (base.py Solver.solve).  ``block`` is
+        accepted for interface parity with the base async mode but
+        ignored: the host-side hi/lo combine forces a sync anyway."""
         if self.A is None:
             raise RuntimeError("solve() before setup()")
         b = jnp.asarray(b)

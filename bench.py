@@ -193,9 +193,14 @@ def _serve_record():
                 "problem",
                 "batched_solves_per_s",
                 "sequential_solves_per_s",
+                "ticket_p50_s",
+                "ticket_p99_s",
+                "overlap_ratio",
+                "host_syncs_per_group",
                 "bucket_hit_rate",
                 "pad_waste_frac",
             )
+            if k in rec
         }
     except Exception as e:  # noqa: BLE001
         print(f"bench: serve record skipped: {e}", file=sys.stderr)

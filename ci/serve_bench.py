@@ -1,4 +1,5 @@
-"""Serve-layer throughput: batched vs sequential-Python-loop solves.
+"""Serve-layer throughput: pipelined batched solves vs a sequential
+Python loop.
 
 Prints ONE JSON line (same contract as bench.py / BENCH_*.json):
 {"metric": "serve_batched_speedup", "value": <x>, ...} — value is the
@@ -11,9 +12,19 @@ Run on the CPU backend (the tier the acceptance gate measures):
 
     JAX_PLATFORMS=cpu python ci/serve_bench.py [--out BENCH_serve.json]
 
-Methodology: B pattern-sharing Jacobi-PCG Poisson systems, warm-up
-call excluded (compile + setup amortize across a service's lifetime,
-which is the serving scenario), best-of-3 timed repetitions.
+Methodology: B pattern-sharing Jacobi-PCG Poisson systems per group;
+each timed cycle submits a full group (dispatch is non-blocking — the
+async pipeline, PR 3) and consumes the tickets through their single
+shared per-group fetch.  ``waves`` cycles per rep, best cycle of
+``reps`` reps reported (the same submit+consume unit the PR 2 record
+measured, so the throughput numbers are directly comparable).
+Warm-up excluded (setup + compile amortize across a service's
+lifetime, which is the serving scenario).  Alongside throughput the
+record carries the new latency observability: steady-state per-ticket
+p50/p99 and the host/device overlap ratio
+((host_busy + device_busy - wall) / min(host_busy, device_busy),
+clamped to [0, 1] — 0 means fully serialized stages, 1 means the
+shorter side completely hidden).
 """
 
 import argparse
@@ -25,7 +36,7 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def run(shape=(16, 16), batch=16, reps=3, config=None):
+def run(shape=(16, 16), batch=16, reps=3, waves=8, config=None):
     import jax
     import numpy as np
 
@@ -40,14 +51,35 @@ def run(shape=(16, 16), batch=16, reps=3, config=None):
     systems = jittered_poisson_family(shape, batch, seed=0)
     n = systems[0][0].shape[0]
 
-    # ---- batched service path --------------------------------------
+    # ---- batched service path (pipelined stream) -------------------
     svc = BatchedSolveService(config=config, max_batch=batch)
-    svc.solve_many(systems)  # warm-up: setup + compile
+    svc.solve_many(systems)  # warm-up: setup + compile + first fetch
+    svc.metrics.reset_latency()  # steady-state latency window only
     t_batch = float("inf")
+    wall_total = 0.0
+    results = None
     for _ in range(reps):
-        t0 = time.perf_counter()
-        results = svc.solve_many(systems)
-        t_batch = min(t_batch, time.perf_counter() - t0)
+        for _w in range(waves):
+            t0 = time.perf_counter()
+            # the full group dispatches at max_batch (non-blocking);
+            # ticket.result() runs the one shared fetch
+            tickets = [svc.submit(sp, b) for sp, b in systems]
+            results = [t.result() for t in tickets]
+            cycle = time.perf_counter() - t0
+            wall_total += cycle
+            t_batch = min(t_batch, cycle)
+
+    m = svc.metrics.snapshot()
+    host_busy = m.get("host_busy_s", 0.0)
+    device_busy = m.get("device_busy_s", 0.0)
+    # overlap over the whole steady window (all reps ran back to back)
+    overlap = 0.0
+    if host_busy > 0 and device_busy > 0:
+        tot_wall = max(wall_total, max(host_busy, device_busy))
+        overlap = (host_busy + device_busy - tot_wall) / min(
+            host_busy, device_busy
+        )
+        overlap = max(0.0, min(1.0, overlap))
 
     # ---- sequential Python loop baseline ---------------------------
     # strongest honest loop: setup and compiles OUTSIDE the loop (one
@@ -90,7 +122,6 @@ def run(shape=(16, 16), batch=16, reps=3, config=None):
         err = np.linalg.norm(xa - xb) / max(np.linalg.norm(xb), 1e-300)
         assert err < 1e-8, f"batched/sequential diverged: {err}"
 
-    m = svc.metrics.snapshot()
     dev = jax.devices()[0]
     return {
         "metric": "serve_batched_speedup",
@@ -102,10 +133,17 @@ def run(shape=(16, 16), batch=16, reps=3, config=None):
         "config": "PCG+BLOCK_JACOBI",
         "n": n,
         "batch": batch,
+        "waves": waves,
         "t_batched_s": round(t_batch, 5),
         "t_sequential_s": round(t_seq, 5),
         "batched_solves_per_s": round(batch / t_batch, 1),
         "sequential_solves_per_s": round(batch / t_seq, 1),
+        "ticket_p50_s": round(m["ticket_p50_s"], 6),
+        "ticket_p99_s": round(m["ticket_p99_s"], 6),
+        "overlap_ratio": round(overlap, 3),
+        "host_syncs_per_group": round(
+            m.get("host_syncs", 0) / max(m.get("batches", 1), 1), 3
+        ),
         "bucket_hit_rate": round(m["bucket_hit_rate"], 3),
         "pad_waste_frac": round(m.get("pad_waste_frac", 0.0), 3),
         "compiles": m.get("compiles", 0),
@@ -120,6 +158,8 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--side", type=int, default=16,
                     help="2D Poisson side length")
+    ap.add_argument("--waves", type=int, default=8,
+                    help="groups per timed stream")
     args = ap.parse_args(argv)
 
     import amgx_tpu
@@ -131,20 +171,36 @@ def main(argv=None):
         # f64 end-to-end on CPU (the tier-1 configuration): the
         # batched-vs-sequential parity check is exact there
         jax.config.update("jax_enable_x64", True)
-    rec = run(shape=(args.side, args.side), batch=args.batch)
+    rec = run(shape=(args.side, args.side), batch=args.batch,
+              waves=args.waves)
     line = json.dumps(rec)
     print(line)
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
+    ok = True
     if rec["value"] < 3.0:
         print(
             f"serve_bench: speedup {rec['value']}x below the 3x "
             "acceptance floor",
             file=sys.stderr,
         )
-        return 1
-    return 0
+        ok = False
+    if not (0 < rec["ticket_p50_s"] <= rec["ticket_p99_s"]):
+        print(
+            "serve_bench: latency percentiles missing/incoherent: "
+            f"p50={rec['ticket_p50_s']} p99={rec['ticket_p99_s']}",
+            file=sys.stderr,
+        )
+        ok = False
+    if rec["host_syncs_per_group"] > 1.0:
+        print(
+            "serve_bench: steady state exceeded one host sync per "
+            f"group ({rec['host_syncs_per_group']})",
+            file=sys.stderr,
+        )
+        ok = False
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -14,6 +14,13 @@ python -m pytest tests/ -q -m slow
 # recovery-path regression fails CI here before the bench runs.
 JAX_PLATFORMS=cpu python ci/fault_smoke.py
 
+# ---- serve pipeline: throughput + latency floors ---------------------
+# One JSON line; non-zero exit when batched speedup drops below the 3x
+# floor, the per-ticket p50/p99 latency fields are missing/incoherent,
+# or the steady state exceeds one host sync per group (async-pipeline
+# regression).
+JAX_PLATFORMS=cpu python ci/serve_bench.py
+
 # ---- native C ABI (VERDICT r4 #9) -----------------------------------
 # Build from source and run both demos on CPU; assert exit 0 and the
 # expected iteration count from the reference README sample (1 iter).

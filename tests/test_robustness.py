@@ -523,3 +523,65 @@ def test_serve_deadline_expires_only_late_ticket():
         t_late.result()
     assert int(t_ok.result().status) == 0
     assert svc.metrics.get("deadline_expired") == 1
+
+
+def test_serve_quarantine_reuses_cached_hierarchy(monkeypatch):
+    """A group failure AFTER a healthy hierarchy build re-solves its
+    members through the CACHED entry (values-only resetup) instead of
+    re-deriving a full per-request setup (PR 3 satellite)."""
+    from amgx_tpu.serve import BatchedSolveService
+    from amgx_tpu.serve.cache import CompileCache
+
+    sp = _poisson_csr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(3)
+    svc = BatchedSolveService(max_batch=4)
+    systems = [(sp, rng.standard_normal(n)) for _ in range(3)]
+    res = svc.solve_many(systems)  # healthy: hierarchy entry cached
+    assert all(int(r.status) == 0 for r in res)
+    setups = svc.metrics.get("setups")
+
+    def boom(self, entry, Bb):
+        raise RuntimeError("injected compile-path failure")
+
+    monkeypatch.setattr(CompileCache, "get", boom)
+    tickets = [
+        svc.submit(sp, rng.standard_normal(n)) for _ in range(3)
+    ]
+    svc.flush()
+    for t in tickets:
+        assert int(t.result().status) == 0
+    assert svc.metrics.get("quarantines") == 1
+    assert svc.metrics.get("quarantine_entry_reuses") == 3
+    assert svc.metrics.get("setups") == setups  # no re-derivation
+
+
+def test_retry_executable_cached_across_solves():
+    """solve_retries recovery: the retry executable is traced once and
+    cached under its own (key, attempt) slot — a later failing solve
+    reuses it instead of recompiling (PR 3 satellite)."""
+    # Jacobi on an off-diagonally dominant system diverges fast
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "BLOCK_JACOBI", "monitor_residual": 1,'
+        ' "tolerance": 1e-10, "convergence": "RELATIVE_INI",'
+        ' "max_iters": 40, "relaxation_factor": 1.0,'
+        ' "rel_div_tolerance": 10.0, "solve_retries": 1}}'
+    )
+    A = sps.csr_matrix(
+        np.array([[1.0, 3.0], [3.0, 1.0]])
+    )
+    s = create_solver(cfg, "default")
+    s.setup(SparseMatrix.from_scipy(A))
+    b = np.ones(2)
+    s.solve(b)
+    assert s.solve_retries_used == 1
+    rkeys = [
+        k for k in s._jit_cache
+        if isinstance(k, tuple) and k and k[0] == "retry"
+    ]
+    assert len(rkeys) == 1
+    fn1 = s._jit_cache[rkeys[0]]
+    s.solve(b)  # fails again -> retries again
+    assert s.solve_retries_used == 1
+    assert s._jit_cache[rkeys[0]] is fn1  # cached, not recompiled

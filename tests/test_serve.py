@@ -342,6 +342,206 @@ def test_ticket_result_flushes_lazily():
     assert t.done() and int(res.status) == 0
 
 
+# ---------------------------------------------------------------------
+# async pipeline (PR 3): non-blocking dispatch, donation, latency
+
+
+def test_async_ticket_done_without_blocking(monkeypatch):
+    """done() flips at DISPATCH — before any host sync — and result()
+    works in any order; the whole group shares exactly ONE blocking
+    fetch."""
+    from amgx_tpu.serve import service as service_mod
+
+    waits, gets = [], []
+    real_block = service_mod._block_ready
+    real_get = service_mod._fetch_host
+    monkeypatch.setattr(
+        service_mod, "_block_ready",
+        lambda x: (waits.append(1), real_block(x))[1],
+    )
+    monkeypatch.setattr(
+        service_mod, "_fetch_host",
+        lambda t: (gets.append(1), real_get(t))[1],
+    )
+    systems = _poisson_family((10, 10), 4, seed=22)
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=4)
+    tickets = [svc.submit(sp, b) for sp, b in systems]
+    # the 4th submit hit max_batch and dispatched the group
+    assert all(t.done() for t in tickets)
+    assert not waits and not gets  # dispatched, nothing fetched yet
+    refs = _sequential_reference(PCG_JACOBI, systems)
+    # consume in REVERSE submission order: per-ticket results must not
+    # depend on fetch order
+    for t, ref in zip(reversed(tickets), reversed(refs)):
+        r = t.result()
+        assert int(r.status) == 0
+        assert int(r.iters) == int(ref.iters)
+        np.testing.assert_allclose(
+            np.asarray(r.x), np.asarray(ref.x), rtol=0, atol=1e-12
+        )
+    assert len(waits) == 1 and len(gets) == 1  # ONE sync, shared
+
+
+def test_steady_state_one_host_sync_per_group(monkeypatch):
+    """Regression for the pipeline contract: a steady-state
+    submit+flush cycle performs exactly one blocking device fetch per
+    group, inside SolveTicket.result() — nowhere else."""
+    from amgx_tpu.serve import service as service_mod
+
+    systems = _poisson_family((10, 10), 8, seed=23)
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=8)
+    svc.solve_many(systems)  # warm: setup + compile + first fetch
+    assert svc.metrics.get("host_syncs") == 1
+    calls = {"block": 0, "get": 0}
+    real_block = service_mod._block_ready
+    real_get = service_mod._fetch_host
+
+    def counting_block(x):
+        calls["block"] += 1
+        return real_block(x)
+
+    def counting_get(t):
+        calls["get"] += 1
+        return real_get(t)
+
+    monkeypatch.setattr(service_mod, "_block_ready", counting_block)
+    monkeypatch.setattr(service_mod, "_fetch_host", counting_get)
+    for _ in range(3):
+        res = svc.solve_many(systems)
+        assert all(int(r.status) == 0 for r in res)
+    assert calls["block"] == 3 and calls["get"] == 3
+    assert svc.metrics.get("host_syncs") == 4
+
+
+def test_donation_invalidates_and_matches():
+    """Acceptance: donation verified.  (a) results are bit-identical
+    with donation forced on vs off; (b) the donated x0 device buffer
+    is invalidated after dispatch."""
+    import jax.numpy as jnp
+
+    systems = _poisson_family((10, 10), 4, seed=21)
+    svc_on = BatchedSolveService(
+        config=PCG_JACOBI, max_batch=8, donate=True
+    )
+    svc_off = BatchedSolveService(
+        config=PCG_JACOBI, max_batch=8, donate=False
+    )
+    res_on = svc_on.solve_many(systems)
+    res_off = svc_off.solve_many(systems)
+    for a, b in zip(res_on, res_off):
+        assert int(a.iters) == int(b.iters)
+        np.testing.assert_array_equal(np.asarray(a.x), np.asarray(b.x))
+    # unit-level invalidation on the donating service's executable
+    (sig, Bb), fn = next(iter(svc_on.compile_cache._fns.items()))
+    entry = next(iter(svc_on.cache._entries.values()))
+    pat = entry.pattern
+    dt = entry.solver.A.values.dtype
+    vals = np.stack(
+        [pat.embed_values(systems[0][0].data, dtype=dt)] * Bb
+    )
+    bs = np.stack([pat.embed_vector(systems[0][1], dt)] * Bb)
+    x0_d = jnp.zeros((Bb, pat.nb), dt)
+    out = fn(entry.template, jnp.asarray(vals), jnp.asarray(bs), x0_d)
+    out.x.block_until_ready()
+    with pytest.raises(RuntimeError):
+        np.asarray(x0_d)  # donated buffer must be deleted
+
+
+def test_latency_breakdown_populated():
+    """Per-ticket queue→pad→dispatch→device→fetch reservoirs fill, and
+    the p50/p99 convenience keys are coherent."""
+    systems = _poisson_family((10, 10), 6, seed=24)
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=8)
+    res = svc.solve_many(systems)
+    assert all(int(r.status) == 0 for r in res)
+    m = svc.metrics.snapshot()
+    lat = m["latency"]
+    for stage in ("queue", "pad", "dispatch", "device", "fetch",
+                  "total"):
+        assert lat[stage]["count"] == 6, stage
+    assert m["ticket_p99_s"] >= m["ticket_p50_s"] > 0.0
+    assert m["device_busy_s"] > 0.0
+    assert m["host_busy_s"] > 0.0
+    assert m["host_syncs"] == 1
+
+
+def test_solver_async_mode_matches_blocking():
+    """Solver.solve(block=False) returns device-backed results without
+    a host sync of its own; values match the blocking solve."""
+    (sp, b), = _poisson_family((10, 10), 1, seed=25)
+    cfg = AMGConfig.from_string(PCG_JACOBI)
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(sp))
+    r_async = s.solve(b, block=False)
+    r_block = s.solve(b)
+    assert int(r_async.status) == 0
+    assert int(r_async.iters) == int(r_block.iters)
+    np.testing.assert_array_equal(
+        np.asarray(r_async.x), np.asarray(r_block.x)
+    )
+
+
+def test_solver_donation_env_override(monkeypatch):
+    """AMGX_TPU_DONATE=1 forces solver-level x0 donation on CPU;
+    repeat solves stay correct (each call owns a fresh x0 buffer) and
+    a caller-owned device x0 is NOT donated."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("AMGX_TPU_DONATE", "1")
+    (sp, b), = _poisson_family((10, 10), 1, seed=26)
+    cfg = AMGConfig.from_string(PCG_JACOBI)
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(sp))
+    r1 = s.solve(b)
+    r2 = s.solve(b)
+    np.testing.assert_array_equal(np.asarray(r1.x), np.asarray(r2.x))
+    # caller-owned device x0 survives the solve (never donated)
+    x0 = jnp.zeros(sp.shape[0], dtype=np.asarray(b).dtype)
+    s.solve(b, x0=x0)
+    np.asarray(x0)  # would raise RuntimeError if donated
+
+
+def test_compile_time_split():
+    """First solve reports its jit compile separately (last_compile_s
+    > 0); warm calls report 0 — solve_time is execute-only."""
+    (sp, b), = _poisson_family((10, 10), 1, seed=27)
+    cfg = AMGConfig.from_string(PCG_JACOBI)
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(sp))
+    s.solve(b)
+    assert s.last_compile_s > 0.0
+    assert s.compile_time >= s.last_compile_s
+    s.solve(b)
+    assert s.last_compile_s == 0.0
+    assert s.compile_time > 0.0
+
+
+def test_prewarm_eliminates_cold_start():
+    """prewarm(A) builds the hierarchy and compiles the batched solve
+    in the background; the first real flush is then cache hits only."""
+    import time as _time
+
+    systems = _poisson_family((10, 10), 4, seed=28)
+    svc = BatchedSolveService(config=PCG_JACOBI, max_batch=4)
+    svc.prewarm(systems[0][0], batch=4)
+    deadline = _time.monotonic() + 60.0
+    while (
+        svc.metrics.get("prewarms") + svc.metrics.get("prewarm_failures")
+        < 1 or len(svc.compile_cache) < 1
+    ):
+        assert _time.monotonic() < deadline, "prewarm never finished"
+        _time.sleep(0.01)
+    assert svc.metrics.get("prewarm_failures") == 0
+    setups = svc.metrics.get("setups")
+    compiles = svc.metrics.get("compiles")
+    res = svc.solve_many(systems)
+    assert all(int(r.status) == 0 for r in res)
+    m = svc.metrics.snapshot()
+    assert m["setups"] == setups  # no setup on the serving path
+    assert m["compiles"] == compiles  # no compile on the serving path
+    assert m["bucket_hits"] >= 1
+
+
 def test_capi_solver_solve_batch():
     from amgx_tpu.api import capi
 
@@ -364,10 +564,15 @@ def test_capi_solver_solve_batch():
         rhs.append(rh)
         shs.append(sh)
     assert capi.solver_solve_batch(slv_h, mhs, rhs, shs) == capi.RC_OK
+    # non-blocking C ABI: the call returned at dispatch; results drain
+    # on the first accessor below
+    s = capi._get(slv_h, capi._SolverHandle)
+    assert s.batch_pending is not None
     for i, (sp, b) in enumerate(systems):
         assert capi.solver_get_batch_status(slv_h, i) == 0
         assert capi.solver_get_batch_iterations_number(slv_h, i) > 0
         x = capi.vector_download(shs[i])
         assert np.linalg.norm(b - sp @ x) < 1e-6 * np.linalg.norm(b)
+    assert s.batch_pending is None  # drained by the accessors
     m = capi.solver_get_batch_metrics(slv_h)
     assert m["batches"] == 1 and m["solved"] == 4
