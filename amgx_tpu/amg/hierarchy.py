@@ -121,6 +121,15 @@ class AMGSolver(Solver):
         # classical pipeline runs (amg/device_setup.py); empty for the
         # host path.  Keys: host_s, device_s, syncs.
         self.setup_profile: dict = {}
+        # setup-phase counters (amgx_tpu.store assertion surface):
+        # coarsen_calls/levels_built count the expensive hierarchy
+        # construction; a store restore leaves both at 0 and flips
+        # restored — "restore skips setup" is checkable, not vibes
+        self.setup_stats: dict = {
+            "coarsen_calls": 0,
+            "levels_built": 0,
+            "restored": False,
+        }
 
     # ------------------------------------------------------------------
     # setup (reference AMG_Setup::setup, amg.cu:147-418)
@@ -199,9 +208,14 @@ class AMGSolver(Solver):
 
         return build_classical_level(Asp, self.cfg, self.scope, level_id)
 
-    def _make_smoother(self, A: SparseMatrix) -> Solver:
+    def _new_smoother(self) -> Solver:
+        """Un-set-up smoother instance for this config (the restore
+        path sets it up by state import instead of ``setup``)."""
         name, sscope = self.cfg.get_scoped("smoother", self.scope)
-        sm = make_nested(SolverRegistry.get(name)(self.cfg, sscope))
+        return make_nested(SolverRegistry.get(name)(self.cfg, sscope))
+
+    def _make_smoother(self, A: SparseMatrix) -> Solver:
+        sm = self._new_smoother()
         sm.setup(A)
         return sm
 
@@ -229,6 +243,7 @@ class AMGSolver(Solver):
     def _coarsen_from(self, Asp):
         """Extend ``self.levels`` by coarsening from the last level
         (whose host CSR is ``Asp``) until a stop condition hits."""
+        self.setup_stats["coarsen_calls"] += 1
         # reference amg.cu:207-230: when the coarse solver is dense LU,
         # coarsening stops once the level fits the dense trigger size
         coarse_name, _ = self.cfg.get_scoped("coarse_solver", self.scope)
@@ -265,6 +280,7 @@ class AMGSolver(Solver):
             self.levels.append(
                 AMGLevel(SparseMatrix.from_scipy(Ac), len(self.levels))
             )
+            self.setup_stats["levels_built"] += 1
             Asp = Ac
 
     @staticmethod
@@ -281,15 +297,20 @@ class AMGSolver(Solver):
         except ValueError:
             return None
 
-    def _finalize_setup(self):
-        # smoothers on all but the coarsest; coarse solver on the last
+    def _finalize_setup(self, reuse_smoothers: bool = False):
+        # smoothers on all but the coarsest; coarse solver on the last.
+        # reuse_smoothers (store-restore path ONLY): keep smoothers the
+        # importer already restored — setup/resetup must NOT pass it
+        # (their level values changed, so smoother params must rebuild)
         for lvl in self.levels[:-1]:
-            lvl.smoother = self._make_smoother(lvl.A)
+            if not (reuse_smoothers and lvl.smoother is not None):
+                lvl.smoother = self._make_smoother(lvl.A)
         coarsest = self.levels[-1]
         self.coarse_solver = self._make_coarse_solver(coarsest.A)
         if self.coarse_solver is None and len(self.levels) > 0:
             # coarsest-level smoothing fallback (coarse_solver=NOSOLVER)
-            coarsest.smoother = self._make_smoother(coarsest.A)
+            if not (reuse_smoothers and coarsest.smoother is not None):
+                coarsest.smoother = self._make_smoother(coarsest.A)
 
         self._params = self._collect_params()
         # reference solver.cu:541-546: grid stats and vis data print
@@ -337,6 +358,62 @@ class AMGSolver(Solver):
             self._coarsen_from(self.levels[i].A.to_scipy())
         self._finalize_setup()
         return True
+
+    # ------------------------------------------------------------------
+    # setup persistence (amgx_tpu.store): the hierarchy IS the setup —
+    # persist the level chain (operators, transfers, Galerkin plans)
+    # and rebuild only the cheap derived state (smoothers, coarse LU)
+    # at import.  Smoother/coarse params re-derive deterministically
+    # from the bitwise-identical persisted level operators, so the
+    # restored solver's iteration counts match the original exactly.
+
+    def _export_impl(self):
+        if not self.levels:
+            return None
+        # per-level smoother state rides along so smoothers with
+        # non-trivial setup (Chebyshev spectrum estimation) restore
+        # instead of re-deriving; the smoother's operator is the
+        # level's (object-identity dedup stores it once).  Smoothers
+        # whose export fails (exotic state) fall back to re-derivation
+        # at import — same result, just not amortized.
+        levels = []
+        for lvl in self.levels:
+            sm = None
+            if lvl.smoother is not None:
+                try:
+                    sm = lvl.smoother._export_setup()
+                except Exception:  # noqa: BLE001 — re-derive at import
+                    sm = None
+            levels.append({
+                "A": lvl.A,
+                "P": lvl.P,
+                "R": lvl.R,
+                "plan": lvl.rap_plan,
+                "smoother": sm,
+            })
+        return {"levels": levels}
+
+    def _import_impl(self, impl):
+        if not impl or not impl.get("levels"):
+            return self._setup_impl(self.A)
+        self.levels = []
+        for state in impl["levels"]:
+            lvl = AMGLevel(state["A"], len(self.levels))
+            lvl.P = state.get("P")
+            lvl.R = state.get("R")
+            lvl.rap_plan = state.get("plan")
+            sm_state = state.get("smoother")
+            if sm_state is not None:
+                try:
+                    sm = self._new_smoother()
+                    sm._import_setup(sm_state)
+                    lvl.smoother = sm
+                except Exception:  # noqa: BLE001 — finalize re-derives
+                    lvl.smoother = None
+            self.levels.append(lvl)
+        self.setup_profile = {}
+        self.setup_stats["restored"] = True
+        self._finalize_setup(reuse_smoothers=True)
 
     def make_batch_params(self):
         """Traced values-only hierarchy rebuild (the batched analogue

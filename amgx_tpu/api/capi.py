@@ -1285,6 +1285,51 @@ def solver_resetup(slv_h: int, mtx_h: int):
     return RC_OK
 
 
+@_traced
+def solver_save(slv_h: int, path: str):
+    """Persist a set-up solver's hierarchy/setup to ``path``
+    (AMGX_write_system-style persistence extended to the SETUP:
+    the reference can only persist the system, so every process
+    restart re-pays setup — solver_save/solver_load make the setup
+    itself durable).  Distributed solvers are not persistable."""
+    from amgx_tpu.solvers.base import Solver as _Solver
+
+    s = _get(slv_h, _SolverHandle)
+    if s.solver is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "solver not set up")
+    if not isinstance(s.solver, _Solver):
+        raise AMGXError(
+            RC_NOT_SUPPORTED_TARGET,
+            "distributed solvers are not persistable",
+        )
+    s.solver.save_setup(path)
+    return RC_OK
+
+
+@_traced
+def solver_load(slv_h: int, path: str):
+    """Restore a solver persisted with :func:`solver_save` into this
+    handle WITHOUT re-running setup.  The handle's config must match
+    the persisted one (content hash) and its mode's matrix dtype must
+    match the restored operator's — a mixed-precision hierarchy would
+    silently break the 'identical iteration counts' contract."""
+    from amgx_tpu.solvers.base import Solver as _Solver
+
+    s = _get(slv_h, _SolverHandle)
+    # settle any in-flight batch of the PRE-load solver first: its
+    # tickets still deliver to their vectors, but its statuses must
+    # not masquerade as results of the restored solver afterwards
+    _drain_batch(s)
+    # expect_dtype gates the persisted dtype BEFORE any device
+    # transfer and surfaces a mismatch as RC_BAD_MODE via _rc_guard
+    s.solver = _Solver.load_setup(
+        path, cfg=s.cfg.cfg, expect_dtype=s.mode.mat_dtype
+    )
+    s.result = None
+    s.batch_results = None
+    return RC_OK
+
+
 def solver_destroy(slv_h):
     _objects.pop(slv_h, None)
     return RC_OK

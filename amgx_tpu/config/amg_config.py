@@ -192,6 +192,60 @@ class AMGConfig:
     def items(self):
         return dict(self._values)
 
+    def content_hash(self, digest_size: int = 12) -> str:
+        """Stable content hash of the full config identity — the
+        config half of every hierarchy-reuse key (serve cache entries,
+        store manifests).  Process-independent: sorted items, repr'd
+        values.  Scope LINKS are part of the hash: two configs with
+        identical key/value maps but different sub-solver scope
+        resolution build different hierarchies and must never share a
+        persisted setup."""
+        import hashlib
+
+        items = sorted(
+            (str(scope), str(name), repr(value))
+            for (scope, name), value in self._values.items()
+        )
+        h = hashlib.blake2b(digest_size=digest_size)
+        for scope, name, value in items:
+            h.update(f"{scope}\0{name}\0{value}\1".encode())
+        for (scope, name), child in sorted(self._scope_links.items()):
+            h.update(f"L\0{scope}\0{name}\0{child}\1".encode())
+        return h.hexdigest()
+
+    # -- persistence (amgx_tpu.store manifests) ----------------------------
+
+    def to_state(self) -> dict:
+        """JSON-able snapshot of the full scoped key/value map (values
+        are str/int/float/bool by construction — ``_set`` type-checks
+        against the parameter registry)."""
+        return {
+            "values": [
+                [scope, name, value]
+                for (scope, name), value in sorted(self._values.items())
+            ],
+            "scope_links": [
+                [scope, name, child]
+                for (scope, name), child in sorted(
+                    self._scope_links.items()
+                )
+            ],
+            "auto_scope": self._auto_scope,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "AMGConfig":
+        """Inverse of :meth:`to_state`.  Values re-enter through
+        ``_set`` so an edited/corrupted manifest still gets the
+        registry's type and allowed-value checks."""
+        cfg = cls()
+        for scope, name, value in state.get("values", ()):
+            cfg._set(str(scope), str(name), value)
+        for scope, name, child in state.get("scope_links", ()):
+            cfg._scope_links[(str(scope), str(name))] = str(child)
+        cfg._auto_scope = int(state.get("auto_scope", 0))
+        return cfg
+
     def __repr__(self):
         return f"AMGConfig({len(self._values)} values)"
 

@@ -103,6 +103,16 @@ class ResourceError(AMGXTPUError):
     rc = RC_NO_MEMORY
 
 
+class StoreError(AMGXTPUError):
+    """Setup-artifact persistence failure (:mod:`amgx_tpu.store`):
+    unreadable/corrupt payload, schema mismatch, or a setup that
+    contains non-serializable state.  The artifact STORE never raises
+    this on reads — corrupt entries degrade to cache misses — but the
+    explicit ``save_setup``/``load_setup`` API surfaces it typed."""
+
+    rc = RC_IO_ERROR
+
+
 def rc_for_exception(e: BaseException) -> int:
     """AMGX_RC code for an arbitrary exception — the single catch-all
     mapping used at the C API boundary.  Typed taxonomy errors carry

@@ -200,6 +200,26 @@ class SparseMatrix:
             object.__setattr__(self, "_fingerprint_cache", fp)
         return fp
 
+    def setup_key(self) -> tuple:
+        """``(sparsity_fingerprint, dtype string)`` — the identity the
+        setup-artifact store (:mod:`amgx_tpu.store`) keys hierarchies
+        on.  The dtype half is always read LIVE from the value buffer
+        (never memoized): ``astype`` and value-swapping paths must not
+        be able to serve a stale dtype to the store."""
+        return self.fingerprint(), str(np.dtype(self.values.dtype))
+
+    def _propagate_structure_memo(self, new: "SparseMatrix"):
+        """Carry the memoized sparsity fingerprint onto a derived
+        matrix whose INDEX structure is identical (values-only
+        rebuilds).  Value-dependent memos must never ride along — only
+        the structure hash is copied, and only when one exists.
+        Traced-value twins (vmap/jit leaves) share the same structure,
+        so this is safe under transforms too."""
+        fp = getattr(self, "_fingerprint_cache", None)
+        if fp is not None:
+            object.__setattr__(new, "_fingerprint_cache", fp)
+        return new
+
     # ---- value updates (structure reuse) -------------------------------
 
     def replace_values(self, values, diag=None) -> "SparseMatrix":
@@ -242,7 +262,13 @@ class SparseMatrix:
             d = jnp.zeros_like(self.dense)
             d = d.at[self.row_ids, self.col_indices].add(values)
             new = dataclasses.replace(new, dense=d)
-        return new
+        # dataclasses.replace builds a FRESH object, so every memoized
+        # attribute is dropped by construction — value-dependent memos
+        # (setup_key dtype, store digests) can never go stale through a
+        # values-only rebuild.  The structure fingerprint alone is
+        # still valid (indices untouched) and is re-attached so
+        # resetup/serve paths don't rehash the pattern per swap.
+        return self._propagate_structure_memo(new)
 
     def astype(self, dtype) -> "SparseMatrix":
         rep = dict(
@@ -256,7 +282,12 @@ class SparseMatrix:
             rep["dia_vals"] = self.dia_vals.astype(dtype)
         if self.has_dense:
             rep["dense"] = self.dense.astype(dtype)
-        return dataclasses.replace(self, **rep)
+        # structure is unchanged (fingerprint excludes values/dtype);
+        # anything dtype-keyed is dropped with the fresh object and
+        # setup_key() re-reads the dtype live
+        return self._propagate_structure_memo(
+            dataclasses.replace(self, **rep)
+        )
 
     # ---- host conversions ----------------------------------------------
 

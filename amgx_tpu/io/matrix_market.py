@@ -28,9 +28,18 @@ class MatrixIOError(ValueError):
 
 
 def _parse_header(lines):
-    header = lines[0].strip().split()
+    header = lines[0].strip().split() if lines else []
     if not header or header[0] != "%%MatrixMarket":
-        raise MatrixIOError(f"bad MatrixMarket header: {lines[0]!r}")
+        raise MatrixIOError(
+            f"bad MatrixMarket header: {lines[0]!r}"
+            if lines
+            else "empty MatrixMarket file"
+        )
+    if len(header) < 5:
+        raise MatrixIOError(
+            f"short MatrixMarket header ({len(header)} tokens): "
+            f"{lines[0]!r}"
+        )
     field, sym = header[3].lower(), header[4].lower()
     flags = []
     i = 1
@@ -45,7 +54,12 @@ def _parse_header(lines):
 def _tokens_to_floats(body_lines):
     """One pass over whitespace-separated numeric tokens (C-level parse)."""
     blob = " ".join(body_lines)
-    return np.array(blob.split(), dtype=np.float64)
+    try:
+        return np.array(blob.split(), dtype=np.float64)
+    except ValueError as e:
+        raise MatrixIOError(
+            f"non-numeric token in MatrixMarket body: {e}"
+        ) from None
 
 
 _NVAMG_BIN_HEADER = b"%%NVAMGBinary\n"
@@ -56,13 +70,28 @@ def _read_system_binary(path):
     layout): header + 9 uint32 system flags, then CSR int32 offsets and
     columns and f64 values (external diagonal appended), then optional
     f64 rhs/solution."""
+    import os
+
+    file_bytes = os.path.getsize(path)
+    remaining = [file_bytes - len(_NVAMG_BIN_HEADER)]
+
     def _take(f, dtype, count, what):
+        # size gate BEFORE np.fromfile: a garbled header can claim
+        # billions of entries, and attempting the read would be a
+        # multi-GB allocation instead of a clean typed error
+        need = int(count) * np.dtype(dtype).itemsize
+        if count < 0 or need > remaining[0]:
+            raise MatrixIOError(
+                f"truncated %%NVAMGBinary file: {what} "
+                f"({need} bytes claimed, {remaining[0]} left)"
+            )
         a = np.fromfile(f, dtype, count)
         if a.shape[0] != count:
             raise MatrixIOError(
                 f"truncated %%NVAMGBinary file: {what} "
                 f"({a.shape[0]}/{count} read)"
             )
+        remaining[0] -= need
         return a
 
     with open(path, "rb") as f:
@@ -95,9 +124,22 @@ def _read_system_binary(path):
             if is_soln
             else None
         )
-    rows = np.repeat(
-        np.arange(n, dtype=np.int64), np.diff(row_offsets)
-    )
+    row_lens = np.diff(row_offsets)
+    # endpoint checks run even for n == 0 (a garbled header claiming
+    # n=0 with nnz>0 must not slip through as an inconsistent system)
+    if (
+        int(row_offsets[0]) != 0
+        or int(row_offsets[-1]) != nnz
+        or (row_lens < 0).any()
+    ):
+        # garbled index section: decodes but is not a CSR (negative
+        # row lengths / offsets not summing to nnz) — typed error, not
+        # a downstream numpy crash
+        raise MatrixIOError(
+            "garbled %%NVAMGBinary file: row offsets are not a valid "
+            "CSR pointer array"
+        )
+    rows = np.repeat(np.arange(n, dtype=np.int64), row_lens)
     cols = cols.astype(np.int64)
     vals = vals.reshape(-1, bsz) if bsz > 1 else vals
     if has_diag:
@@ -180,8 +222,13 @@ def read_system(path):
     has_sol = "solution" in flags
     has_ext_diag = "diagonal" in flags
 
-    sizes = lines[i].split()
-    n_rows, n_cols, nnz = int(sizes[0]), int(sizes[1]), int(sizes[2])
+    try:
+        sizes = lines[i].split()
+        n_rows, n_cols, nnz = int(sizes[0]), int(sizes[1]), int(sizes[2])
+    except (IndexError, ValueError):
+        raise MatrixIOError(
+            "missing or malformed MatrixMarket size line"
+        ) from None
     i += 1
 
     body = [

@@ -29,8 +29,13 @@ from amgx_tpu.serve.service import (
     SolveTicket,
 )
 
+# serving-stack alias: the docs/issues call the frontend "the solve
+# service"; the class name keeps its descriptive form
+SolveService = BatchedSolveService
+
 __all__ = [
     "BatchedSolveService",
+    "SolveService",
     "DEFAULT_CONFIG",
     "SolveTicket",
     "HierarchyCache",

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import concurrent.futures
 import dataclasses
-import hashlib
 import threading
 from collections import OrderedDict
 from typing import Callable, Optional
@@ -31,15 +30,11 @@ from amgx_tpu.serve.metrics import ServeMetrics
 
 
 def config_hash(cfg) -> str:
-    """Stable content hash of an AMGConfig (scoped key/value map)."""
-    items = sorted(
-        (str(scope), str(name), repr(value))
-        for (scope, name), value in cfg.items().items()
-    )
-    h = hashlib.blake2b(digest_size=12)
-    for scope, name, value in items:
-        h.update(f"{scope}\0{name}\0{value}\1".encode())
-    return h.hexdigest()
+    """Stable content hash of an AMGConfig (scoped key/value map).
+    Canonical implementation lives on the config itself so the
+    artifact store (:mod:`amgx_tpu.store`) can key persisted setups
+    identically without importing the serve layer."""
+    return cfg.content_hash()
 
 
 @dataclasses.dataclass
@@ -80,17 +75,60 @@ def template_signature(template) -> tuple:
 
 
 class HierarchyCache:
-    """LRU cache: (padded fingerprint, config hash, dtype) -> entry."""
+    """LRU cache: (padded fingerprint, config hash, dtype) -> entry.
+
+    ``on_evict(key, entry)`` fires (outside the cache lock) for every
+    LRU-evicted entry — the service uses it to drop the entry's
+    orphaned AOT executables from the CompileCache, which otherwise
+    leak until process exit."""
 
     def __init__(self, max_entries: int = 64,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 on_evict: Optional[Callable] = None):
         self.max_entries = max_entries
         self.metrics = metrics or ServeMetrics()
+        self.on_evict = on_evict
         self._lock = threading.Lock()
         self._entries: OrderedDict = OrderedDict()
 
     def __len__(self):
         return len(self._entries)
+
+    def _notify_evict(self, evicted):
+        """Run the eviction callback for popped (key, entry) pairs —
+        after the cache lock is released (the callback takes other
+        locks); callback failures never poison the insert path."""
+        if self.on_evict is None:
+            return
+        for key, entry in evicted:
+            try:
+                self.on_evict(key, entry)
+            except Exception:  # noqa: BLE001 — eviction housekeeping
+                pass
+
+    def any_with_signature(self, signature) -> bool:
+        """Does any CACHED entry share this template signature?  Two
+        entries with equal signatures share compiled executables, so
+        eviction of one must not drop the other's programs."""
+        with self._lock:
+            return any(
+                e.signature == signature
+                for e in self._entries.values()
+            )
+
+    def insert(self, fingerprint: str, cfg_key: str, dtype,
+               entry: HierarchyEntry):
+        """Directly insert a pre-built entry (warm boot restore path):
+        neither a hit nor a miss; LRU bounds still apply."""
+        key = (fingerprint, cfg_key, str(dtype))
+        evicted = []
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                evicted.append(self._entries.popitem(last=False))
+                self.metrics.inc("cache_evictions")
+        self._notify_evict(evicted)
 
     def peek(
         self, fingerprint: str, cfg_key: str, dtype
@@ -121,12 +159,14 @@ class HierarchyCache:
         self.metrics.inc("cache_misses")
         self.metrics.inc("setups")
         entry = build()
+        evicted = []
         with self._lock:
             self._entries[key] = entry
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
+                evicted.append(self._entries.popitem(last=False))
                 self.metrics.inc("cache_evictions")
+        self._notify_evict(evicted)
         return entry
 
     def clear(self):
@@ -183,6 +223,11 @@ class CompileCache:
         self._lock = threading.Lock()
         self._fns: dict = {}
         self._futures: dict = {}
+        # signatures evicted while a warm-up was still compiling: the
+        # finishing compile hands its result to waiters but must not
+        # re-insert it (the executable would leak until process exit —
+        # the orphan class evict_signature exists to close)
+        self._dead_sigs: set = set()
 
     def __len__(self):
         return len(self._fns)
@@ -226,8 +271,9 @@ class CompileCache:
             fut.set_exception(e)
             raise
         with self._lock:
-            self._fns[key] = fn
             self._futures.pop(key, None)
+            if key[0] not in self._dead_sigs:
+                self._fns[key] = fn
         self.metrics.inc("compiles")
         fut.set_result(fn)
         return fn
@@ -238,6 +284,7 @@ class CompileCache:
         flusher thread — never the dispatch worker)."""
         key = (entry.signature, Bb)
         with self._lock:
+            self._dead_sigs.discard(key[0])  # signature is live again
             fn = self._fns.get(key)
             if fn is not None:
                 self.metrics.inc("bucket_hits")
@@ -253,11 +300,32 @@ class CompileCache:
             return self._resolve(key, entry, Bb, fut)
         return fut.result()
 
+    def evict_signature(self, signature) -> int:
+        """Drop every compiled executable of one template signature
+        (the hierarchy cache evicted its last entry with it) and count
+        them under ``compile_evictions``.  In-flight warm-up futures
+        are left to finish — their waiters still need the result — but
+        the signature is tombstoned so the finishing compile does not
+        re-insert (and thereby leak) its executable; get/warm for the
+        signature clear the tombstone."""
+        if signature is None:
+            return 0
+        with self._lock:
+            keys = [k for k in self._fns if k[0] == signature]
+            for k in keys:
+                del self._fns[k]
+            if any(k[0] == signature for k in self._futures):
+                self._dead_sigs.add(signature)
+        if keys:
+            self.metrics.inc("compile_evictions", len(keys))
+        return len(keys)
+
     def warm(self, entry: HierarchyEntry, Bb: int):
         """Schedule a background AOT compile for (entry.signature, Bb)
         if neither an executable nor an in-flight compile exists."""
         key = (entry.signature, Bb)
         with self._lock:
+            self._dead_sigs.discard(key[0])  # signature is live again
             if key in self._fns or key in self._futures:
                 return
             fut = concurrent.futures.Future()

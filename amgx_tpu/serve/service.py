@@ -378,6 +378,15 @@ class BatchedSolveService:
         bypassed for that pattern and its requests run in per-request
         isolation (``breaker_trips`` / ``breaker_bypasses`` counters;
         a successful batched group resets the count).
+    store: setup-artifact store for warm-boot serving (PR 4): a
+        :class:`~amgx_tpu.store.store.ArtifactStore` or a directory
+        path.  Every hierarchy entry this service builds is exported
+        to the store in the background; :meth:`warm_boot` repopulates
+        the hierarchy cache from it at startup so previously-seen
+        fingerprints serve their first group without a rebuild.  When
+        set, JAX's persistent compilation cache is pointed at
+        ``<root>/xla_cache`` (``AMGX_TPU_XLA_CACHE=0`` opts out) so
+        restored buckets can skip XLA compiles too.
     donate: donate the batched x0 buffer to the compiled solve
         (``donate_argnums``) so XLA writes the solution in place
         instead of allocating a fresh (B, n) output per flush.  The
@@ -400,6 +409,7 @@ class BatchedSolveService:
         validate: bool = True,
         breaker_threshold: int = 3,
         donate: Optional[bool] = None,
+        store=None,
     ):
         if config is None:
             config = DEFAULT_CONFIG
@@ -412,8 +422,29 @@ class BatchedSolveService:
         self.queue_limit = int(queue_limit)
         self.metrics = ServeMetrics()
         self.cache = HierarchyCache(
-            max_entries=cache_entries, metrics=self.metrics
+            max_entries=cache_entries, metrics=self.metrics,
+            on_evict=self._on_hierarchy_evict,
         )
+        self.store = None
+        self._store_futures: list = []
+        if store is not None:
+            import os
+
+            from amgx_tpu.store.store import ArtifactStore
+
+            self.store = (
+                store
+                if isinstance(store, ArtifactStore)
+                else ArtifactStore(store)
+            )
+            if os.environ.get("AMGX_TPU_XLA_CACHE", "1") != "0":
+                from amgx_tpu.store.warmboot import (
+                    enable_persistent_compile_cache,
+                )
+
+                enable_persistent_compile_cache(
+                    os.path.join(self.store.root, "xla_cache")
+                )
         self.donate = donate
         self.compile_cache = CompileCache(
             metrics=self.metrics, donate=donate
@@ -804,13 +835,73 @@ class BatchedSolveService:
                 if batch_fn is not None
                 else None
             )
-        return HierarchyEntry(
+        entry = HierarchyEntry(
             solver=solver,
             template=template,
             batch_fn=batch_fn,
             signature=sig,
             pattern=pattern,
         )
+        self._export_entry(entry, dtype)
+        return entry
+
+    # ------------------------------------------------------------------
+    # setup-artifact store (warm-boot serving, amgx_tpu.store)
+
+    def _export_entry(self, entry: HierarchyEntry, dtype):
+        """Persist a freshly-built hierarchy entry in the background
+        (shared compile worker — never on a flush path).  Best-effort:
+        failures count, nothing raises."""
+        if self.store is None:
+            return
+
+        def job():
+            try:
+                from amgx_tpu.store.warmboot import export_entry
+
+                ok = export_entry(self, entry, dtype)
+                self.metrics.inc(
+                    "store_exports" if ok else "store_export_failures"
+                )
+            except BaseException:  # noqa: BLE001 — persistence is
+                # an optimization, never a serve-path liability
+                self.metrics.inc("store_export_failures")
+
+        with self._lock:
+            self._store_futures = [
+                f for f in self._store_futures if not f.done()
+            ]
+            self._store_futures.append(_compile_pool().submit(job))
+
+    def flush_store(self):
+        """Block until every scheduled store export has settled (tests
+        and orderly shutdown; the serve path never calls this)."""
+        with self._lock:
+            futures, self._store_futures = self._store_futures, []
+        for f in futures:
+            f.result()
+
+    def warm_boot(self, wait: bool = True, compile: bool = True) -> int:
+        """Repopulate the hierarchy cache from the store (see
+        :func:`amgx_tpu.store.warmboot.warm_boot`): previously
+        persisted fingerprints serve their first group as cache HITS —
+        no hierarchy rebuild, and with ``compile=True`` their batched
+        solves AOT-warm in the background too."""
+        from amgx_tpu.store.warmboot import warm_boot
+
+        return warm_boot(self, wait=wait, compile=compile)
+
+    def _on_hierarchy_evict(self, key, entry: HierarchyEntry):
+        """Hierarchy-cache eviction hook: drop the entry's AOT
+        executables from the compile cache unless another live entry
+        shares the template signature (equal signatures share
+        programs)."""
+        sig = entry.signature
+        if sig is None or self.cache.any_with_signature(sig):
+            return
+        self.compile_cache.evict_signature(sig)
+        with self._lock:
+            self._last_bucket.pop(sig, None)
 
     def _expire_deadlines(self, grp: _Group):
         """Fail (only) the tickets whose deadline already passed; their

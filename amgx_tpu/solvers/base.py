@@ -144,6 +144,10 @@ class Solver:
         self._params: Any = None
         self._jit_cache: dict = {}
         self.setup_time = 0.0
+        # seconds spent restoring a persisted setup (store.load_setup);
+        # setup_time stays 0 on a restore — the pair is the
+        # skipped-setup assertion surface of tests/test_store.py
+        self.restore_time = 0.0
         self.solve_time = 0.0
         # compile-vs-execute split (PR 3): lifetime compile seconds and
         # the compile cost of the LAST solve() call (0 on warm calls)
@@ -467,6 +471,78 @@ class Solver:
     def _resetup_impl(self, A: SparseMatrix) -> bool:
         """Attempt a values-only refresh; False -> caller runs setup."""
         return False
+
+    # ------------------------------------------------------------------
+    # setup persistence (amgx_tpu.store)
+
+    def _export_setup(self) -> dict:
+        """Serializable setup-state tree (leaves limited to what
+        :func:`amgx_tpu.store.serialize.flatten` handles).  The base
+        shape covers every solver: the set-up operator plus the
+        solve-boundary scale/reorder vectors, with a solver-specific
+        ``impl`` payload from :meth:`_export_impl`."""
+        from amgx_tpu.core.errors import StoreError
+
+        if self.A is None:
+            raise StoreError(
+                f"{self.registry_name}: save_setup before setup()"
+            )
+        return {
+            "A": self.A,
+            "scale": getattr(self, "_scale_vecs", None),
+            "reorder": getattr(self, "_reorder", None),
+            "impl": self._export_impl(),
+        }
+
+    def _import_setup(self, state: dict):
+        """Restore from :meth:`_export_setup` WITHOUT re-running the
+        expensive setup path.  The default re-derives params from the
+        restored operator via ``_setup_impl`` — deterministic and
+        cheap for every non-hierarchical solver; AMG overrides it to
+        rebuild the level chain from the payload instead of
+        re-coarsening."""
+        self.A = state["A"]
+        self._scale_vecs = state.get("scale")
+        self._reorder = state.get("reorder")
+        self._import_impl(state.get("impl"))
+        self._jit_cache.clear()
+
+    def _export_impl(self):
+        """Solver-specific setup state beyond the operator; None when
+        params re-derive from A (the default _import_impl path)."""
+        return None
+
+    def _import_impl(self, impl):
+        self._setup_impl(self.A)
+
+    def save_setup(self, path) -> dict:
+        """Persist this solver's completed setup to ``path`` (one
+        ``.npz`` payload with embedded JSON manifest) so a later
+        process can :meth:`load_setup` it without re-running setup —
+        the durable analogue of ``AMGX_write_system`` extended to the
+        whole hierarchy.  Returns the manifest."""
+        from amgx_tpu.store import serialize
+
+        return serialize.save_setup(self, path)
+
+    @classmethod
+    def load_setup(cls, path, cfg=None, expect_dtype=None):
+        """Restore a solver persisted with :meth:`save_setup`.
+
+        The payload records the solver class and full config; pass
+        ``cfg`` to assert config compatibility instead (content-hash
+        mismatch raises :class:`~amgx_tpu.core.errors.StoreError`),
+        and ``expect_dtype`` to refuse a payload of another operator
+        dtype before anything ships to the device.  The restored
+        solver solves with iteration counts identical to the original
+        — setup (for AMG: coarsening + Galerkin) is skipped, not
+        re-run (``setup_time`` stays 0; ``restore_time`` holds the
+        import cost)."""
+        from amgx_tpu.store import serialize
+
+        return serialize.load_setup(
+            path, cfg=cfg, expect_dtype=expect_dtype
+        )
 
     def make_batch_params(self):
         """Traced values-only params rebuild, for batched group solves

@@ -69,6 +69,30 @@ class ChebyshevSolver(Solver):
         self.lmax, self.lmin = float(lmax), float(lmin)
         self._params = (A, Mp)
 
+    def _export_impl(self):
+        # persistence (amgx_tpu.store): keep the estimated spectrum
+        # bounds (the power iteration is the non-trivial part of this
+        # setup) and recurse into the preconditioner if one exists
+        state = {"lmax": float(self.lmax), "lmin": float(self.lmin)}
+        if self.precond is not None:
+            state["precond"] = self.precond._export_setup()
+        return state
+
+    def _import_impl(self, impl):
+        if not impl or "lmax" not in impl:
+            return self._setup_impl(self.A)
+        if self.precond is not None:
+            if impl.get("precond") is None:
+                return self._setup_impl(self.A)
+            self.precond._import_setup(impl["precond"])
+            A, Mp = self.A, self.precond.apply_params()
+        else:
+            A = scalarized(self.A, "CHEBYSHEV")
+            Mp = invert_diag(A)
+        self.lmax = float(impl["lmax"])
+        self.lmin = float(impl["lmin"])
+        self._params = (A, Mp)
+
     def _estimate_lambda_max(self, A, M, Mp, iters=20, seed=0):
         """Power iteration on M^{-1}A (setup-time, jitted step)."""
         rng = np.random.default_rng(seed)

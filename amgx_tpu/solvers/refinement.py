@@ -59,6 +59,16 @@ class IterativeRefinementSolver(Solver):
         self.inner.setup(A)
         self._params = (A, self.inner.apply_params())
 
+    def _export_impl(self):
+        # persistence (amgx_tpu.store): recurse into the inner solver
+        return {"inner": self.inner._export_setup()}
+
+    def _import_impl(self, impl):
+        if not impl or impl.get("inner") is None:
+            return self._setup_impl(self.A)
+        self.inner._import_setup(impl["inner"])
+        self._params = (self.A, self.inner.apply_params())
+
     def make_solve(self):
         """Jit-composable form: x collapsed to working precision (the
         pair-preserving entry is :meth:`solve`, which combines hi+lo in

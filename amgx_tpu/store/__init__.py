@@ -1,0 +1,44 @@
+"""Setup-artifact store: persistent hierarchy snapshots, warm-boot
+serving, and a disk-backed compile cache.
+
+AMG setup (coarsening, colorings, Galerkin products, LU factors) is
+the dominant cost AMG research tries to amortize — the reference pays
+it per process.  This subsystem makes it durable:
+
+  * :mod:`amgx_tpu.store.serialize` — versioned schema flattening a
+    set-up solver (SparseMatrix with accel formats + gather maps, the
+    AMG level chain with R/P/RAP plans) to ``.npz`` + JSON manifest;
+    the API surface is ``Solver.save_setup(path)`` /
+    ``Solver.load_setup(path)`` (and capi ``solver_save`` /
+    ``solver_load``).
+  * :mod:`amgx_tpu.store.store` — atomic, hash-verified, size-budgeted
+    LRU :class:`ArtifactStore`; corrupt/stale entries are misses.
+  * :mod:`amgx_tpu.store.warmboot` — ``BatchedSolveService(store=...)``
+    exports hierarchy-cache entries on build and
+    ``service.warm_boot()`` repopulates them at startup via the
+    background compile worker, wiring JAX's persistent compilation
+    cache so restored buckets skip XLA compiles too.
+
+See doc/PERSISTENCE.md for the schema, manifest keys and invalidation
+rules.
+"""
+
+from amgx_tpu.store.serialize import (
+    SCHEMA_VERSION,
+    load_setup,
+    save_setup,
+)
+from amgx_tpu.store.store import ArtifactStore
+from amgx_tpu.store.warmboot import (
+    enable_persistent_compile_cache,
+    warm_boot,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "ArtifactStore",
+    "save_setup",
+    "load_setup",
+    "warm_boot",
+    "enable_persistent_compile_cache",
+]

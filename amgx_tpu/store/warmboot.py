@@ -1,0 +1,262 @@
+"""Warm-boot serving: persist the serve layer's hierarchy-cache
+entries and repopulate a fresh service from disk at startup.
+
+A ``BatchedSolveService(store=...)`` exports every hierarchy entry it
+builds (template solver + padded pattern) to the
+:class:`~amgx_tpu.store.store.ArtifactStore` in the background, keyed
+by ``(padded fingerprint, config hash, dtype)``.  A NEW process calls
+``service.warm_boot()``: every persisted entry matching the service's
+config restores on the shared background compile worker
+(:func:`amgx_tpu.serve.cache._compile_pool`) — deserialization skips
+hierarchy setup entirely — and is inserted into the
+:class:`~amgx_tpu.serve.cache.HierarchyCache`, then its batched solve
+AOT-compiles for the entry's persisted batch bucket (the last bucket
+it flushed at, or the full-group bucket).  The first
+request for a previously-seen pattern is a cache HIT (``cache_hits``,
+no rebuild), which is the acceptance contract of PR 4.
+
+XLA compiles are the other half of a cold start: when a store is
+wired, the service also points JAX's persistent compilation cache at
+``<store root>/xla_cache`` (:func:`enable_persistent_compile_cache`),
+so restored buckets skip the XLA compile too when the backend supports
+cache keys (``AMGX_TPU_XLA_CACHE=0`` opts out).
+
+Restores follow the store's failure contract: a corrupt, stale, or
+incompatible entry counts (``warmboot_failures``) and is skipped —
+the service falls back to a fresh setup on first use, never an error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from amgx_tpu.core.errors import StoreError
+from amgx_tpu.store import serialize
+
+ENTRY_KIND = "serve_entry"
+
+
+def enable_persistent_compile_cache(cache_dir) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` with
+    thresholds that cache every entry.  Returns False (instead of
+    raising) when this jax version/backend doesn't support it.
+
+    The cache dir is PROCESS-GLOBAL jax config: the first store to
+    wire it wins, and a second service with a different store keeps
+    the first dir (warned) — last-wins would silently redirect every
+    earlier service's (and unrelated jit's) compile artifacts into
+    the newest store."""
+    import jax
+
+    try:
+        current = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if current and current != str(cache_dir):
+            import warnings
+
+            warnings.warn(
+                "persistent compilation cache already wired to "
+                f"{current!r}; keeping it (requested {cache_dir!r})"
+            )
+            return False
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", -1
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0
+        )
+        return True
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# padded-pattern (de)serialization — host numpy only
+
+
+def _pattern_tree(pat) -> dict:
+    return {
+        "row_offsets": np.asarray(pat.row_offsets),
+        "col_indices": np.asarray(pat.col_indices),
+        "scatter": np.asarray(pat.scatter),
+        "ones_pos": np.asarray(pat.ones_pos),
+        "n": int(pat.n),
+        "nnz": int(pat.nnz),
+        "nb": int(pat.nb),
+        "nnzb": int(pat.nnzb),
+        "max_row_len": int(pat.max_row_len),
+        "num_diagonals": int(pat.num_diagonals),
+        "fingerprint": str(pat.fingerprint),
+    }
+
+
+def _pattern_from_tree(tree: dict):
+    from amgx_tpu.serve.bucketing import PaddedPattern
+
+    try:
+        return PaddedPattern(
+            row_offsets=np.asarray(tree["row_offsets"], np.int32),
+            col_indices=np.asarray(tree["col_indices"], np.int32),
+            scatter=np.asarray(tree["scatter"], np.int64),
+            ones_pos=np.asarray(tree["ones_pos"], np.int64),
+            n=int(tree["n"]),
+            nnz=int(tree["nnz"]),
+            nb=int(tree["nb"]),
+            nnzb=int(tree["nnzb"]),
+            max_row_len=int(tree["max_row_len"]),
+            num_diagonals=int(tree["num_diagonals"]),
+            fingerprint=str(tree["fingerprint"]),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise StoreError(f"malformed serve-entry pattern: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# export / restore of hierarchy-cache entries
+
+
+def entry_key(store, fingerprint: str, cfg_key: str, dtype) -> str:
+    return store.entry_key(
+        fingerprint, cfg_key, str(np.dtype(dtype)), kind=ENTRY_KIND
+    )
+
+
+def export_entry(service, entry, dtype) -> bool:
+    """Serialize one hierarchy-cache entry into the service's store.
+    The template solver is SHARED mutable state (sequential-fallback
+    and quarantine paths resetup it), so ONLY the reference capture
+    (flatten) runs under its lock; the multi-MB host materialization
+    and the disk write happen outside it — the captured jax.Arrays
+    are immutable, so serve-path solves never stall behind the copy.
+    Returns False on any failure (counted by the caller)."""
+    store = service.store
+    if store is None:
+        return False
+    dtype_s = str(np.dtype(dtype))
+    # meta (fingerprint hash = D2H copy of the index arrays) runs
+    # outside the lock: fingerprint and dtype are structure-stable
+    # across the values-only resetups the lock guards, so any snapshot
+    # is correct
+    meta = serialize.solver_meta(entry.solver)
+    with entry.solver_lock:
+        # only the reference capture needs the lock (the fallback and
+        # quarantine paths resetup this solver concurrently); the
+        # captured jax.Arrays are immutable, so the multi-MB D2H copy
+        # below must NOT stall serve-path solves on the same lock
+        tree = {
+            "solver": entry.solver._export_setup(),
+            "pattern": _pattern_tree(entry.pattern),
+        }
+        spec, arrays = serialize.flatten(tree)
+    arrays = serialize.materialize(arrays)
+    from amgx_tpu.serve.bucketing import bucket_batch
+
+    # AOT-warm target for the restored entry: the bucket this entry
+    # last flushed at when known (export can also run before any
+    # flush), else the full-group bucket — the steady-state size for
+    # a loaded service
+    bucket = None
+    if entry.signature is not None:
+        bucket = service._last_bucket.get(entry.signature)
+    manifest = dict(meta)
+    manifest.update(
+        kind=ENTRY_KIND,
+        spec=spec,
+        pattern_fingerprint=entry.pattern.fingerprint,
+        cfg_key=service.cfg_key,
+        dtype=dtype_s,
+        bucket=bucket or bucket_batch(service.max_batch),
+    )
+    key = entry_key(store, entry.pattern.fingerprint,
+                    service.cfg_key, dtype_s)
+    return store.put(key, arrays, manifest)
+
+
+def restore_entry(service, manifest: dict, arrays):
+    """Rebuild a HierarchyEntry from a store payload — the
+    ``_build_entry`` tail without the setup: the restored template
+    solver is already set up, so only the batch template/fn derive."""
+    from amgx_tpu.serve.batched import make_batched_solve
+    from amgx_tpu.serve.cache import HierarchyEntry, template_signature
+
+    serialize.check_schema(manifest)
+    if manifest.get("kind") != ENTRY_KIND:
+        raise StoreError(
+            f"payload kind {manifest.get('kind')!r} is not a serve "
+            "entry"
+        )
+    tree = serialize.unflatten(manifest.get("spec"), arrays)
+    if not isinstance(tree, dict) or "solver" not in tree \
+            or "pattern" not in tree:
+        raise StoreError("malformed serve-entry payload tree")
+    solver = serialize.build_solver(
+        manifest, tree["solver"], cfg=service.cfg
+    )
+    pattern = _pattern_from_tree(tree["pattern"])
+    bp = solver.make_batch_params()
+    batch_fn = make_batched_solve(solver)
+    template = bp[0] if bp is not None else None
+    sig = template_signature(template) if batch_fn is not None else None
+    return HierarchyEntry(
+        solver=solver,
+        template=template,
+        batch_fn=batch_fn,
+        signature=sig,
+        pattern=pattern,
+    )
+
+
+def warm_boot(service, wait: bool = True, compile: bool = True) -> int:
+    """Repopulate a service's hierarchy cache from its store.
+
+    Scans the store for serve entries matching the service's config
+    hash, restores each on the shared background compile worker, and
+    (``compile=True``) AOT-warms the batched solve for the entry's
+    persisted batch bucket.  ``wait=True`` blocks until every restore
+    has settled and returns the number restored; ``wait=False``
+    returns the number SCHEDULED immediately (server startup overlaps
+    restoration with accepting traffic — a request racing its own
+    restore simply misses and rebuilds).
+    """
+    from amgx_tpu.serve.cache import _compile_pool
+
+    store = service.store
+    if store is None:
+        return 0
+    jobs = []
+    for key, side in store.entries():
+        if side.get("kind") != ENTRY_KIND:
+            continue
+        if side.get("cfg_key") != service.cfg_key:
+            continue
+        jobs.append((key, side))
+
+    def restore_one(key, side):
+        try:
+            hit = store.get(key)
+            if hit is None:
+                raise StoreError(f"store entry {key} unreadable")
+            manifest, arrays = hit
+            entry = restore_entry(service, manifest, arrays)
+            service.cache.insert(
+                entry.pattern.fingerprint,
+                service.cfg_key,
+                manifest.get("dtype", side.get("dtype")),
+                entry,
+            )
+            service.metrics.inc("warmboot_restores")
+            if compile and entry.batch_fn is not None:
+                bb = int(manifest.get("bucket") or service.max_batch)
+                service.compile_cache.warm(entry, bb)
+            return True
+        except BaseException:  # noqa: BLE001 — degrade to cold start
+            service.metrics.inc("warmboot_failures")
+            return False
+
+    futures = [
+        _compile_pool().submit(restore_one, key, side)
+        for key, side in jobs
+    ]
+    if not wait:
+        return len(futures)
+    return sum(1 for f in futures if f.result())

@@ -207,6 +207,37 @@ def _serve_record():
         return {"error": str(e)}
 
 
+def _store_record():
+    """Setup-artifact store: cold setup vs restore speedup plus the
+    warm-boot serving scenario (ci/store_bench.py, one small case).
+    Guarded — the store record must never take the headline bench
+    down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.store_bench import run as store_run
+
+        rec = store_run(reps=2)
+        return {
+            k: rec[k]
+            for k in (
+                "value",
+                "unit",
+                "cases",
+                "restored_entries",
+                "boot_s",
+                "warmboot_cache_hits",
+                "warmboot_cache_misses",
+            )
+            if k in rec
+        }
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: store record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _backend_responsive(timeout_s=240):
     """Probe backend init in a subprocess: a broken remote tunnel hangs
     jax.devices() indefinitely, which must not take the benchmark run
@@ -390,6 +421,10 @@ def main():
     serve_rec = _serve_record()
     print(f"bench: serve {serve_rec}", file=sys.stderr)
 
+    # ---- setup-artifact store --------------------------------------
+    store_rec = _store_record()
+    print(f"bench: store {store_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -408,6 +443,7 @@ def main():
                 "unstructured_bytes_per_s_lb": round(ell_bw / 1e9, 1),
                 "solve": solve_rec,
                 "serve": serve_rec,
+                "store": store_rec,
             }
         )
     )

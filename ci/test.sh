@@ -21,6 +21,13 @@ JAX_PLATFORMS=cpu python ci/fault_smoke.py
 # regression).
 JAX_PLATFORMS=cpu python ci/serve_bench.py
 
+# ---- setup-artifact store: restore + warm-boot floors ----------------
+# One JSON line; non-zero exit when load_setup restore drops below 3x
+# over cold setup on the Poisson suite, or a warm-booted service fails
+# to serve its first group for a persisted fingerprint as a hierarchy
+# cache hit (store regression).
+JAX_PLATFORMS=cpu python ci/store_bench.py
+
 # ---- native C ABI (VERDICT r4 #9) -----------------------------------
 # Build from source and run both demos on CPU; assert exit 0 and the
 # expected iteration count from the reference README sample (1 iter).

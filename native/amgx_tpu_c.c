@@ -522,6 +522,26 @@ AMGX_RC AMGX_solver_destroy(AMGX_solver_handle slv) {
   LEAVE_RET(rc);
 }
 
+/* setup persistence (no reference analogue: AMGX_write_system can only
+ * persist the SYSTEM, so every process restart re-pays setup; these
+ * persist the completed setup itself — see doc/PERSISTENCE.md) */
+
+AMGX_RC AMGX_solver_save(AMGX_solver_handle slv, const char *filename) {
+  ENTER();
+  AMGX_RC rc = call_rc(
+      "solver_save",
+      Py_BuildValue("(Ks)", (unsigned long long)slv, filename), 1);
+  LEAVE_RET(rc);
+}
+
+AMGX_RC AMGX_solver_load(AMGX_solver_handle slv, const char *filename) {
+  ENTER();
+  AMGX_RC rc = call_rc(
+      "solver_load",
+      Py_BuildValue("(Ks)", (unsigned long long)slv, filename), 1);
+  LEAVE_RET(rc);
+}
+
 AMGX_RC AMGX_read_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
                          AMGX_vector_handle sol, const char *filename) {
   ENTER();

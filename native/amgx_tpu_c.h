@@ -116,6 +116,11 @@ AMGX_RC AMGX_solver_get_iteration_residual(AMGX_solver_handle slv, int it,
                                            int idx, double *res);
 AMGX_RC AMGX_solver_destroy(AMGX_solver_handle slv);
 
+/* setup persistence: save/restore a completed solver setup (hierarchy
+ * snapshot) — restore skips setup entirely; doc/PERSISTENCE.md */
+AMGX_RC AMGX_solver_save(AMGX_solver_handle slv, const char *filename);
+AMGX_RC AMGX_solver_load(AMGX_solver_handle slv, const char *filename);
+
 AMGX_RC AMGX_read_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
                          AMGX_vector_handle sol, const char *filename);
 AMGX_RC AMGX_write_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,

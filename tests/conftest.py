@@ -12,6 +12,11 @@ import os
 # Force CPU: the session env pins JAX_PLATFORMS=axon (the real TPU tunnel);
 # tests must run on the virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# store-wired services must not pin the process-global XLA persistent
+# cache at short-lived tmp_path stores (jax would warn on every later
+# compile once the dir is deleted); the wiring itself is covered by
+# ci/store_bench.py
+os.environ.setdefault("AMGX_TPU_XLA_CACHE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

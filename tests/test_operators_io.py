@@ -145,6 +145,126 @@ def test_nvamg_binary_roundtrip(tmp_path):
     np.testing.assert_allclose(sol, x)
 
 
+def test_nvamg_binary_truncated_raises_typed(tmp_path):
+    """A truncated or garbled binary file raises MatrixIOError — never
+    a bare struct/Index/ValueError from the decoder internals."""
+    from amgx_tpu.io.matrix_market import (
+        MatrixIOError,
+        read_system,
+        write_system_binary,
+    )
+    from amgx_tpu.io.poisson import poisson_2d_5pt, poisson_rhs
+
+    A = poisson_2d_5pt(10)
+    b = poisson_rhs(A.n_rows)
+    p = str(tmp_path / "sys.bin")
+    write_system_binary(p, A, rhs=b)
+    blob = open(p, "rb").read()
+    # truncation at several depths: inside the flags, the index
+    # sections, the values, the rhs tail
+    for frac in (0.02, 0.2, 0.6, 0.95):
+        cut = str(tmp_path / f"cut_{frac}.bin")
+        open(cut, "wb").write(blob[: int(len(blob) * frac)])
+        with pytest.raises(MatrixIOError):
+            read_system(cut)
+    # garbled: valid header, random bytes after it (a bogus header can
+    # claim billions of entries — must be a typed error, not a
+    # multi-GB allocation or a numpy crash)
+    rng = np.random.default_rng(0)
+    garbled = str(tmp_path / "garbled.bin")
+    open(garbled, "wb").write(
+        b"%%NVAMGBinary\n" + rng.bytes(len(blob) - 14)
+    )
+    with pytest.raises(MatrixIOError):
+        read_system(garbled)
+    # garbled row pointers that still END at nnz: row_offsets[0] != 0
+    # silently shifts every entry a row — must be a typed error, not a
+    # wrong system
+    shifted = bytearray(blob)
+    # layout: 14-byte magic + 9 uint32 flags, then int32 row_offsets
+    off0 = 14 + 9 * 4
+    shifted[off0 : off0 + 4] = np.int32(2).tobytes()
+    bad0 = str(tmp_path / "bad_first_offset.bin")
+    open(bad0, "wb").write(bytes(shifted))
+    with pytest.raises(MatrixIOError):
+        read_system(bad0)
+    # n=0 claimed with nnz>0: the endpoint check must fire even when
+    # there are no rows to length-check
+    flags = np.array([1, 0, 0, 0, 0, 1, 1, 0, 5], dtype=np.uint32)
+    body = (
+        np.zeros(1, np.int32).tobytes()       # row_offsets = [0]
+        + np.arange(5, dtype=np.int32).tobytes()   # 5 cols
+        + np.ones(5, np.float64).tobytes()         # 5 values
+    )
+    zero_rows = str(tmp_path / "zero_rows.bin")
+    open(zero_rows, "wb").write(
+        b"%%NVAMGBinary\n" + flags.tobytes() + body
+    )
+    with pytest.raises(MatrixIOError):
+        read_system(zero_rows)
+
+
+def test_mtx_text_truncated_raises_typed(tmp_path):
+    from amgx_tpu.io.matrix_market import MatrixIOError, read_system
+
+    cases = {
+        "empty.mtx": "",
+        "short_header.mtx": "%%MatrixMarket matrix coordinate\n",
+        "no_sizes.mtx":
+            "%%MatrixMarket matrix coordinate real general\n",
+        "bad_sizes.mtx":
+            "%%MatrixMarket matrix coordinate real general\nx y z\n",
+        "bad_token.mtx":
+            "%%MatrixMarket matrix coordinate real general\n"
+            "2 2 2\n1 1 1.0\n2 2 oops\n",
+        "short_body.mtx":
+            "%%MatrixMarket matrix coordinate real general\n"
+            "4 4 8\n1 1 1.0\n",
+    }
+    for name, text in cases.items():
+        p = tmp_path / name
+        p.write_text(text)
+        with pytest.raises(MatrixIOError):
+            read_system(str(p))
+
+
+def test_mtx_roundtrip_preserves_value_dtype(tmp_path):
+    """write_system -> read round trip preserves values for float32
+    and complex systems (dtype selected at build: the text format
+    itself carries full-precision decimal)."""
+    import scipy.sparse as sps
+
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.io.matrix_market import read_mtx, write_system
+
+    rng = np.random.default_rng(7)
+    n = 12
+    base = sps.random(
+        n, n, density=0.3, random_state=rng, format="csr"
+    ) + sps.eye_array(n) * 4.0
+
+    # float32: values survive bit-exactly through the text format
+    sp32 = base.tocsr().astype(np.float32)
+    A32 = SparseMatrix.from_scipy(sp32, dtype=np.float32)
+    p32 = str(tmp_path / "f32.mtx")
+    write_system(p32, A32)
+    R32 = read_mtx(p32, dtype=np.float32)
+    assert np.dtype(R32.values.dtype) == np.dtype(np.float32)
+    assert np.array_equal(
+        np.asarray(R32.values), np.asarray(A32.values)
+    )
+
+    # complex: both components survive, dtype stays complex
+    spc = base.tocsr().astype(np.complex128)
+    spc.data = spc.data * (1.0 + 0.5j)
+    Ac = SparseMatrix.from_scipy(spc)
+    pc = str(tmp_path / "cx.mtx")
+    write_system(pc, Ac)
+    Rc = read_mtx(pc)
+    assert np.iscomplexobj(np.asarray(Rc.values))
+    assert np.array_equal(np.asarray(Rc.values), np.asarray(Ac.values))
+
+
 def test_nvamg_binary_capi_roundtrip(tmp_path):
     from amgx_tpu.api import capi
     from amgx_tpu.io.poisson import poisson_2d_5pt
