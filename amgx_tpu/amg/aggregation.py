@@ -17,11 +17,14 @@ strategy — one scipy product here).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sps
+
+from amgx_tpu.core.profiling import setup_fastpath_enabled, setup_phase
 
 
 def edge_weights(Asp: sps.csr_matrix, formula: int = 0) -> sps.csr_matrix:
@@ -55,6 +58,18 @@ def _first_per_row(rows_sorted, n):
     """Index of the first occurrence of each row id in a row-sorted array;
     -1 for absent rows."""
     first = np.full(n, -1, dtype=np.int64)
+    if setup_fastpath_enabled():
+        # the input is row-sorted, so first occurrences are exactly the
+        # boundary positions — an O(nnz) flag diff instead of the
+        # np.unique sort the matcher used to pay PER ROUND
+        if rows_sorted.shape[0]:
+            mask = np.empty(rows_sorted.shape[0], dtype=bool)
+            mask[0] = True
+            np.not_equal(rows_sorted[1:], rows_sorted[:-1],
+                         out=mask[1:])
+            idx = np.nonzero(mask)[0]
+            first[rows_sorted[idx]] = idx
+        return first
     uniq, idx = np.unique(rows_sorted, return_index=True)
     first[uniq] = idx
     return first
@@ -126,6 +141,27 @@ def pairwise_match(W: sps.csr_matrix, merge_singletons: bool = True,
 
 _DEVICE_MATCH_MAX_WIDTH = 32  # bounded-degree gate for the ELL matcher
 _DEVICE_MATCH_MIN_ROWS = 16384  # below this, host numpy rounds win
+
+
+def _device_matching_wanted() -> bool:
+    """Backend gate for the XLA matcher: accelerators only.  On the
+    CPU backend the "device" is the same cores the numpy rounds use,
+    so the XLA handshake buys nothing at steady state and its first
+    compile (~0.7-1.4 s measured) dominates a cold setup — exactly the
+    mid-setup device ping-pong the host-resident fast path removes.
+    ``AMGX_TPU_DEVICE_MATCH`` overrides either way (``0`` disables,
+    anything else enables — same parse as AMGX_TPU_SETUP_FASTPATH);
+    the reference path (AMGX_TPU_SETUP_FASTPATH=0) keeps the old
+    size-only gate."""
+    env = os.environ.get("AMGX_TPU_DEVICE_MATCH")
+    if env is not None:
+        return env != "0"
+    if not setup_fastpath_enabled():
+        return True
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover - uninitialized backend
+        return True
 
 
 def _edge_jitter(r, c, n):
@@ -312,7 +348,8 @@ def aggregate(Asp: sps.csr_matrix, passes: int, formula: int = 0,
         # graphs stay on host where the numpy rounds are cheaper than
         # a compile
         if (not serial_matching and max_unassigned <= 0
-                and W.shape[0] >= _DEVICE_MATCH_MIN_ROWS):
+                and W.shape[0] >= _DEVICE_MATCH_MIN_ROWS
+                and _device_matching_wanted()):
             sub = pairwise_match_device(W, merge_singletons,
                                         max_rounds=max_rounds)
         else:
@@ -357,20 +394,26 @@ SELECTOR_PASSES = {
 # and forces coarse levels onto gather-bound formats.
 
 
-def _col_diffs(Asp: sps.csr_matrix):
+def _col_diffs(Asp: sps.csr_matrix, dtype=np.int64):
     """col - row per stored entry, straight from CSR (no COO copy —
-    this runs on every level of every setup)."""
+    this runs on every level of every setup).  ``dtype`` may be int32
+    when both dimensions fit (the offset-scan unique sorts ~2x faster
+    there) — entry ORDER is the contract axis_strengths relies on."""
     rows = np.repeat(
-        np.arange(Asp.shape[0], dtype=np.int64), np.diff(Asp.indptr)
+        np.arange(Asp.shape[0], dtype=dtype), np.diff(Asp.indptr)
     )
-    return Asp.indices.astype(np.int64) - rows
+    return Asp.indices.astype(dtype, copy=False) - rows
 
 
-def stencil_offsets(Asp: sps.csr_matrix, max_diags: int = 64):
+def stencil_offsets(Asp: sps.csr_matrix, max_diags: int = 64,
+                    return_diffs: bool = False):
     """Distinct diagonal offsets of A if there are few, else None.
 
     Short-circuits on a row sample first: unstructured matrices bail
-    after O(sample) work instead of sorting all nnz diffs."""
+    after O(sample) work instead of sorting all nnz diffs.
+    ``return_diffs`` additionally returns the per-entry col-row diff
+    array (entry order) so the caller's geo path reuses the single
+    pass for ``axis_strengths`` — as ``(offs, diffs)``."""
     n = Asp.shape[0]
     if n > 4096:
         take = min(n, 512)
@@ -381,11 +424,19 @@ def stencil_offsets(Asp: sps.csr_matrix, max_diags: int = 64):
         if np.unique(
             sub.indices.astype(np.int64) - rows
         ).size > max_diags:
-            return None
-    offs = np.unique(_col_diffs(Asp))
+            return (None, None) if return_diffs else None
+    # int32 diff arithmetic when both dimensions fit: the unique sort
+    # runs ~2x faster and the offsets themselves are tiny either way
+    use32 = (
+        setup_fastpath_enabled()
+        and max(Asp.shape) < np.iinfo(np.int32).max
+    )
+    diffs = _col_diffs(Asp, np.int32 if use32 else np.int64)
+    offs = np.unique(diffs)
     if offs.size > max_diags:
-        return None
-    return offs
+        return (None, None) if return_diffs else None
+    offs = offs.astype(np.int64)
+    return (offs, diffs) if return_diffs else offs
 
 
 def infer_grid(offsets, n: int):
@@ -439,16 +490,16 @@ def infer_grid(offsets, n: int):
     return best
 
 
-def axis_strengths(Asp: sps.csr_matrix, nx: int, ny: int, nz: int):
+def axis_strengths(Asp: sps.csr_matrix, nx: int, ny: int, nz: int,
+                   diffs=None):
     """Mean |coupling| along each grid axis (offsets ±1, ±nx, ±nx·ny).
 
     Drives the semicoarsening decision: anisotropic stencils must be
     aggregated along the STRONG axis (classical strength-of-connection
     semantics), not by grid shape.
     """
-    coo = Asp.tocoo()
-    d = coo.col.astype(np.int64) - coo.row.astype(np.int64)
-    av = np.abs(coo.data)
+    d = _col_diffs(Asp) if diffs is None else diffs
+    av = np.abs(Asp.data)
     out = []
     for stride, dim in ((1, nx), (nx, ny), (nx * ny, nz)):
         if dim <= 1:
@@ -531,12 +582,13 @@ def select_aggregates(Asp, cfg, scope):
         )
         return _maybe_print_agg_info(cfg, scope, selector, agg), None
     if bool(cfg.get("structured_aggregation", scope)) or selector == "GEO":
-        offs = stencil_offsets(Asp)
+        # one diff pass serves the offset scan and the axis strengths
+        offs, diffs = stencil_offsets(Asp, return_diffs=True)
         grid = (
             infer_grid(offs, Asp.shape[0]) if offs is not None else None
         )
         if grid is not None:
-            strengths = axis_strengths(Asp, *grid)
+            strengths = axis_strengths(Asp, *grid, diffs=diffs)
             block = geo_block_shape(*grid, passes, strengths)
             agg = geo_aggregate(*grid, passes, strengths=strengths)
             return (
@@ -815,22 +867,27 @@ def build_aggregation_level(Asp, cfg, scope):
         raise KeyError(
             f"CoarseAGeneratorFactory '{gen}' has not been registered"
         )
-    agg, geo_info = select_aggregates(Asp, cfg, scope)
+    with setup_phase("aggregation"):
+        agg, geo_info = select_aggregates(Asp, cfg, scope)
     n = Asp.shape[0]
     nc = int(agg.max()) + 1
-    P = sps.csr_matrix(
-        (np.ones(n, dtype=Asp.dtype), (np.arange(n), agg)), shape=(n, nc)
-    )
-    R = P.T.tocsr()
-    Ac = None
-    # the dense-reduction Galerkin avoids the A@P sparse intermediate
-    # (which peaks at ~8x the fine operator's memory); worth it above
-    # this size, below it scipy's product is faster on host
-    if geo_info is not None and n >= _GEO_RAP_MIN_ROWS:
-        Ac = geo_galerkin_dia(Asp, *geo_info)
-    if Ac is None:
-        Ac = (R @ Asp @ P).tocsr()
-        Ac.sum_duplicates()
-        Ac.eliminate_zeros()  # structural parity with the geo path
-        Ac.sort_indices()
+    with setup_phase("interp"):
+        P = sps.csr_matrix(
+            (np.ones(n, dtype=Asp.dtype), (np.arange(n), agg)),
+            shape=(n, nc),
+        )
+        R = P.T.tocsr()
+    with setup_phase("rap_execute"):
+        Ac = None
+        # the dense-reduction Galerkin avoids the A@P sparse
+        # intermediate (which peaks at ~8x the fine operator's
+        # memory); worth it above this size, below it scipy's product
+        # is faster on host
+        if geo_info is not None and n >= _GEO_RAP_MIN_ROWS:
+            Ac = geo_galerkin_dia(Asp, *geo_info)
+        if Ac is None:
+            Ac = (R @ Asp @ P).tocsr()
+            Ac.sum_duplicates()
+            Ac.eliminate_zeros()  # structural parity with the geo path
+            Ac.sort_indices()
     return P, R, Ac

@@ -17,24 +17,91 @@ import warnings
 import numpy as np
 import scipy.sparse as sps
 
+from amgx_tpu.core.profiling import setup_fastpath_enabled, setup_phase
 
-def strength_ahat(Asp: sps.csr_matrix, theta: float, max_row_sum: float):
+
+# ----------------------------------------------------------------------
+# vectorized per-row reductions (the cold-setup fast path)
+#
+# ``np.ufunc.at`` is the single hottest line of classical setup (its
+# unbuffered per-element loop runs at Python-adjacent speed); these
+# helpers produce BITWISE-identical results through C-speed kernels:
+#
+#   * sums: ``np.bincount(weights=...)`` accumulates sequentially in
+#     element order into an f64 accumulator — the exact operation
+#     ``np.add.at`` performs on a zeroed f64 array (asserted by the
+#     fast-vs-reference parity suite, tests/test_setup_fastpath.py).
+#   * maxima: max is exactly associative (no rounding), so
+#     ``np.maximum.reduceat`` row segments equal the sequential
+#     ``np.maximum.at`` accumulation for any grouping; casts commute
+#     with max (monotone), so reducing in the value dtype and casting
+#     the row result equals casting every element first.
+#
+# AMGX_TPU_SETUP_FASTPATH=0 routes back to the ufunc.at reference
+# forms (old-vs-new benchmarking, ci/setup_bench.py).
+
+
+def _row_sum(row_ids, weights, n):
+    """Per-row sums grouped by ``row_ids`` — bitwise-identical to
+    ``np.add.at`` on ``np.zeros(n)``."""
+    if not setup_fastpath_enabled() or np.iscomplexobj(weights):
+        out = np.zeros(
+            n, dtype=weights.dtype if np.iscomplexobj(weights) else None
+        )
+        np.add.at(out, row_ids, weights)
+        return out
+    return np.bincount(row_ids, weights=weights, minlength=n)
+
+
+def _row_max(vals, indptr, row_ids, init, out_dtype=None):
+    """Per-row maxima over CSR-ordered ``vals`` — bitwise-identical to
+    ``np.maximum.at`` on ``np.full(n, init, out_dtype)``.  ``indptr``
+    and ``row_ids`` describe the same row grouping (the caller has
+    both at hand)."""
+    n = indptr.shape[0] - 1
+    if out_dtype is None:
+        out_dtype = vals.dtype
+    if not setup_fastpath_enabled() or vals.shape[0] == 0:
+        out = np.full(n, init, dtype=out_dtype)
+        np.maximum.at(out, row_ids, vals)
+        return out
+    # reduceat over NON-EMPTY rows' start offsets only: consecutive
+    # non-empty starts bound exactly one row's entries (empty rows
+    # contribute none), and every start is < nnz so no segment is ever
+    # clamped/truncated — naive indptr[:-1] clamping silently shortens
+    # the last non-empty row's segment when trailing rows are empty
+    nonempty = np.diff(indptr) > 0
+    fill = np.asarray(init, dtype=vals.dtype)[()]
+    out = np.full(n, fill, dtype=vals.dtype)
+    out[nonempty] = np.maximum.reduceat(
+        vals, indptr[:-1][nonempty].astype(np.int64)
+    )
+    return np.maximum(out, fill).astype(out_dtype, copy=False)
+
+
+def strength_ahat(Asp: sps.csr_matrix, theta: float, max_row_sum: float,
+                  return_flags: bool = False):
     """Strong-connection mask S (csr bool) — AHAT default
     (reference strength/ahat.cu): j strong for i iff
     -a_ij >= theta * max_k(-a_ik); falls back to |a_ij| for rows with no
     negative off-diagonals.  Rows whose row-sum ratio exceeds max_row_sum
     get no strong connections (weakened dependencies, core.cu
-    'max_row_sum')."""
+    'max_row_sum').
+
+    ``return_flags`` additionally returns the per-A-entry strong mask
+    (aligned with ``Asp.data``) so interpolators can skip the
+    ``strong_entry_flags`` membership re-derivation."""
     n = Asp.shape[0]
     indptr, indices, data = Asp.indptr, Asp.indices, Asp.data
     row_ids = np.repeat(np.arange(n), np.diff(indptr))
     offdiag = indices != row_ids
     neg = np.where(offdiag, -data, 0.0)
     # per-row max of negative off-diagonals
-    mneg = np.zeros(n, data.dtype)
-    np.maximum.at(mneg, row_ids, neg)
-    mabs = np.zeros(n, data.dtype)
-    np.maximum.at(mabs, row_ids, np.where(offdiag, np.abs(data), 0.0))
+    mneg = _row_max(neg, indptr, row_ids, 0.0, out_dtype=data.dtype)
+    mabs = _row_max(
+        np.where(offdiag, np.abs(data), 0.0), indptr, row_ids, 0.0,
+        out_dtype=data.dtype,
+    )
     use_abs = mneg <= 0
     thresh = np.where(use_abs, mabs, mneg) * theta
     val = np.where(use_abs[row_ids], np.abs(data), -data)
@@ -57,6 +124,8 @@ def strength_ahat(Asp: sps.csr_matrix, theta: float, max_row_sum: float):
         shape=Asp.shape,
     )
     S.eliminate_zeros()
+    if return_flags:
+        return S, strong
     return S
 
 
@@ -106,13 +175,17 @@ def strength_affinity(Asp: sps.csr_matrix, theta: float,
     return S
 
 
-def strength_all(Asp: sps.csr_matrix):
+def strength_all(Asp: sps.csr_matrix, return_flags: bool = False):
     """ALL: every off-diagonal is strong (reference strength ALL)."""
     n = Asp.shape[0]
     S = Asp.copy().tocsr()
     S.setdiag(0)
     S.eliminate_zeros()
     S.data = np.ones_like(S.data, dtype=np.int8)
+    if return_flags:
+        row_ids = np.repeat(np.arange(n), np.diff(Asp.indptr))
+        flags = (Asp.indices != row_ids) & (Asp.data != 0)
+        return S, flags
     return S
 
 
@@ -190,6 +263,7 @@ def pmis_select(S: sps.csr_matrix, seed: int = 0) -> np.ndarray:
     state[iso] = 1  # isolated points must be coarse (nothing to interp from)
     coo = Ssym.tocoo()
     coo_row, coo_col = coo.row, coo.col
+    fast = setup_fastpath_enabled()
     for _ in range(200):
         und = state == 0
         if not und.any():
@@ -197,8 +271,17 @@ def pmis_select(S: sps.csr_matrix, seed: int = 0) -> np.ndarray:
         # local max among undecided neighbours
         wu = np.where(und, w, -1.0)
         act = und[coo_row] & und[coo_col]
-        nbmax = np.full(n, -1.0)
-        np.maximum.at(nbmax, coo_row[act], wu[coo_col[act]])
+        if fast:
+            # row-segmented reduceat over -1-filled inactive slots:
+            # identical to the maximum.at accumulation (w >= 0, so the
+            # -1.0 fill never wins over an active neighbour)
+            nbmax = _row_max(
+                np.where(act, wu[coo_col], -1.0), Ssym.indptr,
+                coo_row, -1.0,
+            )
+        else:
+            nbmax = np.full(n, -1.0)
+            np.maximum.at(nbmax, coo_row[act], wu[coo_col[act]])
         new_c = und & (wu > nbmax)
         state[new_c] = 1
         # fine: undecided with a C neighbour
@@ -413,7 +496,9 @@ def multipass_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
 
 
 def direct_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
-                         cf: np.ndarray) -> sps.csr_matrix:
+                         cf: np.ndarray,
+                         strong_flag: np.ndarray | None = None,
+                         ) -> sps.csr_matrix:
     """Distance-1 direct interpolation (reference interpolators/
     distance1.cu; hypre-style sign-split weights):
 
@@ -430,23 +515,21 @@ def direct_interpolation(Asp: sps.csr_matrix, S: sps.csr_matrix,
     row_ids = np.repeat(np.arange(n), np.diff(indptr))
     offd = indices != row_ids
 
-    # strong flag per A entry: membership of (i,j) in S's sparsity
-    # (chunked searchsorted, bounded workspace — see strong_entry_flags)
-    strong_flag = strong_entry_flags(Asp, S)
+    # strong flag per A entry: membership of (i,j) in S's sparsity —
+    # handed in by the strength stage when it knows them (AHAT/ALL),
+    # else re-derived by the chunked searchsorted membership test
+    if strong_flag is None:
+        strong_flag = strong_entry_flags(Asp, S)
 
     is_C_col = cf[indices] == 1
     neg = data < 0
     pos = offd & (data > 0)
 
-    sum_neg = np.zeros(n)
-    np.add.at(sum_neg, row_ids, np.where(offd & neg, data, 0.0))
-    sum_pos = np.zeros(n)
-    np.add.at(sum_pos, row_ids, np.where(pos, data, 0.0))
+    sum_neg = _row_sum(row_ids, np.where(offd & neg, data, 0.0), n)
+    sum_pos = _row_sum(row_ids, np.where(pos, data, 0.0), n)
     strongC = strong_flag & is_C_col
-    sum_negC = np.zeros(n)
-    np.add.at(sum_negC, row_ids, np.where(strongC & neg, data, 0.0))
-    sum_posC = np.zeros(n)
-    np.add.at(sum_posC, row_ids, np.where(strongC & pos, data, 0.0))
+    sum_negC = _row_sum(row_ids, np.where(strongC & neg, data, 0.0), n)
+    sum_posC = _row_sum(row_ids, np.where(strongC & pos, data, 0.0), n)
 
     diag = Asp.diagonal().astype(np.float64).copy()
     no_posC = sum_posC == 0
@@ -613,8 +696,8 @@ def truncate_interp(P: sps.csr_matrix, trunc_factor: float,
     absd = np.abs(data)
     keep = np.ones(len(data), dtype=bool)
     if trunc_factor < 1.0:
-        rmax = np.zeros(n)
-        np.maximum.at(rmax, row_ids, absd)
+        rmax = _row_max(absd, indptr, row_ids, 0.0,
+                        out_dtype=np.float64)
         keep &= absd >= trunc_factor * rmax[row_ids]
     if max_elements >= 0:
         # rank within row by descending magnitude (stable, deterministic)
@@ -625,10 +708,8 @@ def truncate_interp(P: sps.csr_matrix, trunc_factor: float,
             np.concatenate([[0], np.cumsum(counts)[:-1]]), counts
         )
         keep &= rank < max_elements
-    rs_old = np.zeros(n)
-    np.add.at(rs_old, row_ids, data)
-    rs_new = np.zeros(n)
-    np.add.at(rs_new, row_ids, np.where(keep, data, 0.0))
+    rs_old = _row_sum(row_ids, data, n)
+    rs_new = _row_sum(row_ids, np.where(keep, data, 0.0), n)
     scale = np.where(rs_new != 0, rs_old / np.where(rs_new != 0, rs_new, 1),
                      1.0)
     newdata = data * keep * scale[row_ids]
@@ -658,17 +739,31 @@ def build_classical_level(Asp, cfg, scope, level_id: int = 0):
         cfg.get("aggressive_interpolator", scope)
     ).upper()
 
-    if strength == "ALL":
-        S = strength_all(Asp)
-    elif strength == "AFFINITY":
-        S = strength_affinity(
-            Asp,
-            theta,
-            n_vectors=int(cfg.get("affinity_vectors", scope)),
-            n_iters=int(cfg.get("affinity_iterations", scope)),
-        )
-    else:  # AHAT default
-        S = strength_ahat(Asp, theta, max_row_sum)
+    # per-A-entry strong flags ride along from the strength stage when
+    # it knows them (fast path: saves the D1 interpolator's membership
+    # re-derivation, a full extra pass over A's pattern)
+    strong_flag = None
+    fast = setup_fastpath_enabled()
+    with setup_phase("strength"):
+        if strength == "ALL":
+            if fast:
+                S, strong_flag = strength_all(Asp, return_flags=True)
+            else:
+                S = strength_all(Asp)
+        elif strength == "AFFINITY":
+            S = strength_affinity(
+                Asp,
+                theta,
+                n_vectors=int(cfg.get("affinity_vectors", scope)),
+                n_iters=int(cfg.get("affinity_iterations", scope)),
+            )
+        else:  # AHAT default
+            if fast:
+                S, strong_flag = strength_ahat(
+                    Asp, theta, max_row_sum, return_flags=True
+                )
+            else:
+                S = strength_ahat(Asp, theta, max_row_sum)
 
     aggressive = (
         level_id < aggressive_levels
@@ -678,40 +773,47 @@ def build_classical_level(Asp, cfg, scope, level_id: int = 0):
                         "AGGRESSIVE_HMIS", "RS", "CR", "DUMMY"):
         warnings.warn(f"selector {selector}: using PMIS")
     if aggressive:
-        cf = aggressive_pmis_select(S)
+        with setup_phase("cf_split"):
+            cf = aggressive_pmis_select(S)
         if aggressive_interp != "MULTIPASS":
             warnings.warn(
                 f"aggressive interpolator {aggressive_interp}: "
                 "using MULTIPASS"
             )
-        P = multipass_interpolation(Asp, S, cf)
-    else:
-        if selector in ("RS",):
-            cf = rs_select(S)
-        elif selector == "HMIS":
-            cf = hmis_select(S)
-        elif selector == "CR":
-            cf = cr_select(S, Asp)
-        else:
-            cf = pmis_select(S)
-        if interp == "D1":
-            P = direct_interpolation(Asp, S, cf)
-        elif interp in ("D2", "STD", "STANDARD"):
-            P = standard_interpolation(Asp, S, cf)
-        elif interp == "MULTIPASS":
-            # reference multipass.cu works with any selector (F points
-            # may lack direct strong C neighbours)
+        with setup_phase("interp"):
             P = multipass_interpolation(Asp, S, cf)
-        else:
-            warnings.warn(
-                f"interpolator {interp} not yet implemented; "
-                "using D2 standard"
-            )
-            P = standard_interpolation(Asp, S, cf)
-    P = truncate_interp(P, trunc, max_el)
-    R = P.T.tocsr()
-    Ac = (R @ Asp @ P).tocsr()
-    Ac.sum_duplicates()
+    else:
+        with setup_phase("cf_split"):
+            if selector in ("RS",):
+                cf = rs_select(S)
+            elif selector == "HMIS":
+                cf = hmis_select(S)
+            elif selector == "CR":
+                cf = cr_select(S, Asp)
+            else:
+                cf = pmis_select(S)
+        with setup_phase("interp"):
+            if interp == "D1":
+                P = direct_interpolation(Asp, S, cf,
+                                         strong_flag=strong_flag)
+            elif interp in ("D2", "STD", "STANDARD"):
+                P = standard_interpolation(Asp, S, cf)
+            elif interp == "MULTIPASS":
+                # reference multipass.cu works with any selector (F
+                # points may lack direct strong C neighbours)
+                P = multipass_interpolation(Asp, S, cf)
+            else:
+                warnings.warn(
+                    f"interpolator {interp} not yet implemented; "
+                    "using D2 standard"
+                )
+                P = standard_interpolation(Asp, S, cf)
+    with setup_phase("interp"):
+        P = truncate_interp(P, trunc, max_el)
+    with setup_phase("rap_execute"):
+        R = P.T.tocsr()
+        Ac = (R @ Asp @ P).tocsr()
+        Ac.sum_duplicates()
     if int(cfg.get("structure_reuse_levels", scope)) != 0:
         # structure reuse needs the FULL structural Galerkin pattern
         # stored: scipy's value matmul prunes numerically-cancelled

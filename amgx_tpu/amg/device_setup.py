@@ -46,23 +46,28 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgx_tpu.amg.classical import _hash_weights as _hash_weights_raw
+from amgx_tpu.core import profiling
 from amgx_tpu.core.errors import ResourceError
 
-# host seconds spent in tie-break hash generation since the last
-# profile reset: the O(n) numpy hashes run between device kernels and
-# must count as HOST work in the placement profile (the profile is the
-# round's 'Done' evidence — it must not be biased by its own pipeline)
-_hash_host_s = [0.0]
 
-
-def _hash_weights(n, seed=0):
+def _hash_weights(n, seed=0, acc=None):
+    """Tie-break hash weights, with the host seconds they cost added
+    to the caller's PER-CALL accumulator ``acc`` (a one-element list):
+    the O(n) numpy hashes run between device kernels and must count as
+    HOST work in the placement profile.  The accumulator used to be a
+    module-global list, which concurrent setups (serve compile worker
+    + foreground) corrupted — each build now owns its accumulator."""
     t0 = time.perf_counter()
     out = _hash_weights_raw(n, seed=seed)
-    _hash_host_s[0] += time.perf_counter() - t0
+    if acc is not None:
+        acc[0] += time.perf_counter() - t0
     return out
 
-# profile of the most recent level build (host vs device split);
-# accumulated into AMGSolver.setup_profile by the hierarchy driver
+
+# profile of the most recent level build (host vs device split) —
+# INFORMATIONAL only (last writer wins under concurrency); callers
+# that need reliable attribution pass ``profile=`` to
+# build_classical_level_device and read their own dict
 last_profile: dict = {}
 
 
@@ -390,8 +395,7 @@ def _spgemm_expand_sort_dev(a_rows, a_cols, a_vals, cum, cnt,
     return rows, cols, vals, first, nnz_out
 
 
-@functools.partial(jax.jit, static_argnames=("out_size",))
-def _spgemm_compress_dev(rows, cols, vals, first, out_size, n_left):
+def _spgemm_compress_impl(rows, cols, vals, first, out_size, n_left):
     """Phase 3 (compress): scatter-add duplicate runs into the padded
     output buffer (static ``out_size``)."""
     valid = rows < n_left
@@ -406,6 +410,31 @@ def _spgemm_compress_dev(rows, cols, vals, first, out_size, n_left):
         cols, mode="drop")
     oval = oval.at[slot].add(vals, mode="drop")
     return orow, ocol, oval
+
+
+@functools.lru_cache(maxsize=2)
+def _compress_jit(donate: bool):
+    """Compress executable, with the expand/sort intermediates DONATED
+    on accelerator backends: the sorted triples + boundary mask are
+    dead after compression, and donating them lets XLA reuse those
+    HBM buffers for the (bucket-padded, same-scale) outputs instead of
+    holding both live — the peak-memory term of the ESC Galerkin
+    chain.  CPU skips donation (unimplemented there; XLA warns)."""
+    if donate:
+        return jax.jit(
+            _spgemm_compress_impl,
+            static_argnames=("out_size",),
+            donate_argnums=(0, 1, 2, 3),
+        )
+    return jax.jit(_spgemm_compress_impl, static_argnames=("out_size",))
+
+
+def _spgemm_compress_dev(rows, cols, vals, first, out_size, n_left):
+    from amgx_tpu.solvers.base import donation_enabled
+
+    return _compress_jit(donation_enabled())(
+        rows, cols, vals, first, out_size, n_left
+    )
 
 
 def _indptr_from_sorted_rows(rows, n):
@@ -518,14 +547,15 @@ def _compact_masked(rows, cols, vals, keep, sentinel_row):
 # aggressive two-stage PMIS (reference selectors AGGRESSIVE_PMIS)
 
 
-def aggressive_pmis_device(rows, cols, vals, strong, n, dtype):
+def aggressive_pmis_device(rows, cols, vals, strong, n, dtype,
+                           hash_acc=None):
     """Two-stage aggressive coarsening: PMIS on S, then PMIS (seed 1)
     among the stage-1 C points on the distance-2 graph S + S@S —
     bit-compatible with the host ``aggressive_pmis_select``."""
     fdt = jnp.float64 if dtype == np.float64 else jnp.float32
     lam = jax.ops.segment_sum(
         strong.astype(fdt), jnp.minimum(cols, n - 1), num_segments=n)
-    w0 = lam + jnp.asarray(_hash_weights(n, seed=0), fdt)
+    w0 = lam + jnp.asarray(_hash_weights(n, seed=0, acc=hash_acc), fdt)
     cf1 = _pmis_dev(rows, cols, strong, n, w0).astype(jnp.int32)
     nc1 = int(cf1.sum())  # scalar sync
     if nc1 <= 1:
@@ -550,7 +580,7 @@ def aggressive_pmis_device(rows, cols, vals, strong, n, dtype):
     edgeC = crow < nc1
     lam2 = jax.ops.segment_sum(
         edgeC.astype(fdt), jnp.minimum(ccol, nc1 - 1), num_segments=nc1)
-    w2 = lam2 + jnp.asarray(_hash_weights(nc1, seed=1), fdt)
+    w2 = lam2 + jnp.asarray(_hash_weights(nc1, seed=1, acc=hash_acc), fdt)
     cf2 = _pmis_dev(crow, ccol, edgeC, nc1, w2)
     # scatter back: final C = stage-1 C that survived stage 2
     cf = (cf1 == 1) & (cf2.astype(jnp.int32)[
@@ -725,6 +755,28 @@ def standard_interpolation_device(rows, cols, vals, strong, cf, n,
 
 
 # ----------------------------------------------------------------------
+# Galerkin chain
+
+
+def galerkin_rap_device(rows, cols, vals, prow, pcol, pval,
+                        n, nc, prof=None):
+    """The per-level Galerkin tail — R = P^T, AP = A @ P, Ac = R @ AP
+    — as ONE driver call over the ESC kernels, with the expand/sort
+    intermediates donated into their compress stages (_compress_jit).
+    The only host round-trips are the four scalar size syncs of the
+    two products (the reference csr_multiply.cu counter readbacks);
+    they are counted into ``prof`` and the module-level setup-sync
+    hook.  Returns ((rrow, rcol, rval), (ac_rows, ac_cols, ac_vals,
+    nnz_ac))."""
+    rrow, rcol, rval = _transpose_dev(prow, pcol, pval, n, nc)
+    ap = spgemm_device(rows, cols, vals, n, prow, pcol, pval, n)
+    ac = spgemm_device(rrow, rcol, rval, nc, ap[0], ap[1], ap[2], n)
+    if prof is not None:
+        prof["syncs"] = prof.get("syncs", 0) + 4
+    return (rrow, rcol, rval), ac
+
+
+# ----------------------------------------------------------------------
 # orchestration
 
 
@@ -767,17 +819,21 @@ def _coo_to_scipy(rows, cols, vals, nnz, shape):
     return sps.csr_matrix((v, c.astype(np.int64), indptr), shape=shape)
 
 
-def build_classical_level_device(Asp, cfg, scope, level_id: int = 0):
+def build_classical_level_device(Asp, cfg, scope, level_id: int = 0,
+                                 profile: dict | None = None):
     """One classical level on device (strength -> PMIS -> D1 -> RAP).
 
-    Returns (P, R, Ac) as scipy CSR for the driver loop, plus a
-    host/device timing profile in ``last_profile``.  Raises nothing:
-    callers gate on :func:`device_setup_eligible`.
+    Returns (P, R, Ac) as scipy CSR for the driver loop.  The
+    host/device timing split accumulates into ``profile`` when given
+    (per-call state — safe under concurrent setups); ``last_profile``
+    still mirrors the most recent build for interactive inspection.
+    Raises nothing: callers gate on :func:`device_setup_eligible`.
     """
     import warnings
 
     global last_profile
     prof = {"host_s": 0.0, "device_s": 0.0, "syncs": 0}
+    hash_acc = [0.0]  # per-call (was a corruptible module global)
     theta = float(cfg.get("strength_threshold", scope))
     max_row_sum = float(cfg.get("max_row_sum", scope))
     selector = str(cfg.get("selector", scope)).upper()
@@ -803,7 +859,6 @@ def build_classical_level_device(Asp, cfg, scope, level_id: int = 0):
     )
     prof["host_s"] += time.perf_counter() - t0
 
-    _hash_host_s[0] = 0.0
     t0 = time.perf_counter()
     rows = jnp.asarray(r_np)
     cols = jnp.asarray(c_np)
@@ -818,7 +873,7 @@ def build_classical_level_device(Asp, cfg, scope, level_id: int = 0):
                 "using MULTIPASS"
             )
         cf, nc = aggressive_pmis_device(rows, cols, vals, strong, n,
-                                        Asp.dtype)
+                                        Asp.dtype, hash_acc=hash_acc)
         prof["syncs"] += 4
         prow, pcol, pval, nnzP, nc = multipass_interpolation_device(
             rows, cols, vals, strong, cf, n)
@@ -830,7 +885,9 @@ def build_classical_level_device(Asp, cfg, scope, level_id: int = 0):
             strong.astype(fdt), jnp.minimum(cols, n - 1),
             num_segments=n,
         )
-        wdev = lam + jnp.asarray(_hash_weights(n, seed=0), fdt)
+        wdev = lam + jnp.asarray(
+            _hash_weights(n, seed=0, acc=hash_acc), fdt
+        )
         cf = _pmis_dev(rows, cols, strong, n, wdev)
         if interp == "MULTIPASS":
             prow, pcol, pval, nnzP, nc = multipass_interpolation_device(
@@ -855,22 +912,28 @@ def build_classical_level_device(Asp, cfg, scope, level_id: int = 0):
 
     prow, pcol, pval, nnzP = truncate_interp_device(
         prow, pcol, pval, nnzP, n, trunc, max_el)
-    # R = P^T
-    rrow, rcol, rval = _transpose_dev(prow, pcol, pval, n, nc)
-    # Galerkin: AP = A @ P ; Ac = R @ AP
-    ap = spgemm_device(rows, cols, vals, n, prow, pcol, pval, n)
-    prof["syncs"] += 2
-    ac = spgemm_device(rrow, rcol, rval, nc, ap[0], ap[1], ap[2], n)
-    prof["syncs"] += 2
+    # Galerkin tail (transpose + AP + RAP) as one driver call with
+    # donated expand/sort intermediates
+    (rrow, rcol, rval), ac = galerkin_rap_device(
+        rows, cols, vals, prow, pcol, pval, n, nc, prof=prof
+    )
     jax.block_until_ready(ac[2])
     # hash generation ran on host between kernels: reattribute
-    prof["device_s"] += time.perf_counter() - t0 - _hash_host_s[0]
-    prof["host_s"] += _hash_host_s[0]
+    prof["device_s"] += time.perf_counter() - t0 - hash_acc[0]
+    prof["host_s"] += hash_acc[0]
 
     t0 = time.perf_counter()
     P = _coo_to_scipy(prow, pcol, pval, nnzP, (n, nc))
     R = _coo_to_scipy(rrow, rcol, rval, nnzP, (nc, n))
     Ac = _coo_to_scipy(ac[0], ac[1], ac[2], ac[3], (nc, nc))
     prof["host_s"] += time.perf_counter() - t0
+    if profile is not None:
+        for k, v in prof.items():
+            profile[k] = profile.get(k, 0) + v
+    # ONE module-hook update covering the whole build, so the
+    # test-countable setup_sync_count agrees exactly with the
+    # per-call profile's sync ledger (aggressive/multipass/D2 paths
+    # included), instead of only the Galerkin tail's share
+    profiling.count_setup_sync(prof["syncs"])
     last_profile = prof
     return P, R, Ac

@@ -23,7 +23,13 @@ import numpy as np
 import scipy.sparse as sps
 
 from amgx_tpu.core.matrix import SparseMatrix
-from amgx_tpu.core.profiling import named_scope
+from amgx_tpu.core.profiling import (
+    named_scope,
+    setup_fastpath_enabled,
+    setup_phase,
+    setup_profile_scope,
+    setup_transfer,
+)
 from amgx_tpu.ops.blas import dot
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
@@ -37,6 +43,55 @@ from amgx_tpu.solvers.registry import (
 # gamma-cycle branch-depth cap shared by the serial and distributed
 # cycles
 W_MAX_BRANCH_LEVELS = 6
+
+
+def levels_bitwise_equal(amg_a, amg_b) -> str | None:
+    """Compare two set-up AMG hierarchies for BITWISE equality of
+    their level structure — level count, A/P/R presence, patterns,
+    values, and rebuilt acceleration structures (DIA/ELL values).
+
+    Returns None when equal, else a short human-readable mismatch
+    description.  This is the single parity contract shared by the
+    fast-vs-reference gates (ci/setup_bench.py and
+    tests/test_setup_fastpath.py) — extend it HERE so both stay in
+    lockstep."""
+    if len(amg_a.levels) != len(amg_b.levels):
+        return (
+            f"level count {len(amg_a.levels)} != {len(amg_b.levels)}"
+        )
+    for la, lb in zip(amg_a.levels, amg_b.levels):
+        for field in ("A", "P", "R"):
+            ma, mb = getattr(la, field), getattr(lb, field)
+            if (ma is None) != (mb is None):
+                return (
+                    f"level {la.level_id} {field} presence mismatch"
+                )
+            if ma is None:
+                continue
+            for arr in ("row_offsets", "col_indices", "values"):
+                if not np.array_equal(
+                    np.asarray(getattr(ma, arr)),
+                    np.asarray(getattr(mb, arr)),
+                ):
+                    return (
+                        f"level {la.level_id} {field}.{arr} not "
+                        "bitwise-identical"
+                    )
+            for accel in ("dia_vals", "ell_vals"):
+                va, vb = getattr(ma, accel), getattr(mb, accel)
+                if (va is None) != (vb is None):
+                    return (
+                        f"level {la.level_id} {field}.{accel} "
+                        "presence mismatch"
+                    )
+                if va is not None and not np.array_equal(
+                    np.asarray(va), np.asarray(vb)
+                ):
+                    return (
+                        f"level {la.level_id} {field}.{accel} not "
+                        "bitwise-identical"
+                    )
+    return None
 
 
 class AMGLevel:
@@ -168,8 +223,12 @@ class AMGSolver(Solver):
             if device_setup_eligible(self.cfg, self.scope, level_id,
                                      dtype=Asp.dtype):
                 try:
+                    # per-call profile out-param (the old module-global
+                    # last_profile read was corruptible by concurrent
+                    # setups on the serve compile worker)
                     out = build_classical_level_device(
-                        Asp, self.cfg, self.scope, level_id
+                        Asp, self.cfg, self.scope, level_id,
+                        profile=self.setup_profile,
                     )
                 except (MemoryError, RuntimeError) as e:
                     # generalized recovery policy (guardrails):
@@ -190,12 +249,6 @@ class AMGSolver(Solver):
                         "the host builder"
                     )
                 else:
-                    from amgx_tpu.amg import device_setup
-
-                    for k, v in device_setup.last_profile.items():
-                        self.setup_profile[k] = (
-                            self.setup_profile.get(k, 0) + v
-                        )
                     return out
             elif explicit_device:
                 import warnings
@@ -235,15 +288,67 @@ class AMGSolver(Solver):
     def _setup_impl(self, A: SparseMatrix):
         from amgx_tpu.ops.diagonal import scalarized
 
-        A = scalarized(A, "AMG")
-        self.levels = [AMGLevel(A, 0)]
-        self._coarsen_from(A.to_scipy())
-        self._finalize_setup()
+        self.setup_profile = {}
+        with setup_profile_scope(self.setup_profile):
+            # block systems: the scalar expansion is host-resident on
+            # the fast path — as levels[0].A it rides the batched
+            # finalize transfer, keeping ≤1 batch per cold setup
+            A = scalarized(A, "AMG",
+                           device=not setup_fastpath_enabled())
+            self.levels = [AMGLevel(A, 0)]
+            # fast path: read the finest operator back through the
+            # construction-time host memo instead of a device->host
+            # download (the first of the ping-pongs cold setup used to
+            # pay); the reference path keeps the download
+            with setup_phase("host_csr"):
+                Asp = (
+                    A.host_csr() if setup_fastpath_enabled()
+                    else A.to_scipy()
+                )
+            self._coarsen_from(Asp)
+            self._finalize_setup()
+            # the finest operator's lazy host memo served the
+            # coarsening read-back; drop it now for the same reason
+            # _upload_levels never propagates memos onto coarse
+            # levels — a set-up hierarchy must not pin host CSR
+            # copies for its lifetime (it re-materializes lazily if
+            # ever needed again; zero-copy on CPU)
+            try:
+                object.__delattr__(
+                    self.levels[0].A, "_host_csr_cache"
+                )
+            except AttributeError:
+                pass
+        self._maybe_dump_setup_profile()
+
+    def _maybe_dump_setup_profile(self):
+        from amgx_tpu.core.profiling import (
+            setup_profile_dump_enabled,
+            setup_profile_table,
+        )
+
+        if setup_profile_dump_enabled() and self.setup_profile:
+            from amgx_tpu.core.printing import emit
+
+            emit(
+                "AMG setup profile "
+                f"(levels={len(self.levels)}):\n"
+                + setup_profile_table(self.setup_profile)
+            )
 
     def _coarsen_from(self, Asp):
         """Extend ``self.levels`` by coarsening from the last level
-        (whose host CSR is ``Asp``) until a stop condition hits."""
+        (whose host CSR is ``Asp``) until a stop condition hits.
+
+        Fast path (AMGX_TPU_SETUP_FASTPATH, default on): every matrix
+        this loop builds is HOST-RESIDENT (``device=False``) — the
+        whole coarsening chain strength -> select -> P -> Galerkin
+        stays in numpy, and ``_finalize_setup`` ships the finished
+        hierarchy to the device in one batched transfer
+        (``_upload_levels``).  The reference path uploads each level's
+        P/R/Ac eagerly as before."""
         self.setup_stats["coarsen_calls"] += 1
+        defer = setup_fastpath_enabled()
         # reference amg.cu:207-230: when the coarse solver is dense LU,
         # coarsening stops once the level fits the dense trigger size
         coarse_name, _ = self.cfg.get_scoped("coarse_solver", self.scope)
@@ -272,19 +377,29 @@ class AMGSolver(Solver):
                 from amgx_tpu.ops.reorder import reorder_coarse_level
 
                 P, R, Ac = reorder_coarse_level(P, R, Ac, dtype)
-            lvl.P = SparseMatrix.from_scipy(P.astype(dtype))
-            lvl.R = SparseMatrix.from_scipy(R.astype(dtype))
-            Ac = Ac.astype(dtype)
+            lvl.P = SparseMatrix.from_scipy(
+                P.astype(dtype, copy=False), device=not defer
+            )
+            lvl.R = SparseMatrix.from_scipy(
+                R.astype(dtype, copy=False), device=not defer
+            )
+            Ac = Ac.astype(dtype, copy=False)
             if self.structure_reuse != 0:
-                lvl.rap_plan = self._try_plan_rap(R, Asp, P, Ac)
+                with setup_phase("rap_plan"):
+                    lvl.rap_plan = self._try_plan_rap(
+                        R, Asp, P, Ac, device=not defer
+                    )
             self.levels.append(
-                AMGLevel(SparseMatrix.from_scipy(Ac), len(self.levels))
+                AMGLevel(
+                    SparseMatrix.from_scipy(Ac, device=not defer),
+                    len(self.levels),
+                )
             )
             self.setup_stats["levels_built"] += 1
             Asp = Ac
 
     @staticmethod
-    def _try_plan_rap(R, Asp, P, Ac):
+    def _try_plan_rap(R, Asp, P, Ac, device: bool = True):
         """Numeric-Galerkin plan for structure reuse, or None when the
         stored coarse pattern doesn't cover the product (truncation,
         geometric dense-reduction with dropped entries)."""
@@ -293,24 +408,67 @@ class AMGSolver(Solver):
         try:
             Acc = Ac.tocsr().copy()
             Acc.sort_indices()
-            return plan_rap(R.tocsr(), Asp.tocsr(), P.tocsr(), Acc)
+            return plan_rap(R.tocsr(), Asp.tocsr(), P.tocsr(), Acc,
+                            device=device)
         except ValueError:
             return None
 
+    @staticmethod
+    def _is_host_resident(obj) -> bool:
+        return obj is not None and any(
+            isinstance(leaf, np.ndarray)
+            for leaf in jax.tree_util.tree_leaves(obj)
+        )
+
+    def _upload_levels(self):
+        """Batched finalize (the tentpole transfer discipline): ship
+        every host-resident leaf the deferred coarsening produced —
+        all levels' CSR/ELL/DIA values, gather maps, P/R and Galerkin
+        plan index lists — in ONE batched ``jax.device_put`` (the same
+        lever the store restore path measured ~10x on,
+        store/serialize.py unflatten).  Device-resident objects (the
+        finest operator, restored levels) are left untouched so object
+        identity — which the artifact store dedups on — is preserved."""
+        sites = []  # (level, field_name, host_resident_obj)
+        for lvl in self.levels:
+            for name in ("A", "P", "R", "rap_plan"):
+                obj = getattr(lvl, name)
+                if self._is_host_resident(obj):
+                    sites.append((lvl, name, obj))
+        if not sites:
+            return
+        leaves, treedef = jax.tree_util.tree_flatten(
+            [obj for _, _, obj in sites]
+        )
+        dev_objs = jax.tree_util.tree_unflatten(
+            treedef, setup_transfer(leaves)
+        )
+        for (lvl, name, old), new in zip(sites, dev_objs):
+            if isinstance(old, SparseMatrix):
+                # structure fingerprint rides along; the host-CSR memo
+                # deliberately does NOT — the coarsening that needed it
+                # is over, and propagating it would pin every level's
+                # full host CSR for the hierarchy's lifetime
+                old._propagate_structure_memo(new)
+            setattr(lvl, name, new)
+
     def _finalize_setup(self, reuse_smoothers: bool = False):
+        self._upload_levels()
         # smoothers on all but the coarsest; coarse solver on the last.
         # reuse_smoothers (store-restore path ONLY): keep smoothers the
         # importer already restored — setup/resetup must NOT pass it
         # (their level values changed, so smoother params must rebuild)
-        for lvl in self.levels[:-1]:
-            if not (reuse_smoothers and lvl.smoother is not None):
-                lvl.smoother = self._make_smoother(lvl.A)
-        coarsest = self.levels[-1]
-        self.coarse_solver = self._make_coarse_solver(coarsest.A)
-        if self.coarse_solver is None and len(self.levels) > 0:
-            # coarsest-level smoothing fallback (coarse_solver=NOSOLVER)
-            if not (reuse_smoothers and coarsest.smoother is not None):
-                coarsest.smoother = self._make_smoother(coarsest.A)
+        with setup_phase("finalize"):
+            for lvl in self.levels[:-1]:
+                if not (reuse_smoothers and lvl.smoother is not None):
+                    lvl.smoother = self._make_smoother(lvl.A)
+            coarsest = self.levels[-1]
+            self.coarse_solver = self._make_coarse_solver(coarsest.A)
+            if self.coarse_solver is None and len(self.levels) > 0:
+                # coarsest-level smoothing fallback
+                # (coarse_solver=NOSOLVER)
+                if not (reuse_smoothers and coarsest.smoother is not None):
+                    coarsest.smoother = self._make_smoother(coarsest.A)
 
         self._params = self._collect_params()
         # reference solver.cu:541-546: grid stats and vis data print
@@ -337,26 +495,29 @@ class AMGSolver(Solver):
         lvl0 = self.levels[0]
         if A.n_rows != lvl0.A.n_rows or A.nnz != lvl0.A.nnz:
             return False
-        lvl0.A = lvl0.A.replace_values(A.values)
-        depth = len(self.levels) - 1
-        if self.structure_reuse > 0:
-            depth = min(self.structure_reuse, depth)
-        i = 0
-        while i < depth and self.levels[i].rap_plan is not None:
-            lvl = self.levels[i]
-            ac_vals = lvl.rap_plan.apply(
-                lvl.R.values, lvl.A.values, lvl.P.values
-            )
-            nxt = self.levels[i + 1]
-            nxt.A = nxt.A.replace_values(ac_vals)
-            i += 1
-        if i < len(self.levels) - 1:
-            # tail not refreshable in place: re-coarsen from level i
-            del self.levels[i + 1:]
-            self.levels[i].P = self.levels[i].R = None
-            self.levels[i].rap_plan = None
-            self._coarsen_from(self.levels[i].A.to_scipy())
-        self._finalize_setup()
+        self.setup_profile = {}
+        with setup_profile_scope(self.setup_profile):
+            lvl0.A = lvl0.A.replace_values(A.values)
+            depth = len(self.levels) - 1
+            if self.structure_reuse > 0:
+                depth = min(self.structure_reuse, depth)
+            i = 0
+            with setup_phase("rap_execute"):
+                while i < depth and self.levels[i].rap_plan is not None:
+                    lvl = self.levels[i]
+                    ac_vals = lvl.rap_plan.apply(
+                        lvl.R.values, lvl.A.values, lvl.P.values
+                    )
+                    nxt = self.levels[i + 1]
+                    nxt.A = nxt.A.replace_values(ac_vals)
+                    i += 1
+            if i < len(self.levels) - 1:
+                # tail not refreshable in place: re-coarsen from level i
+                del self.levels[i + 1:]
+                self.levels[i].P = self.levels[i].R = None
+                self.levels[i].rap_plan = None
+                self._coarsen_from(self.levels[i].A.to_scipy())
+            self._finalize_setup()
         return True
 
     # ------------------------------------------------------------------

@@ -75,11 +75,14 @@ class SpMMPlan:
         return int(self.left_idx.shape[0])
 
 
-def plan_spmm(Bsp, Csp, Outsp) -> SpMMPlan:
+def plan_spmm(Bsp, Csp, Outsp, device: bool = True) -> SpMMPlan:
     """Build the numeric plan for ``Outsp = Bsp @ Csp`` (host, numpy).
 
     ``Outsp`` must be the scipy product's CSR structure (canonical,
-    sorted indices); its values are ignored.
+    sorted indices); its values are ignored.  ``device=False`` leaves
+    the index lists as numpy (host-resident) so the AMG batched
+    finalize can ship every level's plan in the same single
+    ``device_put`` as the level operators.
     """
     B = Bsp.tocsr()
     C = Csp.tocsr()
@@ -118,10 +121,11 @@ def plan_spmm(Bsp, Csp, Outsp) -> SpMMPlan:
     ):
         raise ValueError("Outsp pattern does not cover the product")
     order = np.argsort(pos, kind="stable")
+    dev = jnp.asarray if device else (lambda x: x)
     return SpMMPlan(
-        left_idx=jnp.asarray(b_idx[order].astype(np.int32)),
-        right_idx=jnp.asarray(c_flat[order].astype(np.int32)),
-        out_idx=jnp.asarray(pos[order].astype(np.int32)),
+        left_idx=dev(b_idx[order].astype(np.int32)),
+        right_idx=dev(c_flat[order].astype(np.int32)),
+        out_idx=dev(pos[order].astype(np.int32)),
         nnz_out=int(Out.indices.shape[0]),
     )
 
@@ -140,7 +144,7 @@ class RAPPlan:
         return self.rap.apply(r_vals, ap_vals)
 
 
-def plan_rap(Rsp, Asp, Psp, Acsp) -> RAPPlan:
+def plan_rap(Rsp, Asp, Psp, Acsp, device: bool = True) -> RAPPlan:
     """Host symbolic phase for the Galerkin product (scipy structures).
 
     ``Acsp`` must be (or cover) the structure of ``R @ A @ P`` —
@@ -165,6 +169,6 @@ def plan_rap(Rsp, Asp, Psp, Acsp) -> RAPPlan:
     APsp = (Ab @ Pb).tocsr()
     APsp.sort_indices()
     return RAPPlan(
-        ap=plan_spmm(Asp, Psp, APsp),
-        rap=plan_spmm(Rsp, APsp, Acsp),
+        ap=plan_spmm(Asp, Psp, APsp, device=device),
+        rap=plan_spmm(Rsp, APsp, Acsp, device=device),
     )

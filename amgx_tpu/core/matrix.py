@@ -304,6 +304,7 @@ class SparseMatrix:
         dtype=None,
         accel_formats=("dia", "dense", "ell"),
         validate=None,
+        device=True,
     ) -> "SparseMatrix":
         """Build from host CSR arrays (also the upload path — reference
         AMGX_matrix_upload_all, amgx_c.h:262-279).
@@ -320,6 +321,14 @@ class SparseMatrix:
         malformed CSR raises ``PatternDegeneracyError``, NaN/Inf
         coefficients raise ``NonFiniteValuesError`` — typed at the
         upload boundary instead of a NaN solve status much later.
+
+        ``device=False`` builds a HOST-RESIDENT matrix: every array
+        leaf stays numpy so a caller constructing many matrices (the
+        AMG coarsening loop) can ship them all in ONE batched
+        ``jax.device_put`` later (per-array puts cost ~0.5 ms each —
+        the dominant per-level setup cost the batched finalize
+        removes).  Host-resident matrices are construction-time
+        intermediates: solve paths expect device leaves.
         """
         row_offsets = np.asarray(row_offsets, dtype=np.int32)
         col_indices = np.asarray(col_indices, dtype=np.int32)
@@ -420,8 +429,11 @@ class SparseMatrix:
                     if built is not None:
                         ell_wcols, ell_wvals, ell_wbase, ell_wwidth = built
 
-        dev = jnp.asarray
-        return SparseMatrix(
+        if device:
+            dev = jnp.asarray
+        else:
+            dev = lambda x: x  # noqa: E731 — host-resident build
+        m = SparseMatrix(
             row_offsets=dev(row_offsets),
             col_indices=dev(col_indices),
             values=dev(values),
@@ -445,6 +457,25 @@ class SparseMatrix:
             views=views,
             partition=partition,
         )
+        if device:
+            from amgx_tpu.core import profiling
+
+            # eager per-matrix upload: counts as one transfer batch
+            # when a setup profile is active (the reference cold-setup
+            # path performs several of these per level; the fast path's
+            # single batched finalize is asserted against this hook)
+            if profiling.active_setup_profile() is not None:
+                n_arr = sum(
+                    x is not None
+                    for x in (
+                        row_offsets, col_indices, values, row_ids,
+                        diag, ell_cols, ell_vals, ell_wcols, ell_wvals,
+                        ell_wbase, dia_vals, dense, diag_src, dia_src,
+                        ell_src,
+                    )
+                )
+                profiling.count_setup_transfer(n_arr)
+        return m
 
     @staticmethod
     def from_coo(
@@ -498,6 +529,46 @@ class SparseMatrix:
             block_size=block_size,
             **kw,
         )
+
+    def host_csr(self):
+        """Scalar-expanded scipy CSR through a LAZY host memo: the
+        first call materializes the CSR triple on host (``np.asarray``
+        — zero-copy for host-resident builds and on the CPU backend, a
+        one-time download on accelerators) and caches it, so repeated
+        setups over the same operator never re-download.  Nothing is
+        retained for matrices that never call this, and the memo reads
+        the immutable device buffers — it can never desynchronize from
+        the values the solve uses.
+
+        READ-ONLY contract: the b==1 result shares the memoized numpy
+        buffers — callers must not mutate it in place (the AMG setup
+        chain builds fresh matrices at every stage and never does).
+        ``to_scipy`` remains the mutable-copy API."""
+        import scipy.sparse as sps
+
+        cached = getattr(self, "_host_csr_cache", None)
+        if cached is None:
+            cached = (
+                np.asarray(self.row_offsets),
+                np.asarray(self.col_indices),
+                np.asarray(self.values),
+            )
+            object.__setattr__(self, "_host_csr_cache", cached)
+        ro, ci, v = cached
+        if self.block_size == 1:
+            # sortedness probes stay lazy: a raw from_csr upload may
+            # carry unsorted columns, exactly like the to_scipy copy
+            return sps.csr_matrix(
+                (v, ci, ro), shape=(self.n_rows, self.n_cols),
+                copy=False,
+            )
+        return sps.bsr_matrix(
+            (v, ci, ro),
+            shape=(
+                self.n_rows * self.block_size,
+                self.n_cols * self.block_size,
+            ),
+        ).tocsr()
 
     def to_scipy(self):
         """Expand (blocks unrolled to scalars) to scipy CSR — host side."""

@@ -13,10 +13,148 @@ reference's ``level->Profile.tic("Smoother")`` instrumentation does
 from __future__ import annotations
 
 import contextlib
+import os
+import threading
 import time
 from collections import defaultdict
 
 import jax
+import numpy as np
+
+
+def setup_fastpath_enabled() -> bool:
+    """Cold-setup fast path (host-resident coarsening + batched
+    finalize transfer): ON by default; ``AMGX_TPU_SETUP_FASTPATH=0``
+    selects the reference path (eager per-array uploads, ufunc.at row
+    reductions) — kept for parity testing and old-vs-new benchmarking
+    (ci/setup_bench.py).  Read per call so tests/benches can toggle it
+    mid-process."""
+    return os.environ.get("AMGX_TPU_SETUP_FASTPATH", "1") != "0"
+
+
+# ----------------------------------------------------------------------
+# setup-phase profiling (the cold-setup observability surface)
+#
+# The AMG driver opens a setup_profile_scope around hierarchy
+# construction; coarsening code (amg/classical.py, amg/aggregation.py,
+# amg/device_setup.py) wraps its stages in setup_phase(...) without
+# needing a handle to the solver.  The scope stack is thread-local so
+# concurrent setups (serve compile worker + foreground) never write
+# into each other's profiles.
+
+_setup_tls = threading.local()
+
+# module-level transfer/sync accumulators — test-countable the same way
+# serve's _fetch_host/_block_ready hooks are (tests snapshot, run a
+# setup, and assert on the delta).  [batches, arrays, bytes] / [syncs].
+# Lock-guarded: concurrent setups (serve compile worker + foreground)
+# must not lose increments to interleaved read-modify-writes — the
+# exact corruption class the per-call device_setup accumulators fixed.
+setup_transfer_count = [0, 0, 0]
+setup_sync_count = [0]
+_counter_lock = threading.Lock()
+
+
+def _setup_stack():
+    st = getattr(_setup_tls, "stack", None)
+    if st is None:
+        st = _setup_tls.stack = []
+    return st
+
+
+@contextlib.contextmanager
+def setup_profile_scope(profile: dict):
+    """Activate ``profile`` as this thread's setup-phase sink; nested
+    scopes shadow outer ones (a smoother's own AMG setup would profile
+    into its own dict, not its parent's)."""
+    st = _setup_stack()
+    st.append(profile)
+    try:
+        yield profile
+    finally:
+        st.pop()
+
+
+def active_setup_profile() -> dict | None:
+    st = _setup_stack()
+    return st[-1] if st else None
+
+
+@contextlib.contextmanager
+def setup_phase(name: str):
+    """Accumulate wall-clock for one setup phase (strength, cf_split,
+    aggregation, interp, rap_plan, rap_execute, transfer, finalize)
+    into the active profile.  No-op outside a scope, so module-level
+    helpers can be instrumented unconditionally."""
+    prof = active_setup_profile()
+    if prof is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        prof[name] = prof.get(name, 0.0) + time.perf_counter() - t0
+
+
+def count_setup_sync(n: int = 1):
+    """Record ``n`` device->host synchronizations performed during
+    setup (scalar readbacks of the device pipeline) in the module
+    counter.  Per-profile "syncs" attribution stays with the caller's
+    own profile dict (the device pipeline threads one through its
+    build), so this hook never double-counts into the active scope."""
+    with _counter_lock:
+        setup_sync_count[0] += n
+
+
+def count_setup_transfer(n_arrays: int, n_bytes: int = 0):
+    """Record one host->device transfer BATCH of ``n_arrays`` arrays.
+    The fast path performs exactly one per hierarchy (the batched
+    finalize); the reference path counts one per from_csr upload."""
+    with _counter_lock:
+        setup_transfer_count[0] += 1
+        setup_transfer_count[1] += int(n_arrays)
+        setup_transfer_count[2] += int(n_bytes)
+    prof = active_setup_profile()
+    if prof is not None:
+        prof["transfer_batches"] = prof.get("transfer_batches", 0) + 1
+        prof["transfer_arrays"] = (
+            prof.get("transfer_arrays", 0) + int(n_arrays)
+        )
+
+
+def setup_transfer(leaves):
+    """Ship a list of array leaves host->device as ONE batched
+    ``jax.device_put`` (the store-restore lever, store/serialize.py
+    unflatten), counting it through the transfer hooks and timing it
+    into the active profile's ``transfer`` phase.  Device-resident
+    leaves pass through unchanged inside the same batch."""
+    host = [l for l in leaves if isinstance(l, np.ndarray)]
+    n_bytes = sum(l.nbytes for l in host)
+    with setup_phase("transfer"):
+        out = jax.device_put(leaves) if leaves else []
+        # device_put returns at dispatch; block so the recorded
+        # transfer phase covers the COPY, not just its enqueue (the
+        # very next setup stage consumes these buffers anyway)
+        jax.block_until_ready(out)
+        count_setup_transfer(len(host), n_bytes)
+    return out
+
+
+def setup_profile_table(profile: dict) -> str:
+    """Render a setup profile for the AMGX_TPU_SETUP_PROFILE=1 dump."""
+    lines = ["    setup phase                     value"]
+    for k in sorted(profile):
+        v = profile[k]
+        if isinstance(v, float):
+            lines.append(f"    setup:{k:<24s} {v:>12.6f} s")
+        else:
+            lines.append(f"    setup:{k:<24s} {v:>12}")
+    return "\n".join(lines)
+
+
+def setup_profile_dump_enabled() -> bool:
+    return os.environ.get("AMGX_TPU_SETUP_PROFILE") == "1"
 
 
 def trace_range(name: str):

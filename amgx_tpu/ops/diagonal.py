@@ -12,14 +12,16 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def scalarized(A, solver_name: str):
+def scalarized(A, solver_name: str, device: bool = True):
     """Scalar expansion of a block matrix (block rows/cols unrolled).
 
     Solvers without native block kernels operate on the expanded scalar
     operator — identical linear algebra, though block-coupled variants
     (e.g. block DILU) differ from their scalar expansions; native block
     paths are future work.  Vectors are flat (n*b,) either way, so no
-    caller-visible change."""
+    caller-visible change.  ``device=False`` builds the expansion
+    host-resident (the AMG fast path defers it to the batched finalize
+    transfer, preserving the one-batch-per-setup invariant)."""
     if A.block_size == 1:
         return A
     import warnings
@@ -34,7 +36,7 @@ def scalarized(A, solver_name: str):
     # the block expansion stores all b*b entries per block; drop explicit
     # zeros so the iteration operator (and colorings) keep the true graph
     sp.eliminate_zeros()
-    return SparseMatrix.from_scipy(sp)
+    return SparseMatrix.from_scipy(sp, device=device)
 
 
 def invert_diag(A):

@@ -835,6 +835,17 @@ class BatchedSolveService:
                 if batch_fn is not None
                 else None
             )
+        # cold-miss setup anatomy: fold the solver's per-phase setup
+        # profile (strength/aggregation/interp/rap/transfer/finalize,
+        # PR 5) into the service profile so serve metrics show WHERE a
+        # cold group's setup time went, not just that it happened
+        for k, v in solver.collect_setup_profile().items():
+            # floats only: the profile also carries integer COUNTERS
+            # (syncs, transfer_batches/arrays) that must not land in a
+            # seconds-denominated phase table
+            if isinstance(v, float):
+                self.metrics.profile.times[f"setup:{k}"] += v
+                self.metrics.profile.counts[f"setup:{k}"] += 1
         entry = HierarchyEntry(
             solver=solver,
             template=template,

@@ -570,6 +570,19 @@ class Solver:
     def apply_params(self):
         return self._params
 
+    def collect_setup_profile(self) -> dict:
+        """Merged setup-phase profile (``AMGSolver.setup_profile``
+        keys: strength/cf_split/aggregation/interp/rap_plan/
+        rap_execute/transfer/finalize/... ) of this solver and any
+        nested preconditioner — the dict behind ``obtain_timings``'s
+        ``setup:<phase>`` lines and bench.py's setup split."""
+        prof = dict(getattr(self, "setup_profile", None) or {})
+        inner = getattr(self, "precond", None)
+        if inner is not None and inner is not self:
+            for k, v in inner.collect_setup_profile().items():
+                prof[k] = prof.get(k, 0) + v
+        return prof
+
     def solve(self, b, x0=None, zero_initial_guess=False,
               block=True) -> SolveResult:
         """Monitored solve.  ``block=False`` is the async mode (PR 3):
@@ -657,6 +670,19 @@ class Solver:
                 f"    solve(per iteration): "
                 f"{self.solve_time / max(1, int(res.iters)):10.6f} s"
             )
+            setup_prof = self.collect_setup_profile()
+            if setup_prof:
+                # setup-phase anatomy (PR 5): the cold-setup cost
+                # broken down the way compile:/solve: split the solve
+                # side — doc/PERFORMANCE.md "Setup-phase anatomy"
+                lines = []
+                for k in sorted(setup_prof):
+                    v = setup_prof[k]
+                    if isinstance(v, float):
+                        lines.append(f"    setup:{k}: {v:10.6f} s")
+                    else:
+                        lines.append(f"    setup:{k}: {v}")
+                emit("\n".join(lines))
             mem = device_memory_stats()
             if mem is not None:
                 # reference "Mem Usage" column (memory_info.h:9-33);

@@ -148,6 +148,19 @@ def _solve_record(n_side):
     s = create_solver(cfg, "default")
     s.setup(A)
     setup_s = time.perf_counter() - t0
+    # setup anatomy (PR 5): a second fresh setup rides the now-warm
+    # process-global jit caches, so (first - second) isolates the
+    # first-jit compile cost that used to hide inside setup_s (dense-LU
+    # factorization, device RAP); the profiler's transfer phase splits
+    # host->device shipping out of the remainder.
+    t0 = time.perf_counter()
+    s2 = create_solver(cfg, "default")
+    s2.setup(A)
+    setup_warm_s = time.perf_counter() - t0
+    prof = s2.collect_setup_profile()
+    setup_transfer_s = float(prof.get("transfer", 0.0))
+    setup_compile_s = max(setup_s - setup_warm_s, 0.0)
+    setup_host_s = max(setup_warm_s - setup_transfer_s, 0.0)
     res = s.solve(b)  # warm-up (compile)
     t0 = time.perf_counter()
     res = s.solve(b)
@@ -165,6 +178,9 @@ def _solve_record(n_side):
         "problem": f"poisson7_{n_side}^3_f32",
         "config": "PCG+AMG(SIZE_8,V,Jacobi)",
         "setup_s": round(setup_s, 4),
+        "setup_host_s": round(setup_host_s, 4),
+        "setup_transfer_s": round(setup_transfer_s, 4),
+        "setup_compile_s": round(setup_compile_s, 4),
         "solve_s": round(solve_s, 4),
         "iterations": iters,
         "per_iteration_s": round(solve_s / max(iters, 1), 5),
@@ -235,6 +251,28 @@ def _store_record():
         }
     except Exception as e:  # noqa: BLE001
         print(f"bench: store record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
+def _setup_record():
+    """Cold-setup fast path: old-vs-new wall clock on the CI Poisson
+    suite (ci/setup_bench.py, reduced reps).  Guarded — the setup
+    record must never take the headline bench down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.setup_bench import run as setup_run
+
+        rec = setup_run(reps=2)
+        return {
+            k: rec[k]
+            for k in ("value", "unit", "cases")
+            if k in rec
+        }
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: setup record skipped: {e}", file=sys.stderr)
         return {"error": str(e)}
 
 
@@ -425,6 +463,10 @@ def main():
     store_rec = _store_record()
     print(f"bench: store {store_rec}", file=sys.stderr)
 
+    # ---- cold-setup fast path --------------------------------------
+    setup_rec = _setup_record()
+    print(f"bench: setup {setup_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -444,6 +486,7 @@ def main():
                 "solve": solve_rec,
                 "serve": serve_rec,
                 "store": store_rec,
+                "setup": setup_rec,
             }
         )
     )

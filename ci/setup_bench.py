@@ -1,0 +1,220 @@
+"""Cold-setup fast-path benchmark: reference path vs fast path on the
+CI Poisson suite.
+
+Prints ONE JSON line (same contract as bench.py / ci/store_bench.py):
+``{"metric": "setup_fastpath_speedup", "value": <x>, ...}`` — value is
+the geometric mean over the suite of
+
+    (reference-path setup seconds) / (fast-path setup seconds)
+
+where the reference path is ``AMGX_TPU_SETUP_FASTPATH=0`` (eager
+per-array uploads, ufunc.at row reductions, device matching on any
+backend) and the fast path is the PR 5 host-resident, transfer-batched
+pipeline.  A ``--floor`` (default 1.5; tentpole target 2x) guards the
+speedup in CI.
+
+The speedup only counts if the hierarchies are THE SAME: before any
+timing is reported, each case asserts the two paths produce the same
+level count, identical P/R/A patterns, bitwise-equal level values,
+identical PCG+AMG iteration counts, and that the fast path performed
+at most ONE host->device transfer batch for the whole hierarchy
+(counted through the profiling hooks).  A fast wrong setup must fail
+the bench, not win it.
+
+Run on the CPU backend (the tier the acceptance gate measures):
+
+    JAX_PLATFORMS=cpu python ci/setup_bench.py [--out FILE]
+
+Methodology: one warm-up setup per path first (jit compiles and other
+process-global warm-ups are excluded from BOTH sides equally), then
+best-of-``reps`` per path.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable from any cwd: the repo root precedes ci/ on the path
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+CLASSICAL = """
+{"config_version": 2,
+ "solver": {"scope": "main", "solver": "PCG", "max_iters": 100,
+    "tolerance": 1e-8, "monitor_residual": 1,
+    "convergence": "RELATIVE_INI",
+    "preconditioner": {"scope": "amg", "solver": "AMG",
+       "algorithm": "CLASSICAL", "selector": "PMIS",
+       "smoother": {"scope": "jac", "solver": "BLOCK_JACOBI",
+           "relaxation_factor": 0.8, "monitor_residual": 0},
+       "presweeps": 1, "postsweeps": 1, "max_levels": 20,
+       "min_coarse_rows": 16, "coarse_solver": "DENSE_LU_SOLVER",
+       "cycle": "V", "max_iters": 1, "monitor_residual": 0}}}
+"""
+
+AGGREGATION = """
+{"config_version": 2,
+ "solver": {"scope": "main", "solver": "PCG", "max_iters": 100,
+    "tolerance": 1e-6, "monitor_residual": 1,
+    "convergence": "RELATIVE_INI",
+    "preconditioner": {"scope": "amg", "solver": "AMG",
+       "algorithm": "AGGREGATION", "selector": "SIZE_8",
+       "smoother": {"scope": "jac", "solver": "BLOCK_JACOBI",
+           "relaxation_factor": 0.8, "monitor_residual": 0},
+       "presweeps": 1, "postsweeps": 1, "max_levels": 20,
+       "min_coarse_rows": 512, "coarse_solver": "DENSE_LU_SOLVER",
+       "cycle": "V", "max_iters": 1, "monitor_residual": 0}}}
+"""
+
+
+def _poisson_suite():
+    import numpy as np
+
+    from amgx_tpu.io.poisson import (
+        poisson_2d_5pt,
+        poisson_3d_7pt,
+        poisson_3d_27pt,
+    )
+
+    return [
+        ("classical-poisson2d-256", CLASSICAL,
+         lambda: poisson_2d_5pt(256)),
+        ("classical-poisson3d-20-27pt", CLASSICAL,
+         lambda: poisson_3d_27pt(20)),
+        ("aggregation-poisson3d-24", AGGREGATION,
+         lambda: poisson_3d_7pt(24, dtype=np.float32)),
+    ]
+
+
+def _setup_once(cfg_s, A):
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.solvers import create_solver
+
+    s = create_solver(AMGConfig.from_string(cfg_s), "default")
+    t0 = time.perf_counter()
+    s.setup(A)
+    return time.perf_counter() - t0, s
+
+
+def _assert_parity(name, s_ref, s_fast):
+    from amgx_tpu.amg.hierarchy import levels_bitwise_equal
+
+    mismatch = levels_bitwise_equal(s_ref.precond, s_fast.precond)
+    if mismatch is not None:
+        raise RuntimeError(f"{name}: {mismatch}")
+
+
+def _time_case(name, cfg_s, A, reps):
+    import numpy as np
+
+    from amgx_tpu.io.poisson import poisson_rhs
+
+    b = poisson_rhs(A.n_rows, dtype=np.asarray(A.values).dtype)
+    timings = {}
+    solvers = {}
+    iters = {}
+    for mode, env in (("reference", "0"), ("fast", "1")):
+        os.environ["AMGX_TPU_SETUP_FASTPATH"] = env
+        _setup_once(cfg_s, A)  # warm-up: jit compiles out of the timing
+        best = float("inf")
+        for _ in range(reps):
+            dt, s = _setup_once(cfg_s, A)
+            best = min(best, dt)
+        timings[mode] = best
+        solvers[mode] = s
+        iters[mode] = int(s.solve(b).iters)
+    os.environ.pop("AMGX_TPU_SETUP_FASTPATH", None)
+
+    # correctness gates BEFORE the speedup means anything
+    _assert_parity(name, solvers["reference"], solvers["fast"])
+    if iters["reference"] != iters["fast"]:
+        raise RuntimeError(
+            f"{name}: iteration count {iters['reference']} -> "
+            f"{iters['fast']} between paths"
+        )
+    # transfer discipline: the fast path ships the hierarchy in at
+    # most ONE batched transfer — the timed setups already recorded
+    # the count through the profiling hooks
+    batches = int(
+        solvers["fast"].collect_setup_profile().get(
+            "transfer_batches", 0
+        )
+    )
+    if batches > 1:
+        raise RuntimeError(
+            f"{name}: fast-path cold setup performed {batches} "
+            "host->device transfer batches (expected <= 1)"
+        )
+    rec = {
+        "n": A.n_rows,
+        "nnz": A.nnz,
+        "reference_s": round(timings["reference"], 4),
+        "fast_s": round(timings["fast"], 4),
+        "speedup": round(timings["reference"] / timings["fast"], 2),
+        "transfer_batches": batches,
+        "iters": iters["fast"],
+    }
+    # unrounded ratio for the geomean gate (displayed values are
+    # rounded; the pass/fail decision must come from raw timings)
+    return rec, timings["reference"] / timings["fast"]
+
+
+def run(reps: int = 3):
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    prev = os.environ.get("AMGX_TPU_SETUP_FASTPATH")
+    try:
+        cases = {}
+        speedups = []
+        for name, cfg_s, make in _poisson_suite():
+            cases[name], raw = _time_case(name, cfg_s, make(), reps)
+            speedups.append(raw)
+    finally:
+        if prev is None:
+            os.environ.pop("AMGX_TPU_SETUP_FASTPATH", None)
+        else:
+            os.environ["AMGX_TPU_SETUP_FASTPATH"] = prev
+    geo = 1.0
+    for s in speedups:
+        geo *= s
+    geo = geo ** (1.0 / len(speedups))
+    return {
+        "metric": "setup_fastpath_speedup",
+        "value": round(geo, 2),
+        "unit": "x (reference setup / fast setup)",
+        "cases": cases,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--floor", type=float, default=1.5)
+    args = ap.parse_args()
+
+    rec = run(reps=args.reps)
+    rec["floor"] = args.floor
+    failures = []
+    if rec["value"] < args.floor:
+        failures.append(
+            f"setup_fastpath_speedup {rec['value']} < floor "
+            f"{args.floor}"
+        )
+    rec["pass"] = not failures
+    line = json.dumps(rec)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if failures:
+        print("setup_bench FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,14 @@ JAX_PLATFORMS=cpu python ci/serve_bench.py
 # cache hit (store regression).
 JAX_PLATFORMS=cpu python ci/store_bench.py
 
+# ---- cold-setup fast path: old-vs-new floor --------------------------
+# One JSON line; non-zero exit when the host-resident, transfer-batched
+# setup pipeline drops below 1.5x (geomean) over the reference path on
+# the Poisson suite, when the two paths' hierarchies are not
+# bitwise-identical, or when fast-path cold setup performs more than
+# one host->device transfer batch per hierarchy.
+JAX_PLATFORMS=cpu python ci/setup_bench.py
+
 # ---- native C ABI (VERDICT r4 #9) -----------------------------------
 # Build from source and run both demos on CPU; assert exit 0 and the
 # expected iteration count from the reference README sample (1 iter).
