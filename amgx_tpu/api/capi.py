@@ -206,6 +206,9 @@ class _SolverHandle:
         self.result = None
         # batched solve state (solver_solve_batch)
         self.batch_service = None
+        # optional fleet gateway in front of it (admission control /
+        # load shedding), built when AMGX_TPU_CAPI_ADMISSION is set
+        self.batch_gateway = None
         self.batch_results = None
         # in-flight tickets of a non-blocking solver_solve_batch call:
         # (ticket-or-None, n, sol_handle) triples, drained on the first
@@ -319,6 +322,10 @@ def get_error_string(rc):
         RC_OK: "success",
         RC_BAD_PARAMETERS: "bad parameters",
         RC_UNKNOWN: "unknown error",
+        # RC_NO_MEMORY doubles as the overload/shed code: the fleet
+        # gateway's typed AdmissionRejected/Overloaded carry it, so a
+        # host app polling error strings sees the recoverable wording
+        RC_NO_MEMORY: "out of memory / overloaded (admission shed)",
         RC_IO_ERROR: "I/O error",
         RC_BAD_MODE: "bad mode",
         RC_BAD_CONFIGURATION: "bad configuration",
@@ -1120,9 +1127,36 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
         s.batch_results = []
         return RC_OK
     if s.batch_service is None:
+        import os
+
         from amgx_tpu.serve import BatchedSolveService
 
+        # AMGX_TPU_CAPI_ADMISSION=<budget>: front the embedded batch
+        # service with the fleet gateway — submits beyond the
+        # concurrency budget shed TYPED (per-system FAILED status +
+        # RC_NO_MEMORY wording) instead of queueing unboundedly in a
+        # long-running host process.  Parse BEFORE any handle state is
+        # assigned: a malformed value must fail every call loudly
+        # (RC_BAD_CONFIGURATION), not error once and then silently
+        # run the rest of the process without admission control.
+        budget_env = os.environ.get("AMGX_TPU_CAPI_ADMISSION", "")
+        budget = None
+        if budget_env:
+            try:
+                budget = int(budget_env)
+            except ValueError:
+                raise AMGXError(
+                    RC_BAD_CONFIGURATION,
+                    "AMGX_TPU_CAPI_ADMISSION must be an integer "
+                    f"concurrency budget, got {budget_env!r}",
+                ) from None
         s.batch_service = BatchedSolveService(config=s.cfg.cfg)
+        if budget:
+            from amgx_tpu.serve import SolveGateway
+
+            s.batch_gateway = SolveGateway(
+                s.batch_service, max_inflight=budget
+            )
     systems = []
     for mh, rh, sh in zip(mtx_handles, rhs_handles, sol_handles):
         m = _get(mh, _Matrix)
@@ -1150,12 +1184,15 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
     # unexpected propagates to _rc_guard so host apps still see a
     # diagnostic RC instead of a silent RC_OK
     pending = []
+    front = s.batch_gateway or s.batch_service
     for sys_, sh in zip(systems, sol_handles):
         n = sys_[0].n_rows * sys_[0].block_size
         try:
-            t = s.batch_service.submit(*sys_)
+            t = front.submit(*sys_)
         except AMGXTPUError:
-            t = None  # typed reject: fails only itself
+            # typed reject (validation, or an admission shed when the
+            # gateway fronts the service): fails only itself
+            t = None
         else:
             _get(sh, _Vector)._batch_owner = s
         pending.append((t, n, sh))

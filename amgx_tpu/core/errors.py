@@ -103,6 +103,47 @@ class ResourceError(AMGXTPUError):
     rc = RC_NO_MEMORY
 
 
+class DeadlineExceededError(ResourceError):
+    """A request's ``deadline_s`` passed before it could be served —
+    at submit (already expired on arrival), at flush (expired while
+    queued), or at fetch (the result would arrive too late to matter).
+    Subclass of :class:`ResourceError` so pre-existing deadline
+    handling keeps working."""
+
+
+class AdmissionRejected(ResourceError):
+    """The fleet front-end (:mod:`amgx_tpu.serve.gateway`) refused a
+    request at the door — quota exhausted, deadline provably
+    unmeetable, or the pattern's circuit breaker is open.  Carries the
+    machine-actionable retry hint ``retry_after_s`` (seconds the
+    client should back off before resubmitting; None when unknown)
+    and a short ``reason`` slug (``quota`` / ``deadline_unmeetable``
+    / ``breaker_open`` / ``draining`` / ``overloaded``).
+
+    A shed is a *recoverable, expected* condition: the C API maps it
+    to a per-system FAILED status (RC_NO_MEMORY at the RC boundary),
+    never a crash."""
+
+    def __init__(self, msg: str = "", rc: int | None = None,
+                 retry_after_s: float | None = None,
+                 reason: str = "rejected"):
+        super().__init__(msg, rc)
+        self.retry_after_s = retry_after_s
+        self.reason = reason
+
+
+class Overloaded(AdmissionRejected):
+    """The service as a whole is past its concurrency budget (or is
+    draining): no request of this lane can be admitted right now,
+    regardless of tenant."""
+
+    def __init__(self, msg: str = "", rc: int | None = None,
+                 retry_after_s: float | None = None,
+                 reason: str = "overloaded"):
+        super().__init__(msg, rc, retry_after_s=retry_after_s,
+                         reason=reason)
+
+
 class StoreError(AMGXTPUError):
     """Setup-artifact persistence failure (:mod:`amgx_tpu.store`):
     unreadable/corrupt payload, schema mismatch, or a setup that

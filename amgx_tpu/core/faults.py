@@ -19,6 +19,15 @@ testable without hunting for a naturally-broken matrix:
                         ResourceError (serve/service._compiled_fn)
   capi_internal         an internal RuntimeError inside the C API solve
                         path (api/capi._solve_impl — catch-all test)
+  gateway_shed          the fleet gateway sheds the next submit with a
+                        typed Overloaded regardless of actual load
+                        (serve/gateway.SolveGateway.submit)
+  admission_quota       the admission controller reports the tenant's
+                        token bucket as exhausted for one decision
+                        (serve/admission.AdmissionController.admit)
+  drain_timeout         gateway drain()'s settle-wait budget collapses
+                        to zero, so unsettled tickets fail typed
+                        (serve/gateway.SolveGateway.drain)
   ====================  ===================================================
 
 Injection is **budgeted and consumed at trace/setup time**: arming a
@@ -50,6 +59,9 @@ SITES = (
     "coarse_lu_zero_pivot",
     "serve_compile",
     "capi_internal",
+    "gateway_shed",
+    "admission_quota",
+    "drain_timeout",
 )
 
 _lock = threading.Lock()

@@ -169,12 +169,16 @@ def named_scope(name: str):
     return jax.named_scope(name)
 
 
-def percentile(samples, q: float) -> float:
+def percentile(samples, q: float) -> float | None:
     """Linear-interpolated percentile of a sequence (q in [0, 100]).
-    Small-sample friendly: with one sample every percentile IS it."""
+    Small-sample friendly: with one sample every percentile IS it.
+    An EMPTY sequence returns None — never NaN or IndexError — so
+    consumers that predict from percentiles (the gateway's shed
+    predictor) can distinguish "no data yet" from "zero latency" and
+    must treat None as *admit*, not as a zero-latency promise."""
     xs = sorted(samples)
     if not xs:
-        return 0.0
+        return None
     if len(xs) == 1:
         return float(xs[0])
     pos = (len(xs) - 1) * (q / 100.0)
@@ -214,7 +218,9 @@ class LatencyReservoir:
         self._next = 0
         self.count = 0
 
-    def percentile(self, q: float) -> float:
+    def percentile(self, q: float) -> float | None:
+        """Percentile of the ring, or None when no sample has ever
+        landed (empty-reservoir contract: "no data" is not "0 s")."""
         return percentile(self._samples, q)
 
     def summary(self) -> dict:
@@ -222,8 +228,11 @@ class LatencyReservoir:
         return {
             "count": self.count,
             "mean_s": sum(xs) / len(xs) if xs else 0.0,
-            "p50_s": percentile(xs, 50.0),
-            "p99_s": percentile(xs, 99.0),
+            # summary keys stay float-valued (0.0 when empty) — the
+            # snapshot/table exporters format them; the None contract
+            # lives on percentile() where predictors read it
+            "p50_s": percentile(xs, 50.0) or 0.0,
+            "p99_s": percentile(xs, 99.0) or 0.0,
             "max_s": max(xs) if xs else 0.0,
         }
 

@@ -12,11 +12,25 @@ Many independent sparse solves -> a few vmapped device calls:
     (fingerprint, config) across all later coefficient sets;
   * :mod:`amgx_tpu.serve.metrics` exports the serving counters.
 
-Entry point::
+The fleet front-end (:mod:`amgx_tpu.serve.gateway`) is the
+multi-tenant door in front of the service: per-tenant token-bucket
+quotas, a global concurrency budget with priority lanes
+(interactive / batch), deadline-aware load shedding, and a graceful
+``drain()`` that exports hot hierarchies to the artifact store —
+every overload answer is a typed ``AdmissionRejected``/``Overloaded``
+carrying ``retry_after_s``.
+
+Entry points::
 
     from amgx_tpu.serve import BatchedSolveService
     svc = BatchedSolveService()           # Jacobi-PCG default config
     results = svc.solve_many([(A0, b0), (A1, b1), ...])
+
+    from amgx_tpu.serve import SolveGateway
+    gw = SolveGateway(max_inflight=128).start()
+    t = gw.submit(A, b, tenant="web", lane="interactive",
+                  deadline_s=0.5)
+    x = t.result().x
 """
 
 from amgx_tpu.serve.bucketing import pad_pattern, bucket_batch
@@ -28,6 +42,12 @@ from amgx_tpu.serve.service import (
     BatchedSolveService,
     SolveTicket,
 )
+from amgx_tpu.serve.admission import (
+    AdmissionController,
+    TenantQuota,
+    TokenBucket,
+)
+from amgx_tpu.serve.gateway import GatewayTicket, SolveGateway
 
 # serving-stack alias: the docs/issues call the frontend "the solve
 # service"; the class name keeps its descriptive form
@@ -38,6 +58,11 @@ __all__ = [
     "SolveService",
     "DEFAULT_CONFIG",
     "SolveTicket",
+    "SolveGateway",
+    "GatewayTicket",
+    "AdmissionController",
+    "TenantQuota",
+    "TokenBucket",
     "HierarchyCache",
     "ServeMetrics",
     "make_batched_solve",

@@ -1,0 +1,247 @@
+"""Multi-tenant admission control for the fleet front-end.
+
+The primitives the gateway (:mod:`amgx_tpu.serve.gateway`) makes its
+admit/shed decision from, kept separate so they are unit-testable with
+an injected clock and reusable by other frontends:
+
+* :class:`TokenBucket` — the per-tenant rate quota.  Continuous
+  refill at ``rate`` tokens/s up to ``burst``; ``try_take`` either
+  admits (returns 0.0) or returns the seconds until the requested
+  tokens would be available — which IS the ``retry_after_s`` hint the
+  typed rejection carries.
+* :class:`AdmissionController` — the composed decision: tenant quota,
+  then the global concurrency budget (priority-aware: the batch lane
+  sheds at ``(1 - interactive_reserve_frac)`` of the budget so a
+  burst of batch work can never starve interactive admission), then
+  the deadline-shed predictor.
+
+Everything here is *load-independent state*: the controller never
+looks at the service directly.  The gateway feeds it the one live
+signal it needs — the serve pipeline's end-to-end p99 from the
+PR 3 latency reservoirs — as ``predicted_s``.  A missing percentile
+(``None``: empty reservoir, cold service) always ADMITS: shedding on
+absent data would deadlock a cold worker, and the first tickets are
+exactly what fills the reservoir.
+
+Admission failures are the typed, recoverable vocabulary of
+:mod:`amgx_tpu.core.errors`: :class:`~amgx_tpu.core.errors.Overloaded`
+for budget/drain sheds, its base
+:class:`~amgx_tpu.core.errors.AdmissionRejected` for quota / deadline
+/ breaker sheds — both carrying ``retry_after_s``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+from amgx_tpu.core.errors import AdmissionRejected, Overloaded
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Token-bucket parameters for one tenant: sustained ``rate``
+    requests/s with bursts up to ``burst``."""
+
+    rate: float = 1000.0
+    burst: float = 100.0
+
+
+class TokenBucket:
+    """Continuous-refill token bucket (thread safety is the
+    controller's job — it holds its lock around ``try_take``).
+
+    The clock is injectable so quota arithmetic is unit-testable
+    without sleeping; production uses ``time.monotonic``.
+    """
+
+    __slots__ = ("rate", "burst", "tokens", "_t_last", "_clock")
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._clock = clock
+        self._t_last = clock()
+
+    def try_take(self, n: float = 1.0) -> float:
+        """Take ``n`` tokens if available: returns 0.0 (admitted) or
+        the seconds until ``n`` tokens will have refilled — the
+        retry-after hint.  A zero-rate bucket that is out of burst
+        returns ``inf`` (the caller caps the hint)."""
+        now = self._clock()
+        if self.rate > 0:
+            self.tokens = min(
+                self.burst,
+                self.tokens + (now - self._t_last) * self.rate,
+            )
+        self._t_last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return 0.0
+        if self.rate <= 0:
+            return float("inf")
+        return (n - self.tokens) / self.rate
+
+
+def can_meet_deadline(deadline_s, predicted_s,
+                      headroom: float = 1.0) -> bool:
+    """The shed predictor: can a request with ``deadline_s`` seconds
+    of slack plausibly complete, given the pipeline's current
+    end-to-end tail estimate ``predicted_s`` (p99 of the serve
+    latency reservoirs)?
+
+    MISSING data admits: ``predicted_s is None`` (empty reservoir —
+    cold service) or no deadline at all is always True.  Only a
+    deadline strictly tighter than ``headroom * predicted_s`` is
+    provably unmeetable and sheds."""
+    if deadline_s is None or predicted_s is None:
+        return True
+    return float(deadline_s) >= headroom * float(predicted_s)
+
+
+class AdmissionController:
+    """Composed admission decision + in-flight accounting.
+
+    ``admit()`` either reserves one unit of the concurrency budget
+    (caller MUST pair it with ``release()`` when the request settles)
+    or raises the typed rejection.  Decision order — cheapest and
+    most client-actionable first:
+
+    1. injected ``admission_quota`` fault / tenant token bucket
+       (:class:`AdmissionRejected`, ``reason="quota"``);
+    2. global concurrency budget; the batch lane sheds at
+       ``(1 - interactive_reserve_frac) * max_inflight`` so
+       interactive admission always has headroom
+       (:class:`Overloaded`, ``reason="overloaded"``);
+    3. deadline-shed predictor (:class:`AdmissionRejected`,
+       ``reason="deadline_unmeetable"``) — *after* the budget check so
+       an overloaded service answers with the backoff hint, not a
+       misleading deadline verdict.
+    """
+
+    def __init__(
+        self,
+        max_inflight: int = 256,
+        interactive_reserve_frac: float = 0.25,
+        default_quota: Optional[TenantQuota] = None,
+        quotas: Optional[dict] = None,
+        deadline_headroom: float = 1.0,
+        retry_after_cap_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_inflight = int(max_inflight)
+        self.interactive_reserve_frac = float(interactive_reserve_frac)
+        self.default_quota = default_quota  # None = unlimited
+        self.quota_spec = dict(quotas or {})
+        self.deadline_headroom = float(deadline_headroom)
+        self.retry_after_cap_s = float(retry_after_cap_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets: dict = {}
+        self.inflight = 0
+
+    # -- quota ---------------------------------------------------------
+
+    def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        """Tenant's token bucket, created lazily from its quota spec
+        (caller holds the lock).  No spec and no default = unlimited."""
+        b = self._buckets.get(tenant)
+        if b is not None:
+            return b
+        spec = self.quota_spec.get(tenant, self.default_quota)
+        if spec is None:
+            return None
+        b = TokenBucket(spec.rate, spec.burst, clock=self._clock)
+        self._buckets[tenant] = b
+        return b
+
+    def _cap(self, retry_after: float) -> float:
+        return min(retry_after, self.retry_after_cap_s)
+
+    @property
+    def batch_budget(self) -> int:
+        """In-flight ceiling for the batch lane: the interactive
+        reserve stays admittable even when batch has filled its
+        share."""
+        return max(
+            int(self.max_inflight
+                * (1.0 - self.interactive_reserve_frac)),
+            1,
+        )
+
+    # -- the decision --------------------------------------------------
+
+    def admit(self, tenant: str = "default",
+              lane: str = "interactive",
+              deadline_s: Optional[float] = None,
+              predicted_s: Optional[float] = None) -> None:
+        """Admit (reserving one in-flight unit) or raise typed."""
+        from amgx_tpu.core import faults
+
+        with self._lock:
+            bucket = self._bucket_for(tenant)
+            if faults.should_fire("admission_quota"):
+                raise AdmissionRejected(
+                    f"tenant {tenant!r} quota exhausted (injected "
+                    "fault site admission_quota)",
+                    retry_after_s=self._cap(1.0),
+                    reason="quota",
+                )
+            token_taken = False
+            if bucket is not None:
+                wait = bucket.try_take(1.0)
+                if wait > 0.0:
+                    raise AdmissionRejected(
+                        f"tenant {tenant!r} over its request quota "
+                        f"({bucket.rate:g}/s, burst {bucket.burst:g})",
+                        retry_after_s=self._cap(wait),
+                        reason="quota",
+                    )
+                token_taken = True
+
+            def refund():
+                # a request shed by a LATER gate was never served:
+                # charging its quota token anyway would quota-starve
+                # the tenant exactly when it retries after the
+                # overload clears (double punishment)
+                if token_taken:
+                    bucket.tokens = min(
+                        bucket.burst, bucket.tokens + 1.0
+                    )
+
+            limit = (
+                self.max_inflight
+                if lane == "interactive"
+                else self.batch_budget
+            )
+            if self.inflight >= limit:
+                refund()
+                # backoff hint: one pipeline tail-latency's worth of
+                # draining, when known; a small fixed nudge otherwise
+                hint = predicted_s if predicted_s else 0.05
+                raise Overloaded(
+                    f"concurrency budget exhausted ({self.inflight} "
+                    f"in flight, {lane} lane limit {limit})",
+                    retry_after_s=self._cap(float(hint)),
+                    reason="overloaded",
+                )
+            if not can_meet_deadline(
+                deadline_s, predicted_s, self.deadline_headroom
+            ):
+                refund()
+                raise AdmissionRejected(
+                    f"deadline_s={float(deadline_s):g} cannot be met "
+                    f"(current p99 {float(predicted_s):g}s)",
+                    retry_after_s=self._cap(float(predicted_s)),
+                    reason="deadline_unmeetable",
+                )
+            self.inflight += 1
+
+    def release(self, n: int = 1) -> None:
+        """Return ``n`` in-flight units (the paired ticket settled)."""
+        with self._lock:
+            self.inflight = max(self.inflight - n, 0)

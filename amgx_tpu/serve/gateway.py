@@ -1,0 +1,444 @@
+"""Fleet front-end: the admission-controlled door in front of a
+:class:`~amgx_tpu.serve.service.BatchedSolveService`.
+
+Everything below the waterline already exists — typed failures,
+circuit breakers, quarantine, deadlines, warm-boot store, latency
+reservoirs — but a bare service accepts every submit, so the first
+overloaded client turns into an unbounded queue and an OOM.  The
+gateway makes overload a *first-class, typed, recoverable* condition:
+
+  submit(tenant, lane, deadline_s)
+      │ 1. drain gate          — draining/drained? typed Overloaded
+      │ 2. breaker shed        — pattern's circuit breaker open?
+      │                          shed BEFORE it queues (the PR 2
+      │                          quarantine machinery, moved to the
+      │                          door)
+      │ 3. admission control   — tenant token bucket, then the global
+      │                          concurrency budget (batch lane sheds
+      │                          first: interactive keeps a reserved
+      │                          fraction), then the deadline-shed
+      │                          predictor fed by the PR 3 p99
+      │                          reservoirs (missing p99 = admit)
+      ▼
+  BatchedSolveService.submit(lane=...)   — bounded queues, priority
+      │                          lanes at flush-group formation
+      │                          (interactive preempts batch; batch
+      │                          is starvation-protected by an aging
+      │                          credit), deadline enforcement at
+      │                          submit / flush / fetch
+      ▼
+  GatewayTicket.result()  — settles the in-flight reservation
+
+Every shed raises :class:`~amgx_tpu.core.errors.AdmissionRejected` /
+:class:`~amgx_tpu.core.errors.Overloaded` carrying an AMGX_RC code and
+a machine-actionable ``retry_after_s`` — never an unbounded queue,
+never a crash.  ``drain()`` is the graceful-handoff protocol: stop
+admission, flush and settle every admitted ticket (complete or typed
+failure — an admitted ticket is never lost), then export the
+hierarchy cache to the shared
+:class:`~amgx_tpu.store.store.ArtifactStore` so the replacement
+worker warm-boots the fleet's hot fingerprints (PR 4) instead of
+cold-compiling.
+
+The asyncio face is deliberately thin: ``await gateway.solve(...)``
+runs the admission decision inline (microseconds, typed rejections
+propagate synchronously) and parks the blocking per-group fetch on
+the default executor, so an event-loop server can host thousands of
+in-flight requests over one service.
+
+``ci/load_bench.py`` drives this layer to 2x its sustainable
+throughput and asserts the overload contract: zero unhandled
+exceptions, 100%-typed sheds, bounded interactive p99 while the batch
+lane degrades, and a lossless mid-load drain.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from amgx_tpu.core.errors import (
+    AdmissionRejected,
+    DeadlineExceededError,
+    Overloaded,
+)
+from amgx_tpu.serve.admission import AdmissionController, TenantQuota
+from amgx_tpu.serve.service import BatchedSolveService, _host_csr
+
+LANES = ("interactive", "batch")
+
+
+class GatewayTicket:
+    """Admitted-request handle: wraps the service's SolveTicket and
+    settles the gateway's in-flight reservation exactly once, on the
+    first ``result()`` that completes (either way).  ``drain()`` may
+    force-settle an unsettled ticket with a typed error; the typed
+    error then wins over a still-in-flight device result."""
+
+    __slots__ = ("_gw", "_ticket", "tenant", "lane", "_settled",
+                 "_forced_error", "_lock")
+
+    def __init__(self, gw: "SolveGateway", ticket, tenant: str,
+                 lane: str):
+        self._gw = gw
+        self._ticket = ticket
+        self.tenant = tenant
+        self.lane = lane
+        self._settled = False
+        self._forced_error = None
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._forced_error is not None or self._ticket.done()
+
+    def result(self):
+        with self._lock:
+            if self._forced_error is not None:
+                raise self._forced_error
+        try:
+            res = self._ticket.result()
+        except BaseException as e:
+            self._settle(error=e)
+            raise
+        with self._lock:
+            # a drain timeout that force-settled this ticket while we
+            # were blocked in the fetch wins: the caller sees the same
+            # typed failure the drain report counted, not a success
+            # the accounting already wrote off
+            if self._forced_error is not None:
+                raise self._forced_error
+        self._settle(error=None)
+        return res
+
+    def _fail(self, err: BaseException):
+        """Force-settle with a typed error (drain timeout): admitted
+        tickets are never lost — they complete or fail TYPED."""
+        with self._lock:
+            if self._forced_error is None:
+                self._forced_error = err
+        self._settle(error=err)
+
+    def _settle(self, error):
+        with self._lock:
+            if self._settled:
+                return
+            self._settled = True
+        self._gw._on_settle(self, error)
+
+
+class SolveGateway:
+    """Multi-tenant, deadline-aware, load-shedding front door.
+
+    Parameters
+    ----------
+    service: an existing BatchedSolveService to front, or None to
+        build one from ``config`` / ``store`` / ``service_kwargs``.
+        The gateway shares the service's ServeMetrics, so gateway
+        counters and serve counters land in one snapshot.
+    max_inflight: global concurrency budget — admitted-but-unsettled
+        tickets.  This, not the submit rate, is what bounds memory:
+        staged rows and device results live until the ticket settles.
+    interactive_reserve_frac: fraction of the budget only the
+        interactive lane may use; the batch lane sheds at
+        ``(1 - frac) * max_inflight`` so overload degrades batch
+        first (the load-bench contract).
+    quotas / default_quota: per-tenant token buckets
+        (:class:`~amgx_tpu.serve.admission.TenantQuota`);
+        ``default_quota=None`` means unlisted tenants are unlimited.
+    deadline_headroom: shed a deadline tighter than
+        ``headroom * p99``; the p99 comes from the service's ticket
+        latency reservoir and a missing percentile always admits.
+    shed_broken: shed patterns whose circuit breaker is open at the
+        DOOR (typed, with a retry hint at the breaker's probe
+        cadence) instead of letting them occupy queue and quarantine
+        capacity.  The service's own half-open probing still runs for
+        traffic admitted while the breaker closes.
+    """
+
+    def __init__(
+        self,
+        service: Optional[BatchedSolveService] = None,
+        *,
+        config=None,
+        store=None,
+        max_inflight: int = 256,
+        interactive_reserve_frac: float = 0.25,
+        quotas: Optional[dict] = None,
+        default_quota: Optional[TenantQuota] = None,
+        deadline_headroom: float = 1.0,
+        retry_after_cap_s: float = 60.0,
+        shed_broken: bool = True,
+        **service_kwargs,
+    ):
+        if service is None:
+            service = BatchedSolveService(
+                config=config, store=store, **service_kwargs
+            )
+        elif config is not None or store is not None or service_kwargs:
+            raise ValueError(
+                "pass EITHER an existing service OR construction "
+                "kwargs, not both"
+            )
+        self.service = service
+        self.metrics = service.metrics
+        self.admission = AdmissionController(
+            max_inflight=max_inflight,
+            interactive_reserve_frac=interactive_reserve_frac,
+            default_quota=default_quota,
+            quotas=quotas,
+            deadline_headroom=deadline_headroom,
+            retry_after_cap_s=retry_after_cap_s,
+        )
+        self.shed_broken = bool(shed_broken)
+        self._state = "serving"  # serving | draining | drained
+        self._state_lock = threading.Lock()
+        self._outstanding: set = set()
+        self._drain_report: Optional[dict] = None
+        # set once the drain's report is final: concurrent drain()
+        # callers (shutdown hook + health manager) wait for the ONE
+        # running drain instead of racing a second settle loop
+        self._drained = threading.Event()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def start(self, interval_s: float = 0.005):
+        self.service.start(interval_s)
+        return self
+
+    def stop(self):
+        self.service.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def flush(self):
+        self.service.flush()
+
+    # ------------------------------------------------------------------
+    # submission
+
+    def _shed(self, err: AdmissionRejected):
+        """Count one typed shed by reason and raise it."""
+        self.metrics.inc("gateway_sheds")
+        self.metrics.inc(f"shed_{err.reason}")
+        raise err
+
+    def predicted_p99_s(self) -> Optional[float]:
+        """The shed predictor's tail estimate: p99 of end-to-end
+        ticket latency, None while the reservoir is empty (which
+        ADMITS — a cold service must take traffic to learn)."""
+        return self.metrics.latency["total"].percentile(99.0)
+
+    def submit(self, A, b, x0=None, *, tenant: str = "default",
+               lane: str = "interactive",
+               deadline_s: Optional[float] = None) -> GatewayTicket:
+        """Admit-or-shed, then queue.  Raises typed
+        :class:`AdmissionRejected`/:class:`Overloaded` (with
+        ``retry_after_s``) on shed, typed
+        :class:`DeadlineExceededError` for a dead-on-arrival
+        deadline; returns a :class:`GatewayTicket` once admitted."""
+        from amgx_tpu.core import faults
+
+        if lane not in LANES:
+            raise ValueError(f"unknown lane {lane!r}; lanes: {LANES}")
+        if self._state != "serving":
+            self._shed(Overloaded(
+                f"gateway is {self._state}: not admitting",
+                # the hint is for the REPLACEMENT worker: one drain
+                # timeout's worth of backoff, capped like every hint
+                retry_after_s=min(1.0, self.admission.retry_after_cap_s),
+                reason="draining",
+            ))
+        if faults.should_fire("gateway_shed"):
+            self._shed(Overloaded(
+                "injected shed (fault site gateway_shed)",
+                retry_after_s=0.05,
+                reason="overloaded",
+            ))
+        svc = self.service
+        host = None
+        if self.shed_broken and svc._broken:
+            # tripped fingerprint sheds BEFORE it queues.  The CSR
+            # extraction runs once — the tuple is threaded through to
+            # svc.submit — and the fingerprint hash is memoized on
+            # the matrix object, so the gate stays cheap even while
+            # a breaker is open (exactly the incident window where
+            # the door must not get slower)
+            host = _host_csr(A)
+            ro, ci, vals, n, raw_fp = host
+            pat = svc._pattern_for(ro, ci, n, raw_fp)
+            if pat.fingerprint in svc._broken:
+                self._shed(AdmissionRejected(
+                    "pattern's circuit breaker is open "
+                    f"({pat.fingerprint[:12]}...): shedding at "
+                    "admission",
+                    retry_after_s=min(
+                        svc.max_wait_s * svc._BREAKER_PROBE_EVERY,
+                        self.admission.retry_after_cap_s,
+                    ),
+                    reason="breaker_open",
+                ))
+        try:
+            self.admission.admit(
+                tenant=tenant,
+                lane=lane,
+                deadline_s=deadline_s,
+                predicted_s=self.predicted_p99_s(),
+            )
+        except AdmissionRejected as e:
+            self._shed(e)  # count by reason, then re-raise
+        try:
+            t = svc.submit(A, b, x0, deadline_s=deadline_s, lane=lane,
+                           _host=host)
+        except BaseException:
+            # not admitted after all (validation reject, dead-on-
+            # arrival deadline, malformed input): hand the budget back
+            self.admission.release()
+            raise
+        gt = GatewayTicket(self, t, tenant, lane)
+        with self._state_lock:
+            self._outstanding.add(gt)
+            late = self._state != "serving"
+        if late:
+            # drain() started between the (unlocked) state gate and
+            # this registration: the drain's flush may have missed the
+            # group we just queued into a stopped service — flush it
+            # ourselves so the ticket can always settle.  If the
+            # drain's settle loop is still running it picks the ticket
+            # up from _outstanding; if it already returned, the caller
+            # holds the ticket and settles it — either way it is not
+            # lost, it is merely absent from the drain report.
+            self.service.flush()
+        self.metrics.inc("gateway_admitted")
+        return gt
+
+    async def solve(self, A, b, x0=None, *, tenant: str = "default",
+                    lane: str = "interactive",
+                    deadline_s: Optional[float] = None):
+        """Asyncio face: admission runs inline (typed sheds raise
+        synchronously into the coroutine); the blocking per-group
+        fetch parks on the default executor so the event loop stays
+        free."""
+        import asyncio
+
+        ticket = self.submit(
+            A, b, x0, tenant=tenant, lane=lane, deadline_s=deadline_s
+        )
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, ticket.result)
+
+    def _on_settle(self, ticket: GatewayTicket, error):
+        self.admission.release()
+        with self._state_lock:
+            self._outstanding.discard(ticket)
+        if error is None:
+            self.metrics.inc("gateway_completed")
+        else:
+            from amgx_tpu.core.errors import AMGXTPUError
+
+            self.metrics.inc(
+                "gateway_typed_failures"
+                if isinstance(error, AMGXTPUError)
+                else "gateway_untyped_failures"
+            )
+
+    # ------------------------------------------------------------------
+    # drain + health
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Graceful handoff: stop admission, flush and settle every
+        admitted ticket, export the hierarchy cache to the store.
+
+        The contract ``ci/load_bench.py`` asserts mid-load: no
+        admitted ticket is LOST — each one completes or raises a
+        typed failure (tickets still unsettled when ``timeout_s``
+        runs out fail with :class:`DeadlineExceededError`) — and the
+        fleet's hot fingerprints are on disk for the replacement
+        worker's ``warm_boot()`` before this returns.  Idempotent and
+        single-flight: concurrent callers wait for the one running
+        drain and receive its report.
+
+        Timeout granularity: the budget is checked between tickets,
+        so ``drain`` can overrun ``timeout_s`` by at most the one
+        ``result()`` currently settling — every queued group was
+        flushed first, so that wait is one dispatched group's device
+        fetch, not an unbounded queue."""
+        from amgx_tpu.core import faults
+
+        with self._state_lock:
+            already = self._state != "serving"
+            self._state = "draining" if not already else self._state
+        if already:
+            # single-flight: wait for the running (or finished) drain
+            self._drained.wait()
+            with self._state_lock:
+                return dict(self._drain_report)
+        self.metrics.set_gauge("gateway_draining", 1)
+        self.service.stop()  # stops the poller AND flushes
+        self.service.flush()  # no poller was running: flush explicitly
+        if faults.should_fire("drain_timeout"):
+            timeout_s = 0.0
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        settled = failed = timed_out = 0
+        while True:
+            with self._state_lock:
+                ticket = next(iter(self._outstanding), None)
+            if ticket is None:
+                break
+            if time.monotonic() > deadline:
+                ticket._fail(DeadlineExceededError(
+                    "gateway drain timed out before this ticket "
+                    "settled"
+                ))
+                timed_out += 1
+                continue
+            try:
+                ticket.result()
+                settled += 1
+            except BaseException:  # noqa: BLE001 — typed per-ticket
+                failed += 1
+        exported = self.service.export_all_entries()
+        report = {
+            "settled": settled,
+            "failed": failed,
+            "timed_out": timed_out,
+            "exported": exported,
+        }
+        with self._state_lock:
+            self._state = "drained"
+            self._drain_report = report
+        self.metrics.set_gauge("gateway_draining", 0)
+        self.metrics.inc("gateway_drains")
+        self._drained.set()
+        return dict(report)
+
+    def health(self) -> dict:
+        """Liveness/readiness view for an external prober: serving
+        state, budget occupancy, queue depth, breaker count, shed and
+        lane-latency summaries."""
+        m = self.metrics
+        snap = {
+            "state": self._state,
+            "inflight": self.admission.inflight,
+            "max_inflight": self.admission.max_inflight,
+            "queue_depth": m.get("queue_depth"),
+            "breakers_open": m.get("breakers_open"),
+            "admitted": m.get("gateway_admitted"),
+            "completed": m.get("gateway_completed"),
+            "sheds": m.get("gateway_sheds"),
+            "typed_failures": m.get("gateway_typed_failures"),
+            "untyped_failures": m.get("gateway_untyped_failures"),
+        }
+        for lane in LANES:
+            p99 = m.lane_percentile(lane, 99.0)
+            snap[f"{lane}_p99_s"] = p99
+        return snap

@@ -69,6 +69,11 @@ class ServeMetrics:
         self.times = defaultdict(float)
         # per-ticket pipeline-stage latency reservoirs
         self.latency = {s: LatencyReservoir() for s in TICKET_STAGES}
+        # per-priority-lane end-to-end latency (fleet front-end): the
+        # overload contract is lane-differentiated — interactive p99
+        # stays bounded while batch degrades — so the reservoirs are
+        # too
+        self.lane_latency = defaultdict(LatencyReservoir)
 
     # -- counters ------------------------------------------------------
 
@@ -89,12 +94,27 @@ class ServeMetrics:
                 if res is not None:
                     res.add(s)
 
+    def record_lane(self, lane: str, seconds: float):
+        """Record one ticket's end-to-end latency into its priority
+        lane's reservoir."""
+        with self._lock:
+            self.lane_latency[lane].add(seconds)
+
+    def lane_percentile(self, lane: str, q: float):
+        """Lane latency percentile, or None when the lane has no
+        samples yet (shed predictors MUST treat None as admit)."""
+        with self._lock:
+            res = self.lane_latency.get(lane)
+            return None if res is None else res.percentile(q)
+
     def reset_latency(self):
         """Drop latency samples and busy-time accumulators — excludes
         warm-up (setup/compile) tickets from a steady-state window
         (ci/serve_bench.py)."""
         with self._lock:
             for res in self.latency.values():
+                res.clear()
+            for res in self.lane_latency.values():
                 res.clear()
             self.times.clear()
 
@@ -132,6 +152,10 @@ class ServeMetrics:
             out["latency"] = {
                 name: res.summary() for name, res in self.latency.items()
             }
+            out["lanes"] = {
+                name: res.summary()
+                for name, res in self.lane_latency.items()
+            }
         tot = out["latency"]["total"]
         out["ticket_p50_s"] = tot["p50_s"]
         out["ticket_p99_s"] = tot["p99_s"]
@@ -148,13 +172,19 @@ class ServeMetrics:
         snap = self.snapshot()
         lines = ["    serve metrics:"]
         for k in sorted(snap):
-            if k in ("buckets", "latency"):
+            if k in ("buckets", "latency", "lanes"):
                 continue
             lines.append(f"      {k:<28s} {snap[k]}")
         for name, summ in snap["latency"].items():
             if summ["count"]:
                 lines.append(
                     f"      latency/{name:<20s} p50={summ['p50_s']:.6f}s"
+                    f" p99={summ['p99_s']:.6f}s n={summ['count']}"
+                )
+        for name, summ in snap["lanes"].items():
+            if summ["count"]:
+                lines.append(
+                    f"      lane/{name:<23s} p50={summ['p50_s']:.6f}s"
                     f" p99={summ['p99_s']:.6f}s n={summ['count']}"
                 )
         for bk, st in sorted(snap["buckets"].items()):

@@ -212,6 +212,8 @@ class SolveTicket:
     _batch: object = None  # _BatchResult after dispatch
     _t_submit: float = 0.0
     _pad_s: float = 0.0
+    _lane: str = "interactive"
+    _deadline: Optional[float] = None  # absolute monotonic, or None
 
     def done(self) -> bool:
         return self._done
@@ -222,6 +224,27 @@ class SolveTicket:
         if self._error is not None:
             raise self._error
         if self._result is None and self._batch is not None:
+            # deadline short-circuit at the fetch boundary: a late
+            # fetch whose group nobody has synced yet returns a typed
+            # deadline failure instead of blocking on the device (an
+            # already-fetched group's result is free — return it).
+            # The failure is STICKY (cached like every other terminal
+            # error) so retries raise consistently and the metric
+            # counts tickets, not calls.
+            if (
+                self._deadline is not None
+                and not self._batch.fetched()
+                and time.monotonic() > self._deadline
+            ):
+                from amgx_tpu.core.errors import DeadlineExceededError
+
+                self._service.metrics.inc("deadline_expired_fetch")
+                self._error = DeadlineExceededError(
+                    "serve deadline exceeded before the result was "
+                    "fetched"
+                )
+                self._batch = None  # final: release the group ref
+                raise self._error
             self._result = self._batch.result_for(self)
         return self._result
 
@@ -240,12 +263,15 @@ class _Request:
 
 @dataclasses.dataclass
 class _Group:
-    key: tuple  # (padded fingerprint, dtype str)
+    key: tuple  # (padded fingerprint, dtype str, lane)
     pattern: PaddedPattern
     dtype: np.dtype
     requests: list
     deadline: float
     slot: StagingSlot
+    lane: str = "interactive"
+    created: float = 0.0  # monotonic group-creation time (aging)
+    promoted: bool = False  # batch aging credit consumed (sticky)
 
 
 class _BatchResult:
@@ -280,6 +306,14 @@ class _BatchResult:
         self._lock = threading.Lock()
         self._host = None
         self._error = None
+
+    def fetched(self) -> bool:
+        """Has the group's one host sync already happened?  Used by
+        the deadline short-circuit: once fetched, handing a late
+        ticket its result is free — only an UNfetched group may
+        convert lateness into a typed deadline failure."""
+        with self._lock:
+            return self._host is not None
 
     def fetch(self):
         with self._lock:
@@ -330,6 +364,7 @@ class _BatchResult:
             m.inc("padded_elems", self.Bb * pat.nb)
             m.inc("real_elems", len(self.tickets) * pat.n)
             for t in self.tickets:
+                total = max(t_fetch - t._t_submit, 0.0)
                 m.record_ticket({
                     "queue": max(
                         self.t_flush - t._t_submit - t._pad_s, 0.0
@@ -338,8 +373,9 @@ class _BatchResult:
                     "dispatch": dispatch_s,
                     "device": device_s,
                     "fetch": fetch_s,
-                    "total": max(t_fetch - t._t_submit, 0.0),
+                    "total": total,
                 })
+                m.record_lane(t._lane, total)
             return self._host
 
     def result_for(self, ticket: SolveTicket) -> SolveResult:
@@ -475,14 +511,41 @@ class BatchedSolveService:
     # ------------------------------------------------------------------
     # submission
 
-    def submit(self, A, b, x0=None, deadline_s=None) -> SolveTicket:
+    def submit(self, A, b, x0=None, deadline_s=None,
+               lane: str = "interactive", _host=None) -> SolveTicket:
         """Queue one system; returns a ticket.  ``A`` is a SparseMatrix
-        or scipy sparse matrix (scalar block size).  ``deadline_s``
-        (optional, seconds from now): if the group executes after the
-        deadline, THIS ticket fails with ResourceError while the rest
-        of the group proceeds."""
+        or scipy sparse matrix (scalar block size).
+
+        ``deadline_s`` (optional, seconds from now) is enforced
+        END-TO-END: an already-expired deadline is rejected right here
+        with a typed :class:`DeadlineExceededError`; a deadline that
+        passes while queued fails THIS ticket at flush while the rest
+        of the group proceeds; and a deadline that passes before the
+        result is fetched short-circuits ``ticket.result()`` instead
+        of blocking on the device.
+
+        ``lane`` ("interactive" | "batch") is the priority lane:
+        groups never mix lanes, and at flush-group formation
+        interactive groups preempt batch groups (batch is
+        starvation-protected by an aging credit —
+        ``_BATCH_AGING_FACTOR`` × max_wait_s promotes a passed-over
+        batch group to interactive rank, counted by
+        ``batch_promotions``)."""
         t_submit = time.perf_counter()
-        ro, ci, vals, n, raw_fp = _host_csr(A)
+        if deadline_s is not None and float(deadline_s) <= 0.0:
+            from amgx_tpu.core.errors import DeadlineExceededError
+
+            self.metrics.inc("deadline_expired")
+            raise DeadlineExceededError(
+                f"deadline_s={float(deadline_s):g} already expired at "
+                "submit"
+            )
+        # _host: pre-extracted (ro, ci, vals, n, raw_fp) from a
+        # front-end that already ran _host_csr for its own admission
+        # gates (the gateway's breaker shed) — don't extract twice
+        ro, ci, vals, n, raw_fp = (
+            _host if _host is not None else _host_csr(A)
+        )
         if self.validate:
             # typed rejection at the door: one poisoned request must
             # never reach a batch group (guardrails acceptance)
@@ -501,10 +564,11 @@ class BatchedSolveService:
                 )
         pattern = self._pattern_for(ro, ci, n, raw_fp)
         dtype, dtype_s = _resolve_dtype(vals.dtype)
-        key = (pattern.fingerprint, dtype_s)
+        key = (pattern.fingerprint, dtype_s, lane)
         flush_now = []
         new_group = False
         with self._lock:
+            now_mono = time.monotonic()
             grp = self._groups.get(key)
             if grp is None:
                 grp = _Group(
@@ -512,8 +576,10 @@ class BatchedSolveService:
                     pattern=pattern,
                     dtype=dtype,
                     requests=[],
-                    deadline=time.monotonic() + self.max_wait_s,
+                    deadline=now_mono + self.max_wait_s,
                     slot=self._acquire_slot(key, pattern, dtype),
+                    lane=lane,
+                    created=now_mono,
                 )
                 self._groups[key] = grp
                 new_group = True
@@ -524,14 +590,13 @@ class BatchedSolveService:
                 _pattern=pattern,
             )
             ticket._t_submit = t_submit
+            ticket._lane = lane
+            if deadline_s is not None:
+                ticket._deadline = now_mono + float(deadline_s)
             req = _Request(
                 ticket=ticket,
                 row=ticket._row,
-                deadline=(
-                    None
-                    if deadline_s is None
-                    else time.monotonic() + float(deadline_s)
-                ),
+                deadline=ticket._deadline,
             )
             grp.requests.append(req)
             self._queued += 1
@@ -540,8 +605,11 @@ class BatchedSolveService:
             if len(grp.requests) >= self.max_batch:
                 flush_now.append(self._take_group(key))
             elif self._queued >= self.queue_limit:
+                # backpressure flush-all: interactive groups still go
+                # first (priority holds under pressure too)
                 flush_now.extend(
-                    self._take_group(k) for k in list(self._groups)
+                    self._take_group(k)
+                    for k in self._ordered_keys(now_mono)
                 )
         # pad: write the request into its staging row — OUTSIDE the
         # lock (the row is exclusively this thread's until the group
@@ -610,25 +678,82 @@ class BatchedSolveService:
     # ------------------------------------------------------------------
     # flushing
 
+    # a batch-lane group passed over this long (x max_wait_s) gains
+    # its aging credit and sorts with interactive rank — starvation
+    # protection for the low-priority lane
+    _BATCH_AGING_FACTOR = 8
+
+    def _lane_rank(self, grp: _Group, now: float) -> int:
+        """0 = flush first (interactive, or an aged batch group whose
+        starvation credit promotes it), 1 = batch."""
+        if grp.lane != "batch":
+            return 0
+        if grp.promoted:
+            return 0
+        if (
+            now - grp.created
+            >= self.max_wait_s * self._BATCH_AGING_FACTOR
+        ):
+            grp.promoted = True
+            self.metrics.inc("batch_promotions")
+            return 0
+        return 1
+
+    def _ordered_keys(self, now: float) -> list:
+        """Group keys in flush order (caller holds the lock):
+        interactive preempts batch at flush-group formation; within a
+        rank, oldest max-wait deadline first."""
+        return sorted(
+            self._groups,
+            key=lambda k: (
+                self._lane_rank(self._groups[k], now),
+                self._groups[k].deadline,
+            ),
+        )
+
     def flush(self):
         """Execute every queued group now (dispatch completes before
-        return; results are fetched lazily by the tickets)."""
+        return; results are fetched lazily by the tickets).
+        Interactive-lane groups dispatch before batch-lane groups."""
+        now = time.monotonic()
         with self._lock:
-            groups = [self._take_group(k) for k in list(self._groups)]
+            groups = [
+                self._take_group(k) for k in self._ordered_keys(now)
+            ]
         for grp in groups:
             self._execute_group(grp)
 
     def poll(self):
-        """Execute groups whose max-wait deadline has passed.  Poller
-        flushes don't wait for the dispatch stage — padding of the next
-        group proceeds while the worker ships this one."""
+        """Execute groups whose max-wait deadline has passed, in lane
+        order.  Interactive preemption is REAL here, not just
+        ordering: while any interactive group is due, due batch
+        groups are deferred to a later poll (``batch_deferrals``) so
+        the single-worker dispatch stage serves the interactive lane
+        first — bounded by the aging credit, which promotes a batch
+        group after ``_BATCH_AGING_FACTOR x max_wait_s`` so sustained
+        interactive pressure can never starve it.  Poller flushes
+        don't wait for the dispatch stage — padding of the next group
+        proceeds while the worker ships this one."""
         now = time.monotonic()
         with self._lock:
-            due = [
-                self._take_group(k)
-                for k, g in list(self._groups.items())
-                if g.deadline <= now
+            due_keys = [
+                k for k in self._ordered_keys(now)
+                if self._groups[k].deadline <= now
             ]
+            interactive_pressure = any(
+                self._groups[k].lane != "batch" for k in due_keys
+            )
+            due = []
+            for k in due_keys:
+                g = self._groups[k]
+                if (
+                    interactive_pressure
+                    and g.lane == "batch"
+                    and self._lane_rank(g, now) != 0
+                ):
+                    self.metrics.inc("batch_deferrals")
+                    continue
+                due.append(self._take_group(k))
         for grp in due:
             self._execute_group(grp, wait_dispatch=False)
 
@@ -892,6 +1017,20 @@ class BatchedSolveService:
         for f in futures:
             f.result()
 
+    def export_all_entries(self) -> int:
+        """Synchronously export EVERY cached hierarchy entry to the
+        store (the gateway's drain protocol: hot fingerprints must be
+        on disk before the replacement worker boots).  Settles the
+        background build-time exports FIRST so entries they already
+        persisted are skipped, not re-serialized.  Returns the number
+        on disk; without a store, 0."""
+        if self.store is None:
+            return 0
+        from amgx_tpu.store.warmboot import export_all
+
+        self.flush_store()  # settle scheduled background exports
+        return export_all(self)
+
     def warm_boot(self, wait: bool = True, compile: bool = True) -> int:
         """Repopulate the hierarchy cache from the store (see
         :func:`amgx_tpu.store.warmboot.warm_boot`): previously
@@ -918,7 +1057,7 @@ class BatchedSolveService:
         """Fail (only) the tickets whose deadline already passed; their
         staged rows ride along inert while the rest of the group
         executes normally."""
-        from amgx_tpu.core.errors import ResourceError
+        from amgx_tpu.core.errors import DeadlineExceededError
 
         now = time.monotonic()
         for r in grp.requests:
@@ -927,17 +1066,23 @@ class BatchedSolveService:
                 and now > r.deadline
                 and not r.ticket._done
             ):
-                r.ticket._error = ResourceError(
+                r.ticket._error = DeadlineExceededError(
                     "serve deadline exceeded before execution"
                 )
                 r.ticket._done = True
                 self.metrics.inc("deadline_expired")
 
     def _breaker_failure(self, fp: str):
-        """Count a group failure; trip the breaker at the threshold."""
-        if self.breaker_threshold <= 0 or fp in self._broken:
+        """Count a group failure; trip the breaker at the threshold.
+        The already-open check runs UNDER the lock: two concurrent
+        group failures crossing the threshold together must produce
+        exactly one trip (breaker metrics stay consistent under
+        multi-threaded submit — asserted by test_robustness.py)."""
+        if self.breaker_threshold <= 0:
             return
         with self._lock:
+            if fp in self._broken:
+                return
             n = self._fail_counts.get(fp, 0) + 1
             self._fail_counts[fp] = n
             if n >= self.breaker_threshold:

@@ -173,6 +173,22 @@ class ArtifactStore:
             return "corrupt"
         return side, blob
 
+    def has(self, key: str) -> bool:
+        """Verified presence probe: both files exist, the sidecar's
+        schema matches, AND the payload digest verifies — the blob
+        was already read, so hashing it is the marginal cost of not
+        telling a drain-time exporter to skip a good in-memory
+        hierarchy in favour of a torn/corrupt on-disk pair (which the
+        replacement worker's ``get`` would then delete and
+        cold-compile past).  Never deletes; a failed probe just
+        reads as absent so the caller re-exports over it."""
+        got = self._read_entry(key)
+        if isinstance(got, str):
+            return False
+        side, blob = got
+        digest = hashlib.blake2b(blob, digest_size=16).hexdigest()
+        return digest == side.get("payload_blake2b")
+
     def get(self, key: str):
         """(manifest, arrays) for a verified entry, or None — a miss.
         Corrupt entries (digest/JSON/npz failures) are deleted and

@@ -172,6 +172,43 @@ def export_entry(service, entry, dtype) -> bool:
     return store.put(key, arrays, manifest)
 
 
+def export_all(service) -> int:
+    """Synchronously persist every entry currently in the service's
+    hierarchy cache (the gateway drain protocol: the replacement
+    worker must find the fleet's hot fingerprints on disk).
+
+    Entries already on disk under their content key — the background
+    build-time export usually got there first — are SKIPPED
+    (``store_export_skips``), so a drain does not re-pay whole-cache
+    serialization inside its settle-timeout budget, and
+    ``store_exports`` keeps meaning "entries persisted", not "export
+    calls".  Best-effort per entry — one unserializable setup must
+    not keep the rest of the fleet's hierarchies off disk.  Returns
+    the number on disk when done (fresh + already present)."""
+    store = service.store
+    if store is None:
+        return 0
+    cache = service.cache
+    with cache._lock:
+        items = list(cache._entries.items())
+    exported = 0
+    for (fp, cfg_key, dtype_s), entry in items:
+        try:
+            key = entry_key(store, fp, cfg_key, dtype_s)
+            if store.has(key):
+                service.metrics.inc("store_export_skips")
+                exported += 1
+                continue
+            if export_entry(service, entry, dtype_s):
+                exported += 1
+                service.metrics.inc("store_exports")
+            else:
+                service.metrics.inc("store_export_failures")
+        except BaseException:  # noqa: BLE001 — drain stays best-effort
+            service.metrics.inc("store_export_failures")
+    return exported
+
+
 def restore_entry(service, manifest: dict, arrays):
     """Rebuild a HierarchyEntry from a store payload — the
     ``_build_entry`` tail without the setup: the restored template

@@ -223,6 +223,47 @@ def _serve_record():
         return {"error": str(e)}
 
 
+def _fleet_record():
+    """Fleet front-end under 2x overload: typed-shed fraction, lane
+    p99s, drain outcome (ci/load_bench.py, reduced durations).
+    Guarded — the fleet record must never take the headline bench
+    down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.load_bench import run as fleet_run
+
+        rec, problems = fleet_run(
+            duration_s=1.5, calib_s=0.75, drain_s=1.0
+        )
+        out = {
+            k: rec[k]
+            for k in (
+                "value",
+                "unit",
+                "sustainable_per_s",
+                "offered_per_s",
+                "shed_frac",
+                "interactive_shed_frac",
+                "batch_shed_frac",
+                "interactive_p99_s",
+                "batch_p99_s",
+                "unhandled",
+                "drain",
+                "ok",
+            )
+            if k in rec
+        }
+        if problems:
+            out["problems"] = problems
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: fleet record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _store_record():
     """Setup-artifact store: cold setup vs restore speedup plus the
     warm-boot serving scenario (ci/store_bench.py, one small case).
@@ -459,6 +500,10 @@ def main():
     serve_rec = _serve_record()
     print(f"bench: serve {serve_rec}", file=sys.stderr)
 
+    # ---- fleet front-end (overload/drain) --------------------------
+    fleet_rec = _fleet_record()
+    print(f"bench: fleet {fleet_rec}", file=sys.stderr)
+
     # ---- setup-artifact store --------------------------------------
     store_rec = _store_record()
     print(f"bench: store {store_rec}", file=sys.stderr)
@@ -485,6 +530,7 @@ def main():
                 "unstructured_bytes_per_s_lb": round(ell_bw / 1e9, 1),
                 "solve": solve_rec,
                 "serve": serve_rec,
+                "fleet": fleet_rec,
                 "store": store_rec,
                 "setup": setup_rec,
             }

@@ -216,6 +216,101 @@ def site_capi_internal():
     return ok, f"rc_clean={clean_rc} rc_after={rc_after}"
 
 
+def site_gateway_shed():
+    """Injected gateway shed is a typed Overloaded WITH a retry hint;
+    the very next (clean) submit is admitted and solves."""
+    from amgx_tpu.core.errors import Overloaded
+    from amgx_tpu.serve import SolveGateway
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    gw = SolveGateway(max_batch=2)
+    b = np.ones(n)
+    with faults.inject("gateway_shed", times=1):
+        try:
+            gw.submit(sp, b)
+            return False, "no shed raised"
+        except Overloaded as e:
+            typed = e.retry_after_s is not None and e.reason
+    t = gw.submit(sp, b)
+    gw.flush()
+    res = t.result()
+    ok = (
+        bool(typed)
+        and int(res.status) == SUCCESS
+        and gw.metrics.get("gateway_sheds") == 1
+    )
+    return ok, (
+        f"sheds={gw.metrics.get('gateway_sheds')} "
+        f"status={int(res.status)}"
+    )
+
+
+def site_admission_quota():
+    """Injected quota exhaustion rejects typed (AdmissionRejected,
+    reason 'quota', retry hint set); recovery is immediate."""
+    from amgx_tpu.core.errors import AdmissionRejected
+    from amgx_tpu.serve import SolveGateway
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    gw = SolveGateway(max_batch=2)
+    b = np.ones(n)
+    with faults.inject("admission_quota", times=1):
+        try:
+            gw.submit(sp, b, tenant="victim")
+            return False, "no quota reject raised"
+        except AdmissionRejected as e:
+            typed = e.reason == "quota" and e.retry_after_s is not None
+    t = gw.submit(sp, b, tenant="victim")
+    gw.flush()
+    res = t.result()
+    ok = bool(typed) and int(res.status) == SUCCESS
+    return ok, (
+        f"reason_quota={typed} status={int(res.status)} "
+        f"shed_quota={gw.metrics.get('shed_quota')}"
+    )
+
+
+def site_drain_timeout():
+    """Injected drain timeout: unsettled tickets fail TYPED (never
+    lost, never a hang), the hierarchy export still runs, and the
+    drained gateway sheds new submits typed."""
+    from amgx_tpu.core.errors import AMGXTPUError, Overloaded
+    from amgx_tpu.serve import SolveGateway
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(5)
+    gw = SolveGateway(max_batch=8)
+    tickets = [gw.submit(sp, rng.standard_normal(n)) for _ in range(3)]
+    # deliberately NOT flushed: the queued group is what the zero
+    # settle budget must fail typed
+    with faults.inject("drain_timeout", times=1):
+        report = gw.drain(timeout_s=60.0)
+    outcomes = []
+    for t in tickets:
+        try:
+            t.result()
+            outcomes.append("ok")
+        except AMGXTPUError:
+            outcomes.append("typed")
+        except BaseException:  # noqa: BLE001 — would fail the site
+            outcomes.append("UNTYPED")
+    try:
+        gw.submit(sp, np.ones(n))
+        post = "admitted"
+    except Overloaded:
+        post = "shed"
+    ok = (
+        "UNTYPED" not in outcomes
+        and report["timed_out"] + report["settled"]
+        + report["failed"] == 3
+        and post == "shed"
+    )
+    return ok, f"outcomes={outcomes} report={report} post={post}"
+
+
 def baseline_determinism():
     """All sites disarmed: two fresh solves are bit-identical."""
     faults.disarm()
@@ -233,6 +328,9 @@ MATRIX = [
     ("serve_compile", site_serve_compile),
     ("serve_poisoned_request", site_serve_poisoned_request),
     ("capi_internal", site_capi_internal),
+    ("gateway_shed", site_gateway_shed),
+    ("admission_quota", site_admission_quota),
+    ("drain_timeout", site_drain_timeout),
     ("baseline_determinism", baseline_determinism),
 ]
 

@@ -1,0 +1,423 @@
+"""Fleet front-end overload bench: drive the gateway to 2x its
+sustainable throughput and assert the graceful-degradation contract.
+
+Prints ONE JSON line (same contract as serve_bench/store_bench):
+{"metric": "fleet_overload", "value": <interactive p99 s>, ...}.
+
+Methodology (closed-loop calibration, open-loop attack):
+
+1. **Sustainable throughput** — a closed-loop phase: K worker threads
+   submit-and-fetch back to back against the gateway.  Completions/s
+   is the service's self-paced capacity; the unloaded interactive p99
+   is the baseline the overload ceiling is scaled from.
+2. **2x overload** — an OPEN-loop phase: Poisson arrivals (seeded,
+   exponential inter-arrival gaps) at 2x the measured sustainable
+   rate.  Arrival times are precomputed and independent of
+   completions — the generator does not slow down when the service
+   does, which is what makes overload real.  Traffic is a two-lane,
+   two-tenant mix (30% interactive with a deadline, 70% batch).
+3. **Drain under load** — a fresh gateway over the SAME service takes
+   another open-loop burst; mid-burst, ``drain()`` runs.  Every
+   admitted ticket must settle (result or typed failure — none lost),
+   later submits shed typed ``draining``, and the hierarchy cache is
+   exported to the artifact store for the replacement worker.
+
+Floors (non-zero exit on violation):
+  * zero unhandled (non-taxonomy) exceptions anywhere;
+  * 100% of rejects are typed AdmissionRejected/Overloaded sheds
+    carrying ``retry_after_s``;
+  * the overload phase actually sheds (2x load MUST be over budget);
+  * interactive p99 stays under its ceiling
+    (max(--p99-ceiling, 20x the unloaded baseline)) while the batch
+    lane is the one that degrades (sheds at least as hard as
+    interactive — the reserve contract);
+  * drain loses nothing: settled+failed+timed_out == admitted,
+    timed_out == 0, exported >= 1.
+
+Run on the CPU backend (the tier the acceptance gate measures):
+
+    JAX_PLATFORMS=cpu python ci/load_bench.py [--duration 3.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+
+# runnable from any cwd: the repo root precedes ci/ on the path
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+INTERACTIVE_FRAC = 0.3
+INTERACTIVE_DEADLINE_S = 2.0
+
+
+class _Outcomes:
+    """Thread-safe outcome tally, split by lane."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.offered = {"interactive": 0, "batch": 0}
+        self.completed = {"interactive": 0, "batch": 0}
+        self.shed = {"interactive": 0, "batch": 0}
+        self.typed_failures = {"interactive": 0, "batch": 0}
+        self.unhandled: list = []
+
+    def count(self, bucket: dict, lane: str, n: int = 1):
+        with self.lock:
+            bucket[lane] += n
+
+    def record_unhandled(self, where: str, e: BaseException):
+        with self.lock:
+            self.unhandled.append(
+                f"{where}: {type(e).__name__}: {e}"
+            )
+
+    def totals(self) -> dict:
+        with self.lock:
+            return {
+                "offered": dict(self.offered),
+                "completed": dict(self.completed),
+                "shed": dict(self.shed),
+                "typed_failures": dict(self.typed_failures),
+                "unhandled": list(self.unhandled),
+            }
+
+
+def _submit_one(gw, out, systems, i, lane, rng_b):
+    """One gateway submission with the full outcome taxonomy; returns
+    the admitted ticket or None.  ONLY typed taxonomy errors are
+    expected — anything else is an unhandled-exception floor
+    violation."""
+    from amgx_tpu.core.errors import AdmissionRejected, AMGXTPUError
+
+    sp, _ = systems[i % len(systems)]
+    b = rng_b.standard_normal(sp.shape[0])
+    out.count(out.offered, lane)
+    try:
+        return gw.submit(
+            sp, b,
+            tenant="web" if lane == "interactive" else "jobs",
+            lane=lane,
+            deadline_s=(
+                INTERACTIVE_DEADLINE_S
+                if lane == "interactive" else None
+            ),
+        )
+    except AdmissionRejected as e:
+        # the ONLY acceptable shed: typed, carrying an actionable
+        # retry hint (None would leave clients guessing their backoff)
+        if getattr(e, "retry_after_s", None) is None:
+            out.record_unhandled("submit(shed-without-hint)", e)
+        out.count(out.shed, lane)
+        return None
+    except AMGXTPUError as e:
+        out.count(out.typed_failures, lane)
+        return None
+    except BaseException as e:  # noqa: BLE001 — the floor
+        out.record_unhandled("submit", e)
+        return None
+
+
+def _consume(ticket, lane, out):
+    from amgx_tpu.core.errors import AMGXTPUError
+
+    try:
+        res = ticket.result()
+        if int(res.status) == 0:
+            out.count(out.completed, lane)
+        else:
+            out.count(out.typed_failures, lane)
+    except AMGXTPUError:
+        out.count(out.typed_failures, lane)
+    except BaseException as e:  # noqa: BLE001 — the floor
+        out.record_unhandled("result", e)
+
+
+def _measure_sustainable(gw, systems, duration_s, workers=8):
+    """Closed-loop self-paced throughput: each worker submits and
+    immediately fetches, back to back, for ``duration_s``."""
+    out = _Outcomes()
+    stop = time.monotonic() + duration_s
+    counter = [0]
+    lock = threading.Lock()
+
+    def loop(wid):
+        import numpy as np
+
+        rng_b = np.random.default_rng(1000 + wid)
+        while time.monotonic() < stop:
+            with lock:
+                i = counter[0]
+                counter[0] += 1
+            t = _submit_one(gw, out, systems, i, "interactive", rng_b)
+            if t is not None:
+                _consume(t, "interactive", out)
+
+    threads = [
+        threading.Thread(target=loop, args=(w,)) for w in range(workers)
+    ]
+    t0 = time.monotonic()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.monotonic() - t0
+    tot = out.totals()
+    rate = tot["completed"]["interactive"] / max(wall, 1e-9)
+    return rate, out
+
+
+def _open_loop(gw, systems, rate, duration_s, seed, out, consumers,
+               mid_hook=None):
+    """Open-loop Poisson arrival generator: precomputed exponential
+    gaps at ``rate``/s, independent of completions.  Admitted tickets
+    are handed to the ``consumers`` pool; ``mid_hook`` (drain) fires
+    once past the midpoint."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    rng_b = np.random.default_rng(seed + 1)
+    gaps = rng.exponential(1.0 / rate, size=int(rate * duration_s * 2))
+    arrivals = np.cumsum(gaps)
+    arrivals = arrivals[arrivals < duration_s]
+    lanes = np.where(
+        rng.random(arrivals.shape[0]) < INTERACTIVE_FRAC,
+        "interactive", "batch",
+    )
+    futures = []
+    hook_fired = False
+    t0 = time.monotonic()
+    for i, (t_arr, lane) in enumerate(zip(arrivals, lanes)):
+        now = time.monotonic() - t0
+        if (mid_hook is not None and not hook_fired
+                and now >= duration_s * 0.5):
+            hook_fired = True
+            mid_hook()
+        wait = t_arr - now
+        if wait > 0:
+            time.sleep(wait)
+        ticket = _submit_one(gw, out, systems, i, str(lane), rng_b)
+        if ticket is not None:
+            futures.append(
+                consumers.submit(_consume, ticket, str(lane), out)
+            )
+    if mid_hook is not None and not hook_fired:
+        mid_hook()
+    return futures
+
+
+def run(shape=(8, 8), duration_s=3.0, calib_s=1.0, drain_s=1.5,
+        overload=2.0, max_inflight=64, seed=0, p99_ceiling_s=1.0):
+    import concurrent.futures
+
+    import jax
+
+    from amgx_tpu.io.poisson import jittered_poisson_family
+    from amgx_tpu.serve import BatchedSolveService, SolveGateway
+
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_enable_x64", True)
+
+    systems = jittered_poisson_family(shape, 8, seed=seed)
+    store_dir = tempfile.mkdtemp(prefix="amgx_fleet_bench_")
+    svc = BatchedSolveService(
+        max_batch=8, max_wait_s=0.002, queue_limit=256, store=store_dir
+    )
+    gw = SolveGateway(
+        svc, max_inflight=max_inflight, interactive_reserve_frac=0.25
+    )
+    gw.start()
+    try:
+        # warm-up: setup + ALL batch-bucket compiles amortize over a
+        # fleet's lifetime — concurrent closed-loop workers form
+        # groups of every power-of-two size, so each bucket (1/2/4/8)
+        # must be AOT-warm or its first compile pollutes the
+        # sustainable-rate calibration by seconds
+        for size in (8, 4, 2, 1):
+            warm = [
+                gw.submit(sp, b, lane="interactive")
+                for sp, b in systems[:size]
+            ]
+            gw.flush()
+            for t in warm:
+                t.result()
+        svc.metrics.reset_latency()
+
+        # ---- phase 1: closed-loop sustainable rate -----------------
+        sustainable, _ = _measure_sustainable(gw, systems, calib_s)
+        base_p99 = svc.metrics.lane_percentile("interactive", 99.0)
+        svc.metrics.reset_latency()
+
+        # ---- phase 2: open-loop Poisson arrivals at 2x -------------
+        offered_rate = overload * sustainable
+        out = _Outcomes()
+        with concurrent.futures.ThreadPoolExecutor(8) as consumers:
+            futs = _open_loop(
+                gw, systems, offered_rate, duration_s, seed + 7, out,
+                consumers,
+            )
+            gw.flush()
+            for f in futs:
+                f.result()
+        tot = out.totals()
+        p99_i = svc.metrics.lane_percentile("interactive", 99.0)
+        p99_b = svc.metrics.lane_percentile("batch", 99.0)
+
+        # ---- phase 3: drain under load -----------------------------
+        gw2 = SolveGateway(
+            svc, max_inflight=max_inflight,
+            interactive_reserve_frac=0.25,
+        )
+        out3 = _Outcomes()
+        drain_report = {}
+
+        def do_drain():
+            drain_report.update(gw2.drain(timeout_s=60.0))
+
+        with concurrent.futures.ThreadPoolExecutor(8) as consumers:
+            futs = _open_loop(
+                gw2, systems, max(offered_rate, 50.0), drain_s,
+                seed + 13, out3, consumers, mid_hook=do_drain,
+            )
+            for f in futs:
+                f.result()
+        tot3 = out3.totals()
+    finally:
+        try:
+            gw.stop()
+        except BaseException:  # noqa: BLE001 — already drained is fine
+            pass
+
+    def frac(n, d):
+        return n / d if d else 0.0
+
+    shed_total = sum(tot["shed"].values())
+    offered_total = sum(tot["offered"].values())
+    settled3 = sum(tot3["completed"].values()) \
+        + sum(tot3["typed_failures"].values()) \
+        + sum(tot3["shed"].values())
+    rec = {
+        "metric": "fleet_overload",
+        "value": round(p99_i, 6) if p99_i is not None else None,
+        "unit": "interactive p99 s at 2x sustainable load",
+        "device": jax.devices()[0].platform,
+        "problem": f"poisson5_{shape[0]}x{shape[1]}_2tenant",
+        "sustainable_per_s": round(sustainable, 1),
+        "offered_per_s": round(offered_rate, 1),
+        "offered": tot["offered"],
+        "completed": tot["completed"],
+        "shed": tot["shed"],
+        "typed_failures": tot["typed_failures"],
+        "unhandled": len(tot["unhandled"]),
+        "base_interactive_p99_s": (
+            round(base_p99, 6) if base_p99 is not None else None
+        ),
+        "interactive_p99_s": (
+            round(p99_i, 6) if p99_i is not None else None
+        ),
+        "batch_p99_s": round(p99_b, 6) if p99_b is not None else None,
+        "shed_frac": round(frac(shed_total, offered_total), 3),
+        "interactive_shed_frac": round(
+            frac(tot["shed"]["interactive"],
+                 tot["offered"]["interactive"]), 3
+        ),
+        "batch_shed_frac": round(
+            frac(tot["shed"]["batch"], tot["offered"]["batch"]), 3
+        ),
+        "drain": {
+            **drain_report,
+            "offered": sum(tot3["offered"].values()),
+            "settled": settled3,
+            "unhandled": len(tot3["unhandled"]),
+        },
+    }
+
+    # ---- floors --------------------------------------------------------
+    problems = []
+    if tot["unhandled"] or tot3["unhandled"]:
+        problems.append(
+            "unhandled exceptions: "
+            + "; ".join((tot["unhandled"] + tot3["unhandled"])[:5])
+        )
+    if shed_total == 0:
+        problems.append(
+            f"2x overload ({offered_rate:.0f}/s) produced zero sheds "
+            "— the admission budget never engaged"
+        )
+    if p99_i is None:
+        problems.append("no interactive completions under overload")
+    else:
+        ceiling = max(
+            p99_ceiling_s,
+            20.0 * base_p99 if base_p99 else p99_ceiling_s,
+        )
+        rec["p99_ceiling_s"] = round(ceiling, 6)
+        if p99_i > ceiling:
+            problems.append(
+                f"interactive p99 {p99_i:.4f}s over its ceiling "
+                f"{ceiling:.4f}s"
+            )
+    if rec["batch_shed_frac"] < rec["interactive_shed_frac"]:
+        problems.append(
+            "batch lane shed less than interactive "
+            f"({rec['batch_shed_frac']} < "
+            f"{rec['interactive_shed_frac']}): the reserve contract "
+            "is inverted"
+        )
+    if settled3 != sum(tot3["offered"].values()):
+        problems.append(
+            f"drain lost tickets: {settled3} settled of "
+            f"{sum(tot3['offered'].values())} offered"
+        )
+    if drain_report.get("timed_out", 1) != 0:
+        problems.append(
+            f"drain timed out on {drain_report.get('timed_out')} "
+            "tickets"
+        )
+    if drain_report.get("exported", 0) < 1:
+        problems.append("drain exported no hierarchies to the store")
+    rec["ok"] = not problems
+    return rec, problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON record to this file")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="overload-phase seconds")
+    ap.add_argument("--calib", type=float, default=1.0,
+                    help="sustainable-rate calibration seconds")
+    ap.add_argument("--drain-duration", type=float, default=1.5)
+    ap.add_argument("--side", type=int, default=8,
+                    help="2D Poisson side length")
+    ap.add_argument("--p99-ceiling", type=float, default=1.0,
+                    help="absolute interactive p99 ceiling (s)")
+    args = ap.parse_args(argv)
+
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    rec, problems = run(
+        shape=(args.side, args.side),
+        duration_s=args.duration,
+        calib_s=args.calib,
+        drain_s=args.drain_duration,
+        p99_ceiling_s=args.p99_ceiling,
+    )
+    line = json.dumps(rec)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    for p in problems:
+        print(f"load_bench: {p}", file=sys.stderr)
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,6 +21,14 @@ JAX_PLATFORMS=cpu python ci/fault_smoke.py
 # regression).
 JAX_PLATFORMS=cpu python ci/serve_bench.py
 
+# ---- fleet front-end: overload + drain floors ------------------------
+# One JSON line; non-zero exit when 2x-sustainable load produces any
+# unhandled exception, any untyped reject (every shed must be a typed
+# AdmissionRejected/Overloaded with retry_after_s), an interactive-lane
+# p99 over its ceiling (batch must be the lane that degrades), or a
+# mid-load drain that loses an admitted ticket / exports nothing.
+JAX_PLATFORMS=cpu python ci/load_bench.py
+
 # ---- setup-artifact store: restore + warm-boot floors ----------------
 # One JSON line; non-zero exit when load_setup restore drops below 3x
 # over cold setup on the Poisson suite, or a warm-booted service fails

@@ -1,0 +1,473 @@
+"""Fleet front-end tests: admission control, priority lanes,
+deadline-aware shedding, graceful drain (amgx_tpu.serve.gateway /
+admission), and the percentile edge-case contract the shed predictor
+depends on (core/profiling.py)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import amgx_tpu
+from amgx_tpu.core.errors import (
+    AdmissionRejected,
+    DeadlineExceededError,
+    Overloaded,
+    RC_NO_MEMORY,
+    rc_for_exception,
+)
+from amgx_tpu.io.poisson import poisson_scipy
+from amgx_tpu.serve import (
+    BatchedSolveService,
+    SolveGateway,
+    TenantQuota,
+    TokenBucket,
+)
+
+amgx_tpu.initialize()
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def sysmat():
+    sp = poisson_scipy((8, 8)).tocsr()
+    sp.sort_indices()
+    return sp
+
+
+def _rhs(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(n)
+
+
+# ---------------------------------------------------------------------------
+# percentile / reservoir edge cases (the shed predictor's data contract)
+
+
+def test_percentile_empty_returns_none():
+    from amgx_tpu.core.profiling import LatencyReservoir, percentile
+
+    assert percentile([], 50.0) is None
+    assert percentile([], 99.0) is None
+    res = LatencyReservoir()
+    assert res.percentile(50.0) is None
+    assert res.percentile(99.0) is None
+    # summary keys stay float-valued for exporters
+    s = res.summary()
+    assert s["p50_s"] == 0.0 and s["p99_s"] == 0.0 and s["count"] == 0
+
+
+def test_percentile_single_sample_is_every_percentile():
+    from amgx_tpu.core.profiling import LatencyReservoir, percentile
+
+    assert percentile([0.25], 1.0) == 0.25
+    assert percentile([0.25], 99.0) == 0.25
+    res = LatencyReservoir()
+    res.add(0.125)
+    assert res.percentile(50.0) == 0.125
+    assert res.percentile(99.0) == 0.125
+    res.clear()
+    assert res.percentile(99.0) is None  # cleared = empty again
+
+
+def test_shed_predictor_admits_on_missing_percentile(sysmat):
+    """A cold gateway (empty reservoirs) must ADMIT deadline-carrying
+    requests — None percentiles are 'no data', not 'zero latency',
+    and not 'infinite latency' either."""
+    from amgx_tpu.serve.admission import can_meet_deadline
+
+    assert can_meet_deadline(0.001, None)  # no data -> admit
+    assert can_meet_deadline(None, 5.0)  # no deadline -> admit
+    assert not can_meet_deadline(0.1, 0.5)  # provably unmeetable
+    assert can_meet_deadline(1.0, 0.5)  # meetable
+
+    gw = SolveGateway(max_batch=4)
+    assert gw.predicted_p99_s() is None
+    t = gw.submit(sysmat, _rhs(sysmat.shape[0]), deadline_s=10.0)
+    gw.flush()
+    assert int(t.result().status) == 0
+
+
+# ---------------------------------------------------------------------------
+# token bucket / quotas
+
+
+def test_token_bucket_refill_and_retry_hint():
+    clock = [0.0]
+    b = TokenBucket(rate=10.0, burst=2.0, clock=lambda: clock[0])
+    assert b.try_take() == 0.0
+    assert b.try_take() == 0.0
+    wait = b.try_take()  # burst exhausted
+    assert wait == pytest.approx(0.1)
+    clock[0] += 0.1  # one token refills
+    assert b.try_take() == 0.0
+    clock[0] += 1000.0  # refill caps at burst
+    assert b.tokens <= b.burst
+    assert b.try_take() == 0.0
+    assert b.try_take() == 0.0
+    assert b.try_take() > 0.0
+
+
+def test_zero_rate_bucket_hint_is_capped(sysmat):
+    gw = SolveGateway(
+        max_batch=4,
+        quotas={"frozen": TenantQuota(rate=0.0, burst=1.0)},
+        retry_after_cap_s=5.0,
+    )
+    n = sysmat.shape[0]
+    t = gw.submit(sysmat, _rhs(n), tenant="frozen")
+    with pytest.raises(AdmissionRejected) as ei:
+        gw.submit(sysmat, _rhs(n), tenant="frozen")
+    assert ei.value.reason == "quota"
+    assert ei.value.retry_after_s == 5.0  # inf capped
+    gw.flush()
+    t.result()
+
+
+def test_tenant_quota_isolates_tenants(sysmat):
+    """One tenant exhausting its bucket must not shed another."""
+    n = sysmat.shape[0]
+    gw = SolveGateway(
+        max_batch=8,
+        quotas={"greedy": TenantQuota(rate=5.0, burst=1.0)},
+    )
+    t1 = gw.submit(sysmat, _rhs(n, 1), tenant="greedy")
+    with pytest.raises(AdmissionRejected) as ei:
+        gw.submit(sysmat, _rhs(n, 2), tenant="greedy")
+    assert ei.value.reason == "quota"
+    assert 0.0 < ei.value.retry_after_s <= 0.2 + 1e-6
+    # unlisted tenant: unlimited by default
+    t2 = gw.submit(sysmat, _rhs(n, 3), tenant="other")
+    gw.flush()
+    assert int(t1.result().status) == 0
+    assert int(t2.result().status) == 0
+    assert gw.metrics.get("shed_quota") == 1
+    assert gw.metrics.get("gateway_sheds") == 1
+
+
+# ---------------------------------------------------------------------------
+# global concurrency budget + lanes
+
+
+def test_overload_typed_with_retry_hint_and_release(sysmat):
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=4, max_inflight=2,
+                      interactive_reserve_frac=0.0)
+    t1 = gw.submit(sysmat, _rhs(n, 1))
+    t2 = gw.submit(sysmat, _rhs(n, 2))
+    with pytest.raises(Overloaded) as ei:
+        gw.submit(sysmat, _rhs(n, 3))
+    assert ei.value.reason == "overloaded"
+    assert ei.value.retry_after_s is not None
+    assert ei.value.rc == RC_NO_MEMORY  # the C-API shed code
+    gw.flush()
+    t1.result()
+    t2.result()  # settles release the budget ...
+    assert gw.admission.inflight == 0
+    t3 = gw.submit(sysmat, _rhs(n, 4))  # ... so admission resumes
+    gw.flush()
+    assert int(t3.result().status) == 0
+
+
+def test_batch_lane_sheds_before_interactive(sysmat):
+    """The interactive reserve: batch hits its (1 - frac) ceiling
+    while interactive still admits, so overload degrades batch
+    first."""
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=8, max_inflight=4,
+                      interactive_reserve_frac=0.5)
+    assert gw.admission.batch_budget == 2
+    tickets = [
+        gw.submit(sysmat, _rhs(n, i), lane="batch") for i in range(2)
+    ]
+    with pytest.raises(Overloaded):
+        gw.submit(sysmat, _rhs(n, 9), lane="batch")
+    # interactive still has its reserve
+    tickets.append(gw.submit(sysmat, _rhs(n, 3), lane="interactive"))
+    tickets.append(gw.submit(sysmat, _rhs(n, 4), lane="interactive"))
+    with pytest.raises(Overloaded):
+        gw.submit(sysmat, _rhs(n, 5), lane="interactive")
+    gw.flush()
+    for t in tickets:
+        assert int(t.result().status) == 0
+    assert gw.metrics.get("shed_overloaded") == 2
+
+
+def test_interactive_preempts_batch_at_flush(sysmat):
+    """Lane priority at flush-group formation: interactive groups
+    dispatch before batch groups, and an AGED batch group regains
+    rank via its starvation credit."""
+    n = sysmat.shape[0]
+    svc = BatchedSolveService(max_batch=8, max_wait_s=0.001)
+    order = []
+    orig = BatchedSolveService._execute_group
+
+    def spy(self, grp, wait_dispatch=True):
+        order.append(grp.lane)
+        return orig(self, grp, wait_dispatch)
+
+    try:
+        BatchedSolveService._execute_group = spy
+        tb = svc.submit(sysmat, _rhs(n, 1), lane="batch")
+        ti = svc.submit(sysmat, _rhs(n, 2), lane="interactive")
+        svc.flush()
+        assert order == ["interactive", "batch"]
+        assert int(tb.result().status) == 0
+        assert int(ti.result().status) == 0
+        # aging credit: a batch group older than the aging window is
+        # promoted and no longer loses to a fresh interactive group
+        order.clear()
+        tb2 = svc.submit(sysmat, _rhs(n, 3), lane="batch")
+        time.sleep(
+            svc.max_wait_s * svc._BATCH_AGING_FACTOR + 0.01
+        )
+        ti2 = svc.submit(sysmat, _rhs(n, 4), lane="interactive")
+        svc.flush()
+        assert order[0] == "batch"  # promoted: oldest deadline first
+        assert svc.metrics.get("batch_promotions") == 1
+        tb2.result()
+        ti2.result()
+    finally:
+        BatchedSolveService._execute_group = orig
+    snap = svc.metrics.snapshot()
+    assert snap["lanes"]["interactive"]["count"] == 2
+    assert snap["lanes"]["batch"]["count"] == 2
+
+
+def test_poll_defers_batch_until_aging_promotes(sysmat):
+    """Real preemption on the poller path: while an interactive group
+    is due, a due batch group is deferred to a later poll
+    (``batch_deferrals``); once it ages past the credit it promotes
+    and flushes even under continued interactive pressure."""
+    n = sysmat.shape[0]
+    svc = BatchedSolveService(max_batch=8, max_wait_s=0.01)
+    tb = svc.submit(sysmat, _rhs(n, 1), lane="batch")
+    ti1 = svc.submit(sysmat, _rhs(n, 2), lane="interactive")
+    time.sleep(0.02)  # both groups past max_wait
+    svc.poll()
+    assert svc.metrics.get("batch_deferrals") == 1
+    assert not tb.done()  # still queued, not lost
+    assert int(ti1.result().status) == 0
+    # age past the credit while keeping interactive pressure up
+    time.sleep(svc.max_wait_s * svc._BATCH_AGING_FACTOR)
+    ti2 = svc.submit(sysmat, _rhs(n, 3), lane="interactive")
+    time.sleep(0.02)
+    svc.poll()
+    assert svc.metrics.get("batch_promotions") == 1
+    assert int(tb.result().status) == 0
+    assert int(ti2.result().status) == 0
+
+
+# ---------------------------------------------------------------------------
+# deadline shedding end-to-end
+
+
+def test_deadline_shed_when_p99_says_unmeetable(sysmat):
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=4)
+    # feed the predictor: make the observed end-to-end p99 ~0.5 s
+    for _ in range(8):
+        gw.metrics.latency["total"].add(0.5)
+    assert gw.predicted_p99_s() == pytest.approx(0.5)
+    with pytest.raises(AdmissionRejected) as ei:
+        gw.submit(sysmat, _rhs(n), deadline_s=0.05)
+    assert ei.value.reason == "deadline_unmeetable"
+    assert ei.value.retry_after_s == pytest.approx(0.5)
+    # a meetable deadline still admits
+    t = gw.submit(sysmat, _rhs(n), deadline_s=5.0)
+    gw.flush()
+    assert int(t.result().status) == 0
+    assert gw.metrics.get("shed_deadline_unmeetable") == 1
+
+
+def test_expired_deadline_rejected_at_submit(sysmat):
+    svc = BatchedSolveService(max_batch=4)
+    with pytest.raises(DeadlineExceededError):
+        svc.submit(sysmat, _rhs(sysmat.shape[0]), deadline_s=0.0)
+    assert svc.metrics.get("deadline_expired") == 1
+    assert svc.metrics.get("submitted") == 0  # never queued
+
+
+def test_late_fetch_short_circuits_typed(sysmat):
+    """A ticket whose deadline passes after dispatch but before
+    anyone fetched its group gets a typed deadline failure instead of
+    blocking on the device; a deadline-free groupmate still fetches
+    the group normally."""
+    n = sysmat.shape[0]
+    svc = BatchedSolveService(max_batch=8)
+    t_late = svc.submit(sysmat, _rhs(n, 1), deadline_s=0.05)
+    t_ok = svc.submit(sysmat, _rhs(n, 2))
+    svc.flush()  # dispatched; nothing fetched yet
+    time.sleep(0.1)
+    with pytest.raises(DeadlineExceededError):
+        t_late.result()
+    assert svc.metrics.get("deadline_expired_fetch") == 1
+    assert int(t_ok.result().status) == 0
+
+
+# ---------------------------------------------------------------------------
+# breaker shed at the door
+
+
+def test_breaker_open_sheds_at_admission(sysmat):
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=4)
+    svc = gw.service
+    # resolve the padded fingerprint exactly as submit would
+    from amgx_tpu.serve.service import _host_csr
+
+    ro, ci, vals, nn, raw_fp = _host_csr(sysmat)
+    pat = svc._pattern_for(ro, ci, nn, raw_fp)
+    svc._broken.add(pat.fingerprint)
+    with pytest.raises(AdmissionRejected) as ei:
+        gw.submit(sysmat, _rhs(n))
+    assert ei.value.reason == "breaker_open"
+    assert ei.value.retry_after_s is not None
+    assert gw.metrics.get("shed_breaker_open") == 1
+    # shed_broken=False admits through to the service's own
+    # bypass/probe machinery
+    gw2 = SolveGateway(svc, shed_broken=False)
+    t = gw2.submit(sysmat, _rhs(n))
+    gw2.flush()
+    assert int(t.result().status) == 0  # quarantine-isolated solve
+    svc._broken.discard(pat.fingerprint)
+
+
+# ---------------------------------------------------------------------------
+# drain + health + asyncio
+
+
+def test_drain_completes_tickets_exports_and_stops_admission(
+    sysmat, tmp_path
+):
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=8, store=str(tmp_path / "store"))
+    rhss = [_rhs(n, i) for i in range(4)]
+    tickets = [gw.submit(sysmat, b) for b in rhss]
+    report = gw.drain(timeout_s=30.0)
+    assert gw.state == "drained"
+    assert report["settled"] == 4
+    assert report["failed"] == 0 and report["timed_out"] == 0
+    assert report["exported"] >= 1
+    for b, t in zip(rhss, tickets):
+        res = t.result()  # settled results stay readable after drain
+        assert int(res.status) == 0
+        relres = np.linalg.norm(
+            sysmat @ np.asarray(res.x) - b
+        ) / np.linalg.norm(b)
+        assert relres < 1e-6
+    with pytest.raises(Overloaded) as ei:
+        gw.submit(sysmat, _rhs(n, 9))
+    assert ei.value.reason == "draining"
+    # idempotent: a second drain returns the first report
+    assert gw.drain() == report
+    # the exported hierarchy warm-boots a REPLACEMENT worker: its
+    # first group for this fingerprint is a cache hit, zero setups
+    svc2 = BatchedSolveService(
+        max_batch=8, store=str(tmp_path / "store")
+    )
+    assert svc2.warm_boot(wait=True) >= 1
+    t = svc2.submit(sysmat, _rhs(n, 11))
+    svc2.flush()
+    assert int(t.result().status) == 0
+    assert svc2.metrics.get("setups") == 0
+    assert svc2.metrics.get("cache_hits") >= 1
+
+
+def test_health_snapshot(sysmat):
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=4, max_inflight=16)
+    h = gw.health()
+    assert h["state"] == "serving"
+    assert h["interactive_p99_s"] is None  # cold: no data, not 0.0
+    t = gw.submit(sysmat, _rhs(n), lane="interactive")
+    gw.flush()
+    t.result()
+    h = gw.health()
+    assert h["admitted"] == 1 and h["completed"] == 1
+    assert h["inflight"] == 0
+    assert h["interactive_p99_s"] > 0.0
+    assert h["untyped_failures"] == 0
+
+
+def test_async_solve_roundtrip(sysmat):
+    n = sysmat.shape[0]
+    b = _rhs(n, 3)
+
+    async def go():
+        gw = SolveGateway(max_batch=4, max_wait_s=0.002)
+        gw.start()
+        try:
+            res = await gw.solve(
+                sysmat, b, tenant="web", lane="interactive",
+                deadline_s=30.0,
+            )
+            # typed sheds surface synchronously in the coroutine too
+            for _ in range(4):
+                gw.metrics.latency["total"].add(1.0)
+            with pytest.raises(AdmissionRejected):
+                await gw.solve(sysmat, b, deadline_s=0.001)
+            return res
+        finally:
+            gw.stop()
+
+    res = asyncio.run(go())
+    assert int(res.status) == 0
+    relres = np.linalg.norm(
+        sysmat @ np.asarray(res.x) - b
+    ) / np.linalg.norm(b)
+    assert relres < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# C API: shed maps to the RC boundary
+
+
+def test_shed_rc_mapping_and_capi_batch(sysmat, monkeypatch):
+    """AdmissionRejected carries RC_NO_MEMORY through
+    rc_for_exception, and an admission-fronted capi batch turns sheds
+    into per-system FAILED statuses — never an API error."""
+    assert rc_for_exception(Overloaded("x")) == RC_NO_MEMORY
+    assert rc_for_exception(
+        AdmissionRejected("x", retry_after_s=1.0)
+    ) == RC_NO_MEMORY
+
+    from amgx_tpu.api import capi
+
+    assert "overloaded" in capi.get_error_string(RC_NO_MEMORY)
+
+    monkeypatch.setenv("AMGX_TPU_CAPI_ADMISSION", "1")
+    capi.initialize()
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG", "max_iters": 100, "tolerance": 1e-8,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI"}}'
+    )
+    res_h = capi.resources_create_simple(cfg)
+    n = sysmat.shape[0]
+    mh, rh, sh = [], [], []
+    for i in range(3):
+        m = capi.matrix_create(res_h)
+        capi.matrix_upload_all(
+            m, n, sysmat.nnz, 1, 1,
+            sysmat.indptr.astype(np.int32),
+            sysmat.indices.astype(np.int32), sysmat.data,
+        )
+        r = capi.vector_create(res_h)
+        capi.vector_upload(r, n, 1, _rhs(n, i))
+        x = capi.vector_create(res_h)
+        capi.vector_set_zero(x, n, 1)
+        mh.append(m)
+        rh.append(r)
+        sh.append(x)
+    slv = capi.solver_create(res_h, "dDDI", cfg)
+    rc = capi.solver_solve_batch(slv, mh, rh, sh)
+    assert rc == capi.RC_OK
+    statuses = [
+        capi.solver_get_batch_status(slv, i) for i in range(3)
+    ]
+    # budget 1: exactly one admitted + solved, the rest shed typed
+    # into per-system FAILED
+    assert statuses.count(capi.SOLVE_SUCCESS) == 1
+    assert statuses.count(capi.SOLVE_FAILED) == 2

@@ -508,21 +508,34 @@ def test_serve_compile_failure_recovers_via_quarantine():
 
 
 def test_serve_deadline_expires_only_late_ticket():
-    """A ticket with an already-passed deadline fails with
-    ResourceError at flush; its groupmates execute normally."""
-    from amgx_tpu.core.errors import ResourceError
+    """Deadlines are enforced end-to-end: an already-expired deadline
+    is rejected TYPED at submit (it never occupies a staging row); a
+    deadline that passes while queued fails only that ticket at
+    flush; groupmates execute normally."""
+    import time
+
+    from amgx_tpu.core.errors import (
+        DeadlineExceededError,
+        ResourceError,
+    )
     from amgx_tpu.serve import BatchedSolveService
 
     sp = _poisson_csr()
     n = sp.shape[0]
     svc = BatchedSolveService(max_batch=8)
-    t_late = svc.submit(sp, np.ones(n), deadline_s=-1.0)
+    # dead on arrival: typed reject at the submit boundary
+    with pytest.raises(DeadlineExceededError):
+        svc.submit(sp, np.ones(n), deadline_s=-1.0)
+    assert svc.metrics.get("deadline_expired") == 1
+    # expires while queued: fails at flush, groupmate unaffected
+    t_late = svc.submit(sp, np.ones(n), deadline_s=0.01)
     t_ok = svc.submit(sp, np.ones(n))
+    time.sleep(0.05)
     svc.flush()
-    with pytest.raises(ResourceError):
+    with pytest.raises(ResourceError):  # DeadlineExceededError IS one
         t_late.result()
     assert int(t_ok.result().status) == 0
-    assert svc.metrics.get("deadline_expired") == 1
+    assert svc.metrics.get("deadline_expired") == 2
 
 
 def test_serve_quarantine_reuses_cached_hierarchy(monkeypatch):
@@ -554,6 +567,106 @@ def test_serve_quarantine_reuses_cached_hierarchy(monkeypatch):
     assert svc.metrics.get("quarantines") == 1
     assert svc.metrics.get("quarantine_entry_reuses") == 3
     assert svc.metrics.get("setups") == setups  # no re-derivation
+
+
+def test_concurrent_submit_while_breaker_trips(monkeypatch):
+    """N threads hammer submit() while every batched attempt for the
+    fingerprint fails (forced compile-path error): the breaker trips
+    exactly once, NO group is corrupted (every ticket settles with a
+    correct solution or a typed error — here all succeed via
+    quarantine isolation), and the breaker/bypass metrics stay
+    consistent.  After the fault clears, a half-open probe closes the
+    breaker and batching resumes."""
+    import threading
+
+    from amgx_tpu.core.errors import AMGXTPUError
+    from amgx_tpu.serve import BatchedSolveService
+    from amgx_tpu.serve.cache import CompileCache
+
+    sp = _poisson_csr()
+    n = sp.shape[0]
+    svc = BatchedSolveService(max_batch=4, breaker_threshold=2)
+    # healthy warm-up: hierarchy entry cached, so quarantine re-solves
+    # reuse it (values-only resetup) instead of full per-request setup
+    assert all(
+        int(r.status) == 0
+        for r in svc.solve_many(
+            [(sp, np.ones(n) * (i + 1)) for i in range(2)]
+        )
+    )
+
+    real_get = CompileCache.get
+
+    def boom(self, entry, Bb):
+        raise RuntimeError("forced batched-compile failure")
+
+    monkeypatch.setattr(CompileCache, "get", boom)
+    n_threads, per_thread = 4, 6
+    results: dict = {}
+    errors: list = []
+    lock = threading.Lock()
+
+    def hammer(tid):
+        rng = np.random.default_rng(100 + tid)
+        for k in range(per_thread):
+            b = rng.standard_normal(n)
+            try:
+                t = svc.submit(sp, b)
+                svc.flush()
+                res = t.result()
+            except AMGXTPUError as e:  # typed is acceptable settling
+                with lock:
+                    errors.append(e)
+            except BaseException as e:  # noqa: BLE001 — corruption
+                with lock:
+                    errors.append(AssertionError(f"untyped: {e!r}"))
+            else:
+                with lock:
+                    results[(tid, k)] = (b, res)
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,))
+        for i in range(n_threads)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # no untyped escape, and every successful result is CORRECT for
+    # ITS OWN rhs — the no-group-corruption assertion
+    assert not [e for e in errors if isinstance(e, AssertionError)]
+    assert results, "every ticket errored — quarantine never isolated"
+    for b, res in results.values():
+        assert int(res.status) == 0
+        relres = np.linalg.norm(
+            sp @ np.asarray(res.x) - b
+        ) / np.linalg.norm(b)
+        assert relres < 1e-6
+    snap = svc.metrics.snapshot()
+    # breaker consistency under concurrency: one trip, it is OPEN,
+    # and every post-trip group either bypassed or probed (counts
+    # can't exceed the groups the threads produced)
+    assert snap["breaker_trips"] == 1
+    assert snap["breakers_open"] == 1
+    assert snap["failed_groups"] >= svc.breaker_threshold
+    total_groups = snap["failed_groups"] + snap["breaker_bypasses"]
+    assert total_groups <= n_threads * per_thread
+    assert snap.get("quarantined_solves", 0) + snap.get(
+        "poisoned_requests", 0
+    ) >= len(results) - 2  # warm-up solves rode the batched path
+
+    # fault cleared: a half-open probe closes the breaker again
+    monkeypatch.setattr(CompileCache, "get", real_get)
+    closed = False
+    for i in range(2 * svc._BREAKER_PROBE_EVERY):
+        t = svc.submit(sp, np.ones(n))
+        svc.flush()
+        assert int(t.result().status) == 0
+        if svc.metrics.get("breaker_closes") == 1:
+            closed = True
+            break
+    assert closed, "half-open probe never closed the breaker"
+    assert svc.metrics.get("breakers_open") == 0
 
 
 def test_retry_executable_cached_across_solves():
