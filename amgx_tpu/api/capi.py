@@ -1150,6 +1150,15 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
                     "AMGX_TPU_CAPI_ADMISSION must be an integer "
                     f"concurrency budget, got {budget_env!r}",
                 ) from None
+            if budget <= 0:
+                # a zero/negative budget would either silently disable
+                # admission control or shed EVERY submit — both
+                # contradict the set-but-malformed-fails-loudly intent
+                raise AMGXError(
+                    RC_BAD_CONFIGURATION,
+                    "AMGX_TPU_CAPI_ADMISSION must be a positive "
+                    f"concurrency budget, got {budget_env!r}",
+                )
         s.batch_service = BatchedSolveService(config=s.cfg.cfg)
         if budget:
             from amgx_tpu.serve import SolveGateway

@@ -178,10 +178,29 @@ class AdmissionController:
     def admit(self, tenant: str = "default",
               lane: str = "interactive",
               deadline_s: Optional[float] = None,
-              predicted_s: Optional[float] = None) -> None:
-        """Admit (reserving one in-flight unit) or raise typed."""
+              predicted_s=None) -> None:
+        """Admit (reserving one in-flight unit) or raise typed.
+
+        ``predicted_s`` is the pipeline tail estimate: a float, None,
+        or a zero-arg callable resolved at most once, always OUTSIDE
+        the controller lock so the reservoir copy+sort behind the
+        gateway's p99 never serializes concurrent admissions.  A
+        no-deadline submit resolves it only if the budget gate sheds
+        (the backoff hint) — the hot under-budget path skips it
+        entirely; a deadline-carrying submit resolves it up front,
+        before the quota gate, because the deadline check needs the
+        value inside the lock."""
         from amgx_tpu.core import faults
 
+        def resolve():
+            return (
+                predicted_s() if callable(predicted_s) else predicted_s
+            )
+
+        # the deadline gate's input is a pure function of the
+        # arguments: resolve it before taking the lock
+        pred = resolve() if deadline_s is not None else None
+        over = None
         with self._lock:
             bucket = self._bucket_for(tenant)
             if faults.should_fire("admission_quota"):
@@ -219,27 +238,35 @@ class AdmissionController:
                 else self.batch_budget
             )
             if self.inflight >= limit:
+                # budget shed outranks the deadline verdict (see the
+                # class docstring), but its hint may need a reservoir
+                # sort — record the decision and raise OUTSIDE the
+                # lock so a shed storm cannot serialize admissions
                 refund()
-                # backoff hint: one pipeline tail-latency's worth of
-                # draining, when known; a small fixed nudge otherwise
-                hint = predicted_s if predicted_s else 0.05
-                raise Overloaded(
-                    f"concurrency budget exhausted ({self.inflight} "
-                    f"in flight, {lane} lane limit {limit})",
-                    retry_after_s=self._cap(float(hint)),
-                    reason="overloaded",
-                )
-            if not can_meet_deadline(
-                deadline_s, predicted_s, self.deadline_headroom
+                over = (self.inflight, limit)
+            elif not can_meet_deadline(
+                deadline_s, pred, self.deadline_headroom
             ):
                 refund()
                 raise AdmissionRejected(
                     f"deadline_s={float(deadline_s):g} cannot be met "
-                    f"(current p99 {float(predicted_s):g}s)",
-                    retry_after_s=self._cap(float(predicted_s)),
+                    f"(current p99 {float(pred):g}s)",
+                    retry_after_s=self._cap(float(pred)),
                     reason="deadline_unmeetable",
                 )
-            self.inflight += 1
+            else:
+                self.inflight += 1
+        if over is not None:
+            inflight, limit = over
+            # backoff hint: one pipeline tail-latency's worth of
+            # draining, when known; a small fixed nudge otherwise
+            hint = (pred if deadline_s is not None else resolve())
+            raise Overloaded(
+                f"concurrency budget exhausted ({inflight} "
+                f"in flight, {lane} lane limit {limit})",
+                retry_after_s=self._cap(float(hint or 0.05)),
+                reason="overloaded",
+            )
 
     def release(self, n: int = 1) -> None:
         """Return ``n`` in-flight units (the paired ticket settled)."""

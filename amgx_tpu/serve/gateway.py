@@ -12,7 +12,9 @@ gateway makes overload a *first-class, typed, recoverable* condition:
       │ 2. breaker shed        — pattern's circuit breaker open?
       │                          shed BEFORE it queues (the PR 2
       │                          quarantine machinery, moved to the
-      │                          door)
+      │                          door); every Nth submit is admitted
+      │                          as the half-open probe so the
+      │                          breaker can still close
       │ 3. admission control   — tenant token bucket, then the global
       │                          concurrency budget (batch lane sheds
       │                          first: interactive keeps a reserved
@@ -73,14 +75,17 @@ class GatewayTicket:
     """Admitted-request handle: wraps the service's SolveTicket and
     settles the gateway's in-flight reservation exactly once, on the
     first ``result()`` that completes (either way).  ``drain()`` may
-    force-settle an unsettled ticket with a typed error; the typed
-    error then wins over a still-in-flight device result."""
+    force-settle an UNsettled ticket with a typed error; the typed
+    error then wins over a still-in-flight device result — but never
+    over a ``result()`` that already returned a success (settling and
+    force-failing are one atomic check-and-set, so retries stay
+    consistent with what the first caller saw)."""
 
     __slots__ = ("_gw", "_ticket", "tenant", "lane", "_settled",
-                 "_forced_error", "_lock")
+                 "_forced_error", "_lock", "_probe_fp")
 
     def __init__(self, gw: "SolveGateway", ticket, tenant: str,
-                 lane: str):
+                 lane: str, probe_fp: Optional[str] = None):
         self._gw = gw
         self._ticket = ticket
         self.tenant = tenant
@@ -88,6 +93,10 @@ class GatewayTicket:
         self._settled = False
         self._forced_error = None
         self._lock = threading.Lock()
+        # fingerprint this ticket is the door's half-open probe for
+        # (None for normal traffic): settling it re-opens the probe
+        # slot so the door can try again if the breaker is still open
+        self._probe_fp = probe_fp
 
     def done(self) -> bool:
         return self._forced_error is not None or self._ticket.done()
@@ -101,23 +110,37 @@ class GatewayTicket:
         except BaseException as e:
             self._settle(error=e)
             raise
+        settle = False
         with self._lock:
             # a drain timeout that force-settled this ticket while we
             # were blocked in the fetch wins: the caller sees the same
             # typed failure the drain report counted, not a success
-            # the accounting already wrote off
+            # the accounting already wrote off.  Marking settled in
+            # the SAME critical section closes the converse race: once
+            # a success is returned here, a later _fail is a no-op.
             if self._forced_error is not None:
                 raise self._forced_error
-        self._settle(error=None)
+            if not self._settled:
+                self._settled = True
+                settle = True
+        if settle:
+            self._gw._on_settle(self, None)
         return res
 
-    def _fail(self, err: BaseException):
+    def _fail(self, err: BaseException) -> bool:
         """Force-settle with a typed error (drain timeout): admitted
-        tickets are never lost — they complete or fail TYPED."""
+        tickets are never lost — they complete or fail TYPED.
+        Returns False without touching the ticket when it already
+        settled (a client's ``result()`` completed first): that
+        outcome stands, and the caller must not count this ticket as
+        timed out."""
         with self._lock:
-            if self._forced_error is None:
-                self._forced_error = err
-        self._settle(error=err)
+            if self._settled or self._forced_error is not None:
+                return False
+            self._forced_error = err
+            self._settled = True
+        self._gw._on_settle(self, err)
+        return True
 
     def _settle(self, error):
         with self._lock:
@@ -152,8 +175,10 @@ class SolveGateway:
     shed_broken: shed patterns whose circuit breaker is open at the
         DOOR (typed, with a retry hint at the breaker's probe
         cadence) instead of letting them occupy queue and quarantine
-        capacity.  The service's own half-open probing still runs for
-        traffic admitted while the breaker closes.
+        capacity.  Every Nth broken-pattern submit (the service's own
+        probe cadence) is admitted as the half-open probe so the
+        breaker can still close; its success re-opens the door for
+        the fingerprint.
     """
 
     def __init__(
@@ -194,6 +219,13 @@ class SolveGateway:
         self._state = "serving"  # serving | draining | drained
         self._state_lock = threading.Lock()
         self._outstanding: set = set()
+        # fingerprints with a door-admitted half-open probe currently
+        # in flight (guarded by the SERVICE lock, like the probe
+        # counter it aligns with): exactly one probe per fingerprint
+        # at a time, so a burst of broken-pattern traffic cannot
+        # flood past the breaker gate during the admit-to-execute
+        # window
+        self._probe_pending: set = set()
         self._drain_report: Optional[dict] = None
         # set once the drain's report is final: concurrent drain()
         # callers (shutdown hook + health manager) wait for the ONE
@@ -238,6 +270,43 @@ class SolveGateway:
         ADMITS — a cold service must take traffic to learn)."""
         return self.metrics.latency["total"].percentile(99.0)
 
+    def _door_probe(self, fp: str) -> bool:
+        """Half-open probing through a shedding door: every Nth
+        broken-pattern submit (the service's own probe cadence) is
+        ADMITTED so the breaker can still close — with everything
+        else shed at the door, nothing would otherwise reach
+        ``_execute_group`` and a tripped fingerprint would be a
+        permanent outage.  The door shares the service's per-
+        fingerprint probe counter and, on the admitting hit, rolls it
+        back one so ``_execute_group``'s own increment lands back on
+        the probe multiple: the admitted group IS the batched probe,
+        not the start of another shed cycle.
+
+        At most ONE probe is in flight per fingerprint
+        (``_probe_pending``, cleared when the probe's ticket settles):
+        while it is pending the door sheds WITHOUT counting, so the
+        rolled-back counter cannot re-admit a flood of broken-pattern
+        traffic during the admit-to-execute window, and the counter
+        stays aligned for the probe group's own increment."""
+        svc = self.service
+        with svc._lock:
+            if fp in self._probe_pending:
+                return False
+            n = svc._bypass_counts.get(fp, 0) + 1
+            if n % svc._BREAKER_PROBE_EVERY == 0:
+                svc._bypass_counts[fp] = n - 1
+                self._probe_pending.add(fp)
+                return True
+            svc._bypass_counts[fp] = n
+            return False
+
+    def _probe_done(self, fp: str):
+        """The in-flight probe for ``fp`` resolved (its ticket
+        settled, or it never became a ticket): re-open the probe
+        slot."""
+        with self.service._lock:
+            self._probe_pending.discard(fp)
+
     def submit(self, A, b, x0=None, *, tenant: str = "default",
                lane: str = "interactive",
                deadline_s: Optional[float] = None) -> GatewayTicket:
@@ -266,6 +335,7 @@ class SolveGateway:
             ))
         svc = self.service
         host = None
+        probe_fp = None
         if self.shed_broken and svc._broken:
             # tripped fingerprint sheds BEFORE it queues.  The CSR
             # extraction runs once — the tuple is threaded through to
@@ -277,34 +347,50 @@ class SolveGateway:
             ro, ci, vals, n, raw_fp = host
             pat = svc._pattern_for(ro, ci, n, raw_fp)
             if pat.fingerprint in svc._broken:
-                self._shed(AdmissionRejected(
-                    "pattern's circuit breaker is open "
-                    f"({pat.fingerprint[:12]}...): shedding at "
-                    "admission",
-                    retry_after_s=min(
-                        svc.max_wait_s * svc._BREAKER_PROBE_EVERY,
-                        self.admission.retry_after_cap_s,
-                    ),
-                    reason="breaker_open",
-                ))
+                if self._door_probe(pat.fingerprint):
+                    probe_fp = pat.fingerprint
+                else:
+                    self._shed(AdmissionRejected(
+                        "pattern's circuit breaker is open "
+                        f"({pat.fingerprint[:12]}...): shedding at "
+                        "admission",
+                        retry_after_s=min(
+                            svc.max_wait_s * svc._BREAKER_PROBE_EVERY,
+                            self.admission.retry_after_cap_s,
+                        ),
+                        reason="breaker_open",
+                    ))
         try:
-            self.admission.admit(
-                tenant=tenant,
-                lane=lane,
-                deadline_s=deadline_s,
-                predicted_s=self.predicted_p99_s(),
-            )
-        except AdmissionRejected as e:
-            self._shed(e)  # count by reason, then re-raise
-        try:
-            t = svc.submit(A, b, x0, deadline_s=deadline_s, lane=lane,
-                           _host=host)
+            try:
+                self.admission.admit(
+                    tenant=tenant,
+                    lane=lane,
+                    deadline_s=deadline_s,
+                    # bound method, not a value: the controller
+                    # resolves it lazily, so the reservoir copy+sort
+                    # behind the p99 never runs on the hot
+                    # no-deadline, under-budget path
+                    predicted_s=self.predicted_p99_s,
+                )
+            except AdmissionRejected as e:
+                self._shed(e)  # count by reason, then re-raise
+            try:
+                t = svc.submit(A, b, x0, deadline_s=deadline_s,
+                               lane=lane, _host=host)
+            except BaseException:
+                # not admitted after all (validation reject, dead-on-
+                # arrival deadline, malformed input): hand the budget
+                # back
+                self.admission.release()
+                raise
         except BaseException:
-            # not admitted after all (validation reject, dead-on-
-            # arrival deadline, malformed input): hand the budget back
-            self.admission.release()
+            # the door-admitted probe never became a ticket (shed by
+            # a later gate or rejected by the service): re-open the
+            # probe slot so the next broken-pattern submit retries it
+            if probe_fp is not None:
+                self._probe_done(probe_fp)
             raise
-        gt = GatewayTicket(self, t, tenant, lane)
+        gt = GatewayTicket(self, t, tenant, lane, probe_fp=probe_fp)
         with self._state_lock:
             self._outstanding.add(gt)
             late = self._state != "serving"
@@ -337,6 +423,8 @@ class SolveGateway:
         return await loop.run_in_executor(None, ticket.result)
 
     def _on_settle(self, ticket: GatewayTicket, error):
+        if ticket._probe_fp is not None:
+            self._probe_done(ticket._probe_fp)
         self.admission.release()
         with self._state_lock:
             self._outstanding.discard(ticket)
@@ -395,11 +483,16 @@ class SolveGateway:
             if ticket is None:
                 break
             if time.monotonic() > deadline:
-                ticket._fail(DeadlineExceededError(
+                if ticket._fail(DeadlineExceededError(
                     "gateway drain timed out before this ticket "
                     "settled"
-                ))
-                timed_out += 1
+                )):
+                    timed_out += 1
+                else:
+                    # lost the settle race to a client thread: its
+                    # success stands; give its _on_settle a beat to
+                    # unregister the ticket before re-scanning
+                    time.sleep(0.0005)
                 continue
             try:
                 ticket.result()

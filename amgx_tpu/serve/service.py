@@ -214,6 +214,14 @@ class SolveTicket:
     _pad_s: float = 0.0
     _lane: str = "interactive"
     _deadline: Optional[float] = None  # absolute monotonic, or None
+    # settle-path lock: concurrent result() calls on ONE ticket are a
+    # designed pattern (a gateway drain's settle loop races the client
+    # thread), so the deadline short-circuit's _batch/_error handoff
+    # must be atomic — both callers get the result, or both get the
+    # sticky typed error, never an AttributeError or a silent None
+    _rlock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def done(self) -> bool:
         return self._done
@@ -221,32 +229,36 @@ class SolveTicket:
     def result(self):
         if not self._done:
             self._service._flush_group_of(self)
-        if self._error is not None:
-            raise self._error
-        if self._result is None and self._batch is not None:
-            # deadline short-circuit at the fetch boundary: a late
-            # fetch whose group nobody has synced yet returns a typed
-            # deadline failure instead of blocking on the device (an
-            # already-fetched group's result is free — return it).
-            # The failure is STICKY (cached like every other terminal
-            # error) so retries raise consistently and the metric
-            # counts tickets, not calls.
-            if (
-                self._deadline is not None
-                and not self._batch.fetched()
-                and time.monotonic() > self._deadline
-            ):
-                from amgx_tpu.core.errors import DeadlineExceededError
-
-                self._service.metrics.inc("deadline_expired_fetch")
-                self._error = DeadlineExceededError(
-                    "serve deadline exceeded before the result was "
-                    "fetched"
-                )
-                self._batch = None  # final: release the group ref
+        with self._rlock:
+            if self._error is not None:
                 raise self._error
-            self._result = self._batch.result_for(self)
-        return self._result
+            if self._result is None and self._batch is not None:
+                # deadline short-circuit at the fetch boundary: a late
+                # fetch whose group nobody has synced yet returns a
+                # typed deadline failure instead of blocking on the
+                # device (an already-fetched group's result is free —
+                # return it).  The failure is STICKY (cached like
+                # every other terminal error) so retries raise
+                # consistently and the metric counts tickets, not
+                # calls.
+                if (
+                    self._deadline is not None
+                    and not self._batch.fetched()
+                    and time.monotonic() > self._deadline
+                ):
+                    from amgx_tpu.core.errors import (
+                        DeadlineExceededError,
+                    )
+
+                    self._service.metrics.inc("deadline_expired_fetch")
+                    self._error = DeadlineExceededError(
+                        "serve deadline exceeded before the result "
+                        "was fetched"
+                    )
+                    self._batch = None  # final: release the group ref
+                    raise self._error
+                self._result = self._batch.result_for(self)
+            return self._result
 
 
 @dataclasses.dataclass

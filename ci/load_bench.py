@@ -253,7 +253,10 @@ def run(shape=(8, 8), duration_s=3.0, calib_s=1.0, drain_s=1.5,
         svc.metrics.reset_latency()
 
         # ---- phase 2: open-loop Poisson arrivals at 2x -------------
-        offered_rate = overload * sustainable
+        # floor the offered rate like phase 3 does: a starved CI host
+        # can calibrate sustainable == 0, and 1/rate in the Poisson
+        # gap generator must never divide by zero
+        offered_rate = max(overload * sustainable, 50.0)
         out = _Outcomes()
         with concurrent.futures.ThreadPoolExecutor(8) as consumers:
             futs = _open_loop(
@@ -280,7 +283,7 @@ def run(shape=(8, 8), duration_s=3.0, calib_s=1.0, drain_s=1.5,
 
         with concurrent.futures.ThreadPoolExecutor(8) as consumers:
             futs = _open_loop(
-                gw2, systems, max(offered_rate, 50.0), drain_s,
+                gw2, systems, offered_rate, drain_s,
                 seed + 13, out3, consumers, mid_hook=do_drain,
             )
             for f in futs:

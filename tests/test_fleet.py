@@ -4,6 +4,7 @@ admission), and the percentile edge-case contract the shed predictor
 depends on (core/profiling.py)."""
 
 import asyncio
+import threading
 import time
 
 import numpy as np
@@ -306,6 +307,37 @@ def test_late_fetch_short_circuits_typed(sysmat):
     assert int(t_ok.result().status) == 0
 
 
+def test_late_fetch_concurrent_results_stay_typed(sysmat):
+    """Concurrent result() calls on ONE expired ticket (the drain
+    settle loop racing a client thread) must ALL get the sticky typed
+    deadline failure — never an AttributeError from the _batch=None
+    handoff, never a silent None result."""
+    n = sysmat.shape[0]
+    svc = BatchedSolveService(max_batch=8)
+    t = svc.submit(sysmat, _rhs(n, 1), deadline_s=0.05)
+    svc.flush()  # dispatched; nothing fetched yet
+    time.sleep(0.1)
+    outcomes = []
+
+    def hit():
+        try:
+            outcomes.append(t.result())
+        except BaseException as e:  # noqa: BLE001 — typing asserted
+            outcomes.append(e)
+
+    threads = [threading.Thread(target=hit) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(outcomes) == 8
+    assert all(
+        isinstance(o, DeadlineExceededError) for o in outcomes
+    ), outcomes
+    # sticky error counted once per TICKET, not per call
+    assert svc.metrics.get("deadline_expired_fetch") == 1
+
+
 # ---------------------------------------------------------------------------
 # breaker shed at the door
 
@@ -332,6 +364,48 @@ def test_breaker_open_sheds_at_admission(sysmat):
     gw2.flush()
     assert int(t.result().status) == 0  # quarantine-isolated solve
     svc._broken.discard(pat.fingerprint)
+
+
+def test_breaker_door_admits_half_open_probe(sysmat):
+    """A shedding door must not make a tripped fingerprint a
+    permanent outage: every Nth submit (the service's probe cadence)
+    is admitted, executes as the batched half-open probe, and its
+    success closes the breaker — after which the door is open
+    again."""
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=4)
+    svc = gw.service
+    from amgx_tpu.serve.service import _host_csr
+
+    ro, ci, vals, nn, raw_fp = _host_csr(sysmat)
+    pat = svc._pattern_for(ro, ci, nn, raw_fp)
+    svc._broken.add(pat.fingerprint)
+    every = svc._BREAKER_PROBE_EVERY
+    probe = None
+    sheds = 0
+    for i in range(every):
+        try:
+            probe = gw.submit(sysmat, _rhs(n, i))
+        except AdmissionRejected as e:
+            assert e.reason == "breaker_open"
+            sheds += 1
+    assert sheds == every - 1
+    assert probe is not None  # the Nth submit IS the probe
+    # while the probe is in flight the door HOLDS: a burst of
+    # broken-pattern traffic cannot flood past the breaker gate
+    # through the rolled-back counter
+    for i in range(3):
+        with pytest.raises(AdmissionRejected):
+            gw.submit(sysmat, _rhs(n, 50 + i))
+    gw.flush()
+    assert int(probe.result().status) == 0
+    # the probe executed batched and succeeded: breaker closed...
+    assert pat.fingerprint not in svc._broken
+    assert svc.metrics.get("breaker_closes") == 1
+    # ...and the door admits the fingerprint again, first try
+    t2 = gw.submit(sysmat, _rhs(n, 99))
+    gw.flush()
+    assert int(t2.result().status) == 0
 
 
 # ---------------------------------------------------------------------------
@@ -471,3 +545,47 @@ def test_shed_rc_mapping_and_capi_batch(sysmat, monkeypatch):
     # into per-system FAILED
     assert statuses.count(capi.SOLVE_SUCCESS) == 1
     assert statuses.count(capi.SOLVE_FAILED) == 2
+
+
+def test_capi_admission_rejects_nonpositive_budget(
+    sysmat, monkeypatch
+):
+    """AMGX_TPU_CAPI_ADMISSION=0 or negative must fail loudly
+    (RC_BAD_CONFIGURATION) on EVERY call — '0' silently disabling
+    admission control and a negative budget shedding every submit
+    both contradict the set-but-malformed-fails-loudly intent."""
+    from amgx_tpu.api import capi
+
+    capi.initialize()
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG", "max_iters": 100, "tolerance": 1e-8,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI"}}'
+    )
+    res_h = capi.resources_create_simple(cfg)
+    n = sysmat.shape[0]
+    m = capi.matrix_create(res_h)
+    capi.matrix_upload_all(
+        m, n, sysmat.nnz, 1, 1,
+        sysmat.indptr.astype(np.int32),
+        sysmat.indices.astype(np.int32), sysmat.data,
+    )
+    r = capi.vector_create(res_h)
+    capi.vector_upload(r, n, 1, _rhs(n))
+    x = capi.vector_create(res_h)
+    capi.vector_set_zero(x, n, 1)
+    slv = capi.solver_create(res_h, "dDDI", cfg)
+    for bad in ("0", "-4"):
+        monkeypatch.setenv("AMGX_TPU_CAPI_ADMISSION", bad)
+        with pytest.raises(capi.AMGXError) as ei:
+            capi.solver_solve_batch(slv, [m], [r], [x])
+        assert ei.value.rc == capi.RC_BAD_CONFIGURATION
+    # repeats loudly: the failed parse left no half-built service
+    monkeypatch.setenv("AMGX_TPU_CAPI_ADMISSION", "0")
+    with pytest.raises(capi.AMGXError) as ei:
+        capi.solver_solve_batch(slv, [m], [r], [x])
+    assert ei.value.rc == capi.RC_BAD_CONFIGURATION
+    # a valid budget after the operator fixes the env still works
+    monkeypatch.setenv("AMGX_TPU_CAPI_ADMISSION", "4")
+    assert capi.solver_solve_batch(slv, [m], [r], [x]) == capi.RC_OK
+    assert capi.solver_get_batch_status(slv, 0) == capi.SOLVE_SUCCESS
