@@ -867,6 +867,13 @@ def build_aggregation_level(Asp, cfg, scope):
         raise KeyError(
             f"CoarseAGeneratorFactory '{gen}' has not been registered"
         )
+    if not Asp.data.flags.writeable:
+        # the serve path hands the READ-ONLY host_csr view of a padded
+        # pattern, which can carry duplicate filler entries; scipy's
+        # abs()/binops dedup IN PLACE, so canonicalize a private copy
+        Asp = Asp.copy()
+        Asp.sum_duplicates()
+        Asp.sort_indices()
     with setup_phase("aggregation"):
         agg, geo_info = select_aggregates(Asp, cfg, scope)
     n = Asp.shape[0]

@@ -1303,6 +1303,41 @@ def solver_get_batch_metrics(slv_h: int) -> dict:
     return s.batch_service.metrics.snapshot()
 
 
+def solver_get_telemetry(slv_h: int) -> dict:
+    """Unified telemetry for one solver handle (AMGX_solver_get_
+    telemetry): direct-solve timings, the handle's serve metrics and
+    flight recorder (records + incident log) when batch solves ran,
+    and the process-wide registry snapshot (every component: serve,
+    gateway, store, solvers, tracing).  Collection degrades — a
+    telemetry failure is counted, never raised into the C ABI."""
+    from amgx_tpu import telemetry
+
+    s = _get(slv_h, _SolverHandle)
+    out: dict = {"enabled": telemetry.telemetry_enabled()}
+    if s.batch_service is not None:
+        _drain_batch(s)
+        out["serve"] = s.batch_service.metrics.snapshot()
+        out["flight"] = s.batch_service.recorder.to_dict()
+    if s.solver is not None:
+        out["solver"] = {
+            "setup_s": getattr(s.solver, "setup_time", 0.0),
+            "restore_s": getattr(s.solver, "restore_time", 0.0),
+            "compile_s": getattr(s.solver, "compile_time", 0.0),
+            "solve_s": getattr(s.solver, "solve_time", 0.0),
+        }
+    out["registry"] = telemetry.get_registry().snapshot()
+    return out
+
+
+def solver_telemetry_json(slv_h: int) -> str:
+    """:func:`solver_get_telemetry` as a JSON string — the form the
+    native shim hands back as a ``char*`` (AMGX_solver_telemetry_json)
+    so C hosts can scrape a worker without a Python round-trip."""
+    import json
+
+    return json.dumps(solver_get_telemetry(slv_h), default=str)
+
+
 @_traced
 def solver_resetup(slv_h: int, mtx_h: int):
     """Refresh the solver for a matrix whose VALUES changed but whose

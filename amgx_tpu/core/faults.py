@@ -28,6 +28,12 @@ testable without hunting for a naturally-broken matrix:
   drain_timeout         gateway drain()'s settle-wait budget collapses
                         to zero, so unsettled tickets fail typed
                         (serve/gateway.SolveGateway.drain)
+  telemetry_export      telemetry export/record paths raise (flight
+                        recorder record/incident, registry snapshot
+                        collection and JSON dump) — proving telemetry
+                        failures degrade to a counted
+                        ``telemetry_errors`` and never fail a solve
+                        (telemetry/recorder.py, telemetry/registry.py)
   ====================  ===================================================
 
 Injection is **budgeted and consumed at trace/setup time**: arming a
@@ -62,6 +68,7 @@ SITES = (
     "gateway_shed",
     "admission_quota",
     "drain_timeout",
+    "telemetry_export",
 )
 
 _lock = threading.Lock()

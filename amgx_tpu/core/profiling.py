@@ -84,17 +84,43 @@ def active_setup_profile() -> dict | None:
 def setup_phase(name: str):
     """Accumulate wall-clock for one setup phase (strength, cf_split,
     aggregation, interp, rap_plan, rap_execute, transfer, finalize)
-    into the active profile.  No-op outside a scope, so module-level
-    helpers can be instrumented unconditionally."""
+    into the active profile.  No-op outside a scope (and with tracing
+    off), so module-level helpers can be instrumented
+    unconditionally.  When request tracing is on
+    (``AMGX_TPU_TRACE_SAMPLE``), every phase also records a
+    ``setup:<name>`` span into the telemetry span buffer, so setup
+    phases land on the SAME Perfetto timeline as serve spans — one
+    profiling system, not two."""
     prof = active_setup_profile()
-    if prof is None:
+    tracer = _span_recorder()
+    if prof is None and tracer is None:
         yield
         return
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        prof[name] = prof.get(name, 0.0) + time.perf_counter() - t0
+        t1 = time.perf_counter()
+        if prof is not None:
+            prof[name] = prof.get(name, 0.0) + t1 - t0
+        if tracer is not None:
+            tracer(f"setup:{name}", t0, t1)
+
+
+def _span_recorder():
+    """Telemetry span hook: a ``record(name, t0, t1)`` callable when
+    request tracing is sampled on, else None.  Lazy import — the
+    telemetry package depends on nothing here, so the one-way edge
+    stays acyclic."""
+    from amgx_tpu.telemetry import tracing as _tracing
+
+    if not _tracing.tracing_enabled():
+        return None
+
+    def rec(name, t0, t1):
+        _tracing.record_span(name, t0, t1, _tracing.ambient())
+
+    return rec
 
 
 def count_setup_sync(n: int = 1):
@@ -157,10 +183,40 @@ def setup_profile_dump_enabled() -> bool:
     return os.environ.get("AMGX_TPU_SETUP_PROFILE") == "1"
 
 
+class _TracedRange:
+    """TraceAnnotation plus a telemetry span: the jax profiler sees
+    the range as before, and the telemetry span buffer gets the same
+    interval attributed to the thread's ambient trace context."""
+
+    __slots__ = ("_name", "_ann", "_rec", "_t0")
+
+    def __init__(self, name, ann, rec):
+        self._name = name
+        self._ann = ann
+        self._rec = rec
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+        self._rec(self._name, self._t0, time.perf_counter())
+        return False
+
+
 def trace_range(name: str):
     """Host-side trace span around an API call (NVTX-range analogue;
-    reference amgx_c.cu:2747 nvtxRange per AMGX_* entry)."""
-    return jax.profiler.TraceAnnotation(name)
+    reference amgx_c.cu:2747 nvtxRange per AMGX_* entry).  With
+    request tracing sampled on, the same interval also lands in the
+    telemetry span buffer (one timeline for API ranges, setup phases,
+    and serve spans)."""
+    ann = jax.profiler.TraceAnnotation(name)
+    rec = _span_recorder()
+    if rec is None:
+        return ann
+    return _TracedRange(name, ann, rec)
 
 
 def named_scope(name: str):
@@ -238,11 +294,27 @@ class LatencyReservoir:
 
 
 class LevelProfile:
-    """Accumulating tic/toc phase map (reference amgx_timer.h:46-60)."""
+    """Accumulating tic/toc phase map (reference amgx_timer.h:46-60).
+
+    Thread-safe: serve mutates one shared instance from submit
+    threads, the flusher, and the dispatch worker concurrently, and a
+    telemetry snapshot may iterate it at any moment — a bare
+    defaultdict there is exactly the "dictionary changed size during
+    iteration" torn-read window the PR 7 audit closed.  Mutate via
+    :meth:`phase`/:meth:`add`; read via :meth:`snapshot` (the
+    ``times``/``counts`` attributes remain for single-threaded
+    callers, e.g. :func:`profile_cycle`)."""
 
     def __init__(self):
         self.times = defaultdict(float)
         self.counts = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def add(self, name: str, seconds: float, count: int = 1):
+        """Locked accumulate — the API for cross-thread writers."""
+        with self._lock:
+            self.times[name] += float(seconds)
+            self.counts[name] += count
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -250,14 +322,21 @@ class LevelProfile:
         try:
             yield
         finally:
-            self.times[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+            self.add(name, time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        """Consistent point-in-time copy: {"times": ..., "counts":
+        ...} as plain dicts (safe to iterate/serialize)."""
+        with self._lock:
+            return {"times": dict(self.times), "counts": dict(self.counts)}
 
     def table(self) -> str:
+        snap = self.snapshot()
+        times, counts = snap["times"], snap["counts"]
         lines = ["    phase                          calls      total_s"]
-        for k in sorted(self.times):
+        for k in sorted(times):
             lines.append(
-                f"    {k:<30s} {self.counts[k]:>5d} {self.times[k]:>12.6f}"
+                f"    {k:<30s} {counts[k]:>5d} {times[k]:>12.6f}"
             )
         return "\n".join(lines)
 

@@ -272,3 +272,17 @@ class AdmissionController:
         """Return ``n`` in-flight units (the paired ticket settled)."""
         with self._lock:
             self.inflight = max(self.inflight - n, 0)
+
+    def snapshot(self) -> dict:
+        """Telemetry view: budget occupancy and per-tenant remaining
+        tokens (the quota gauge the exposition page exports as
+        ``amgx_admission_tenant_tokens``)."""
+        with self._lock:
+            return {
+                "inflight": self.inflight,
+                "max_inflight": self.max_inflight,
+                "batch_budget": self.batch_budget,
+                "tenant_tokens": {
+                    t: b.tokens for t, b in self._buckets.items()
+                },
+            }

@@ -67,8 +67,15 @@ from amgx_tpu.core.errors import (
 )
 from amgx_tpu.serve.admission import AdmissionController, TenantQuota
 from amgx_tpu.serve.service import BatchedSolveService, _host_csr
+from amgx_tpu.telemetry import get_registry, tracing
 
 LANES = ("interactive", "batch")
+
+# bound on distinct tenants tracked per gateway: an adversarial (or
+# buggy) client minting tenant ids must not grow the telemetry dict
+# unboundedly — overflow traffic aggregates under one bucket
+_TENANT_CAP = 256
+_TENANT_OVERFLOW = "_other"
 
 
 class GatewayTicket:
@@ -231,6 +238,59 @@ class SolveGateway:
         # callers (shutdown hook + health manager) wait for the ONE
         # running drain instead of racing a second settle loop
         self._drained = threading.Event()
+        # per-tenant admitted/shed/completed counters (telemetry):
+        # bounded cardinality, own lock (tiny critical sections, never
+        # nested with the state or service locks)
+        self._tenant_lock = threading.Lock()
+        self._tenants: dict = {}
+        # the service's flight recorder is the gateway's too: sheds
+        # and drains land in the same incident log as quarantines
+        self.recorder = self.service.recorder
+        self.telemetry_name = get_registry().register("gateway", self)
+
+    # ------------------------------------------------------------------
+    # telemetry
+
+    def _tenant_inc(self, tenant: str, key: str):
+        with self._tenant_lock:
+            st = self._tenants.get(tenant)
+            if st is None:
+                if len(self._tenants) >= _TENANT_CAP:
+                    tenant = _TENANT_OVERFLOW
+                st = self._tenants.setdefault(
+                    tenant, {"admitted": 0, "sheds": 0, "completed": 0}
+                )
+            st[key] += 1
+
+    def telemetry_snapshot(self) -> dict:
+        """Registry source (kind="gateway"): admission/tenant view
+        plus the flight-recorder summary.  The shared serve counter
+        set is exported by the service's own registration — this
+        source covers what only the gateway knows."""
+        with self._tenant_lock:
+            tenants = {t: dict(st) for t, st in self._tenants.items()}
+        adm = self.admission.snapshot()
+        for t, tokens in adm.pop("tenant_tokens", {}).items():
+            if t in tenants:
+                tenants[t]["tokens"] = tokens
+        return {
+            "state": self._state,
+            "tenants": tenants,
+            "recorder": self.recorder.summary(),
+            **adm,
+        }
+
+    def debug_report(self) -> dict:
+        """The whole observability surface in one call (operator
+        debugging: "what is this worker doing and what has gone wrong
+        lately"): health view, full metrics snapshot, flight-recorder
+        records and incident log, and the trace-buffer stats."""
+        return {
+            "health": self.health(),
+            "metrics": self.metrics.snapshot(),
+            "flight": self.recorder.to_dict(),
+            "tracing": tracing.telemetry_snapshot(),
+        }
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -258,17 +318,38 @@ class SolveGateway:
     # ------------------------------------------------------------------
     # submission
 
-    def _shed(self, err: AdmissionRejected):
-        """Count one typed shed by reason and raise it."""
+    def _shed(self, err: AdmissionRejected, tenant: str = None,
+              ctx=None, t0: float = None):
+        """Count one typed shed by reason (and tenant), log the
+        incident, and raise it."""
         self.metrics.inc("gateway_sheds")
         self.metrics.inc(f"shed_{err.reason}")
+        if tenant is not None:
+            self._tenant_inc(tenant, "sheds")
+        # every typed shed is a flight-recorder incident (throttled
+        # snapshot capture inside: an overload's shed storm must not
+        # turn the observer into load)
+        self.service._flight_incident(
+            "shed", detail=f"{err.reason} (tenant {tenant!r})"
+        )
+        if ctx is not None:
+            # close the sampled trace's root: without this the shed
+            # path's child spans parent onto a root id that never
+            # appears in the export (dangling parent_id in Perfetto)
+            tracing.record_span(
+                "submit", t0, time.perf_counter(), ctx,
+                args={"tenant": tenant, "shed": err.reason}, root=True,
+            )
         raise err
 
     def predicted_p99_s(self) -> Optional[float]:
         """The shed predictor's tail estimate: p99 of end-to-end
         ticket latency, None while the reservoir is empty (which
-        ADMITS — a cold service must take traffic to learn)."""
-        return self.metrics.latency["total"].percentile(99.0)
+        ADMITS — a cold service must take traffic to learn).  Read
+        through the LOCKED accessor: the bare reservoir's copy+sort
+        races concurrent submit threads writing the ring (the PR 7
+        torn-read audit)."""
+        return self.metrics.latency_percentile("total", 99.0)
 
     def _door_probe(self, fp: str) -> bool:
         """Half-open probing through a shedding door: every Nth
@@ -319,6 +400,10 @@ class SolveGateway:
 
         if lane not in LANES:
             raise ValueError(f"unknown lane {lane!r}; lanes: {LANES}")
+        # request tracing: the gateway is the front door, so the trace
+        # root is minted here (one float compare when tracing is off)
+        ctx = tracing.new_trace()
+        t_gw = time.perf_counter()
         if self._state != "serving":
             self._shed(Overloaded(
                 f"gateway is {self._state}: not admitting",
@@ -326,13 +411,13 @@ class SolveGateway:
                 # timeout's worth of backoff, capped like every hint
                 retry_after_s=min(1.0, self.admission.retry_after_cap_s),
                 reason="draining",
-            ))
+            ), tenant, ctx=ctx, t0=t_gw)
         if faults.should_fire("gateway_shed"):
             self._shed(Overloaded(
                 "injected shed (fault site gateway_shed)",
                 retry_after_s=0.05,
                 reason="overloaded",
-            ))
+            ), tenant, ctx=ctx, t0=t_gw)
         svc = self.service
         host = None
         probe_fp = None
@@ -359,8 +444,9 @@ class SolveGateway:
                             self.admission.retry_after_cap_s,
                         ),
                         reason="breaker_open",
-                    ))
+                    ), tenant, ctx=ctx, t0=t_gw)
         try:
+            t_adm = time.perf_counter()
             try:
                 self.admission.admit(
                     tenant=tenant,
@@ -373,15 +459,34 @@ class SolveGateway:
                     predicted_s=self.predicted_p99_s,
                 )
             except AdmissionRejected as e:
-                self._shed(e)  # count by reason, then re-raise
+                if ctx is not None:
+                    tracing.record_span(
+                        "admission", t_adm, time.perf_counter(), ctx,
+                        args={"shed": e.reason},
+                    )
+                # count by reason, close the trace root, re-raise
+                self._shed(e, tenant, ctx=ctx, t0=t_gw)
+            if ctx is not None:
+                tracing.record_span(
+                    "admission", t_adm, time.perf_counter(), ctx
+                )
             try:
                 t = svc.submit(A, b, x0, deadline_s=deadline_s,
-                               lane=lane, _host=host)
+                               lane=lane, tenant=tenant, _host=host,
+                               _trace=ctx)
             except BaseException:
                 # not admitted after all (validation reject, dead-on-
                 # arrival deadline, malformed input): hand the budget
                 # back
                 self.admission.release()
+                if ctx is not None:
+                    # close the sampled root so the already-recorded
+                    # admission/serve_submit children don't dangle
+                    tracing.record_span(
+                        "submit", t_gw, time.perf_counter(), ctx,
+                        args={"tenant": tenant, "rejected": True},
+                        root=True,
+                    )
                 raise
         except BaseException:
             # the door-admitted probe never became a ticket (shed by
@@ -405,6 +510,13 @@ class SolveGateway:
             # lost, it is merely absent from the drain report.
             self.service.flush()
         self.metrics.inc("gateway_admitted")
+        self._tenant_inc(tenant, "admitted")
+        if ctx is not None:
+            # the trace root: gateway entry to admitted ticket
+            tracing.record_span(
+                "submit", t_gw, time.perf_counter(), ctx,
+                args={"lane": lane, "tenant": tenant}, root=True,
+            )
         return gt
 
     async def solve(self, A, b, x0=None, *, tenant: str = "default",
@@ -430,6 +542,7 @@ class SolveGateway:
             self._outstanding.discard(ticket)
         if error is None:
             self.metrics.inc("gateway_completed")
+            self._tenant_inc(ticket.tenant, "completed")
         else:
             from amgx_tpu.core.errors import AMGXTPUError
 
@@ -500,6 +613,15 @@ class SolveGateway:
             except BaseException:  # noqa: BLE001 — typed per-ticket
                 failed += 1
         exported = self.service.export_all_entries()
+        if timed_out:
+            # a drain that force-failed tickets is an operator-grade
+            # event: capture it (with a metrics snapshot) so the
+            # post-mortem can see what was still in flight
+            self.service._flight_incident(
+                "drain_timeout",
+                detail=f"{timed_out} tickets force-failed after "
+                       f"{float(timeout_s):g}s settle budget",
+            )
         report = {
             "settled": settled,
             "failed": failed,
@@ -517,9 +639,12 @@ class SolveGateway:
     def health(self) -> dict:
         """Liveness/readiness view for an external prober: serving
         state, budget occupancy, queue depth, breaker count, shed and
-        lane-latency summaries."""
+        lane-latency summaries, and the flight-recorder ``incidents``
+        summary (what has tripped lately — counts by kind; the full
+        incident log is :meth:`debug_report`)."""
         m = self.metrics
         snap = {
+            "incidents": self.recorder.summary(),
             "state": self._state,
             "inflight": self.admission.inflight,
             "max_inflight": self.admission.max_inflight,

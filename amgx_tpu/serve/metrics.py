@@ -55,7 +55,17 @@ class BucketStat:
 
 
 class ServeMetrics:
-    """Thread-safe counter registry for one BatchedSolveService."""
+    """Thread-safe counter registry for one BatchedSolveService.
+
+    Lock discipline (PR 7 audit): every counter/reservoir/bucket
+    mutation AND every read that iterates or sorts shared state goes
+    through ``self._lock``; the phase ``profile`` carries its own
+    lock (:class:`LevelProfile`).  External readers use
+    :meth:`snapshot` (consistent copies), :meth:`latency_percentile`
+    / :meth:`lane_percentile` (locked quantiles) — never the raw
+    ``latency``/``lane_latency`` objects, whose rings race their
+    writers (tests/test_telemetry.py hammers this contract under
+    8-thread submit load)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -107,6 +117,16 @@ class ServeMetrics:
             res = self.lane_latency.get(lane)
             return None if res is None else res.percentile(q)
 
+    def latency_percentile(self, stage: str, q: float):
+        """Stage-reservoir percentile under the metrics lock — the
+        ONLY safe way to read a quantile while submit threads are
+        writing (the reservoirs themselves are not thread-safe; an
+        unlocked copy+sort races the ring writer).  None when the
+        stage has no samples (or no such stage)."""
+        with self._lock:
+            res = self.latency.get(stage)
+            return None if res is None else res.percentile(q)
+
     def reset_latency(self):
         """Drop latency samples and busy-time accumulators — excludes
         warm-up (setup/compile) tickets from a steady-state window
@@ -156,6 +176,9 @@ class ServeMetrics:
                 name: res.summary()
                 for name, res in self.lane_latency.items()
             }
+        # the phase profile holds its own lock (LevelProfile.snapshot)
+        # — taking it outside ours keeps the lock order trivial
+        out["profile"] = self.profile.snapshot()
         tot = out["latency"]["total"]
         out["ticket_p50_s"] = tot["p50_s"]
         out["ticket_p99_s"] = tot["p99_s"]
@@ -172,7 +195,7 @@ class ServeMetrics:
         snap = self.snapshot()
         lines = ["    serve metrics:"]
         for k in sorted(snap):
-            if k in ("buckets", "latency", "lanes"):
+            if k in ("buckets", "latency", "lanes", "profile"):
                 continue
             lines.append(f"      {k:<28s} {snap[k]}")
         for name, summ in snap["latency"].items():

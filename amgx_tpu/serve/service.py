@@ -82,6 +82,13 @@ from amgx_tpu.serve.cache import (
 )
 from amgx_tpu.serve.metrics import ServeMetrics
 from amgx_tpu.solvers.base import SolveResult
+from amgx_tpu.telemetry import (
+    FlightRecorder,
+    SolveRecord,
+    get_registry,
+    telemetry_enabled,
+    tracing,
+)
 
 
 def _host_csr(A):
@@ -213,7 +220,11 @@ class SolveTicket:
     _t_submit: float = 0.0
     _pad_s: float = 0.0
     _lane: str = "interactive"
+    _tenant: str = "default"  # set by the gateway; "default" direct
     _deadline: Optional[float] = None  # absolute monotonic, or None
+    # telemetry trace context (tracing.TraceContext) when this ticket
+    # is sampled, else None — spans recorded at pad/dispatch/fetch
+    _trace: object = None
     # settle-path lock: concurrent result() calls on ONE ticket are a
     # designed pattern (a gateway drain's settle loop races the client
     # thread), so the deadline short-circuit's _batch/_error handoff
@@ -256,6 +267,10 @@ class SolveTicket:
                         "was fetched"
                     )
                     self._batch = None  # final: release the group ref
+                    self._service._flight_incident(
+                        "deadline_expired",
+                        detail="fetch-boundary short-circuit",
+                    )
                     raise self._error
                 self._result = self._batch.result_for(self)
             return self._result
@@ -375,9 +390,24 @@ class _BatchResult:
             m.inc("solved", len(self.tickets))
             m.inc("padded_elems", self.Bb * pat.nb)
             m.inc("real_elems", len(self.tickets) * pat.n)
+            rec_on = telemetry_enabled()
+            if rec_on:
+                # hoist everything shared or vectorizable out of the
+                # per-ticket loop: one wall clock, list-ified status /
+                # iteration arrays, and one vectorized residual max —
+                # the loop body then only CONSTRUCTS records (batched
+                # into the recorder under one lock by extend(); this
+                # is the path the ci/telemetry_check.py ≤3% overhead
+                # ceiling measures)
+                ts_now = time.time()
+                iters_l = np.asarray(host.iters).tolist()
+                status_l = np.asarray(host.status).tolist()
+                fn = np.asarray(host.final_norm)
+                fn_max = fn.reshape(fn.shape[0], -1).max(axis=1)
+                recs = []
             for t in self.tickets:
                 total = max(t_fetch - t._t_submit, 0.0)
-                m.record_ticket({
+                stages = {
                     "queue": max(
                         self.t_flush - t._t_submit - t._pad_s, 0.0
                     ),
@@ -386,8 +416,41 @@ class _BatchResult:
                     "device": device_s,
                     "fetch": fetch_s,
                     "total": total,
-                })
+                }
+                m.record_ticket(stages)
                 m.record_lane(t._lane, total)
+                ctx = t._trace
+                if ctx is not None:
+                    # the ticket's tail spans only materialize at the
+                    # group's one fetch — device is dispatch->ready,
+                    # fetch is the host copy (both shared groupwide)
+                    tracing.record_span(
+                        "queue", t._t_submit + t._pad_s, self.t_flush,
+                        ctx,
+                    )
+                    tracing.record_span(
+                        "device", self.t_dispatch, t_done, ctx
+                    )
+                    tracing.record_span("fetch", t_done, t_fetch, ctx)
+                if rec_on:
+                    i = t._row
+                    recs.append(SolveRecord(
+                        ts=ts_now,
+                        fingerprint=pat.fingerprint,
+                        config=self._service.cfg_key,
+                        lane=t._lane,
+                        tenant=t._tenant,
+                        iterations=iters_l[i],
+                        final_residual=float(fn_max[i]),
+                        status=status_l[i],
+                        stages=stages,
+                        path="batched",
+                        trace_id=(
+                            ctx.trace_id if ctx is not None else None
+                        ),
+                    ))
+            if rec_on and recs:
+                self._service._flight_record_many(recs)
             return self._host
 
     def result_for(self, ticket: SolveTicket) -> SolveResult:
@@ -519,12 +582,64 @@ class BatchedSolveService:
         self._fail_counts: dict = {}
         self._broken: set = set()
         self._bypass_counts: dict = {}
+        # solve flight recorder + registry registration (telemetry
+        # tentpole): the recorder's incident snapshots read this
+        # service's own metrics; the registry holds only a weakref,
+        # so registration never extends the service's lifetime
+        self.recorder = FlightRecorder(
+            snapshot_fn=self.metrics.snapshot
+        )
+        self.telemetry_name = get_registry().register("serve", self)
+
+    # ------------------------------------------------------------------
+    # telemetry
+
+    def telemetry_snapshot(self) -> dict:
+        """Registry source (kind="serve"): the full metrics snapshot —
+        counters, caches, latency/lane reservoirs, phase profile."""
+        return self.metrics.snapshot()
+
+    def _flight_record(self, **fields):
+        """Record one solve into the flight recorder, degrading any
+        failure (including the ``telemetry_export`` fault) to a
+        counted ``telemetry_errors`` — telemetry never fails a
+        solve."""
+        try:
+            self.recorder.record(**fields)
+        except BaseException:  # noqa: BLE001 — degrade, never raise
+            self.metrics.inc("telemetry_errors")
+
+    def _flight_record_many(self, recs):
+        """Batched flight-record append (one lock for a whole fetch
+        group); a failure counts one ``telemetry_errors`` PER lost
+        record, preserving the per-solve error accounting."""
+        try:
+            self.recorder.extend(recs)
+        except BaseException:  # noqa: BLE001 — degrade, never raise
+            self.metrics.inc("telemetry_errors", len(recs))
+
+    def _flight_incident(self, kind: str, detail: str = "",
+                         record=None):
+        """Capture one incident (quarantine / breaker trip / shed /
+        deadline expiry), same degrade contract as _flight_record."""
+        if not telemetry_enabled():
+            return
+        try:
+            self.recorder.incident(kind, detail=detail, record=record)
+        except BaseException:  # noqa: BLE001 — degrade, never raise
+            self.metrics.inc("telemetry_errors")
 
     # ------------------------------------------------------------------
     # submission
 
+    # sentinel: distinguishes "no front-end minted a trace — mint one
+    # here if sampling says so" from "the gateway already made the
+    # sampling decision (possibly None)"
+    _TRACE_UNSET = object()
+
     def submit(self, A, b, x0=None, deadline_s=None,
-               lane: str = "interactive", _host=None) -> SolveTicket:
+               lane: str = "interactive", tenant: str = "default",
+               _host=None, _trace=_TRACE_UNSET) -> SolveTicket:
         """Queue one system; returns a ticket.  ``A`` is a SparseMatrix
         or scipy sparse matrix (scalar block size).
 
@@ -544,10 +659,21 @@ class BatchedSolveService:
         batch group to interactive rank, counted by
         ``batch_promotions``)."""
         t_submit = time.perf_counter()
+        # trace context: the gateway mints and passes one (or None);
+        # direct service callers sample here.  new_trace() is a float
+        # compare when tracing is off.
+        ctx = (
+            tracing.new_trace()
+            if _trace is self._TRACE_UNSET
+            else _trace
+        )
         if deadline_s is not None and float(deadline_s) <= 0.0:
             from amgx_tpu.core.errors import DeadlineExceededError
 
             self.metrics.inc("deadline_expired")
+            self._flight_incident(
+                "deadline_expired", detail="dead on arrival at submit"
+            )
             raise DeadlineExceededError(
                 f"deadline_s={float(deadline_s):g} already expired at "
                 "submit"
@@ -603,6 +729,8 @@ class BatchedSolveService:
             )
             ticket._t_submit = t_submit
             ticket._lane = lane
+            ticket._tenant = tenant
+            ticket._trace = ctx
             if deadline_s is not None:
                 ticket._deadline = now_mono + float(deadline_s)
             req = _Request(
@@ -628,7 +756,9 @@ class BatchedSolveService:
         # flushes; the flusher waits on req.ready)
         t0 = time.perf_counter()
         try:
-            with trace_range("serve_submit"):
+            # ambient ctx: trace_range/setup_phase spans fired inside
+            # this block attribute to THIS request's trace
+            with tracing.use_context(ctx), trace_range("serve_submit"):
                 grp.slot.write_row(req.row, vals, b, x0)
         except BaseException as e:
             # malformed request (wrong length, bad dtype): fail ONLY
@@ -643,9 +773,20 @@ class BatchedSolveService:
             raise
         req.ready = True
         ticket._pad_s = time.perf_counter() - t0
-        prof = self.metrics.profile
-        prof.times["pad"] += ticket._pad_s
-        prof.counts["pad"] += 1
+        # locked accumulate: submit threads, the flusher, and the
+        # dispatch worker all write this profile concurrently
+        self.metrics.profile.add("pad", ticket._pad_s)
+        if ctx is not None:
+            tracing.record_span(
+                "pad", t0, t0 + ticket._pad_s, ctx
+            )
+            if _trace is self._TRACE_UNSET:
+                # direct service use: this call is the trace root
+                tracing.record_span(
+                    "submit", t_submit, time.perf_counter(), ctx,
+                    args={"lane": lane, "tenant": tenant},
+                    root=True,
+                )
         if new_group:
             self._maybe_warm(pattern, dtype)
         for g in flush_now:
@@ -981,8 +1122,7 @@ class BatchedSolveService:
             # (syncs, transfer_batches/arrays) that must not land in a
             # seconds-denominated phase table
             if isinstance(v, float):
-                self.metrics.profile.times[f"setup:{k}"] += v
-                self.metrics.profile.counts[f"setup:{k}"] += 1
+                self.metrics.profile.add(f"setup:{k}", v)
         entry = HierarchyEntry(
             solver=solver,
             template=template,
@@ -1083,6 +1223,10 @@ class BatchedSolveService:
                 )
                 r.ticket._done = True
                 self.metrics.inc("deadline_expired")
+                self._flight_incident(
+                    "deadline_expired",
+                    detail=f"expired while queued (lane {grp.lane})",
+                )
 
     def _breaker_failure(self, fp: str):
         """Count a group failure; trip the breaker at the threshold.
@@ -1103,6 +1247,15 @@ class BatchedSolveService:
                 self.metrics.set_gauge(
                     "breakers_open", len(self._broken)
                 )
+                tripped = True
+            else:
+                tripped = False
+        if tripped:
+            # outside the service lock: incident capture snapshots the
+            # metrics (which take their own lock)
+            self._flight_incident(
+                "breaker_trip", detail=f"fingerprint {fp[:16]}..."
+            )
 
     def _breaker_success(self, fp: str):
         """A batched group completed: reset the failure count and — if
@@ -1205,6 +1358,13 @@ class BatchedSolveService:
         self.metrics.inc("failed_groups")
         self._breaker_failure(fp)
         self.metrics.inc("quarantines")
+        self._flight_incident(
+            "quarantine",
+            detail=(
+                f"group of {len(grp.requests)} (lane {grp.lane}) "
+                f"fingerprint {fp[:16]}..."
+            ),
+        )
         self._execute_quarantined(grp)
 
     def _dispatch_batched(self, entry, fn, grp, live, t_flush):
@@ -1258,6 +1418,32 @@ class BatchedSolveService:
                 (t_dispatch - t_flush)
                 + sum(r.ticket._pad_s for r in live),
             )
+            if tracing.tracing_enabled():
+                sampled = [
+                    r.ticket._trace for r in live
+                    if r.ticket._trace is not None
+                ]
+                for c in sampled:
+                    tracing.record_span(
+                        "dispatch", t_flush, t_dispatch, c
+                    )
+                # group-formation span: one per batched group with at
+                # least one SAMPLED member (at fractional rates a
+                # memberless span per group would flood the ring and
+                # evict the sampled chains), linking the member
+                # tickets' trace ids so a Perfetto view shows exactly
+                # which requests shared this batch
+                if sampled:
+                    tracing.record_span(
+                        "flush_group", t_flush, t_dispatch, None,
+                        args={
+                            "members": [c.trace_id for c in sampled],
+                            "batch": Bb,
+                            "real": nreq,
+                            "lane": grp.lane,
+                            "fingerprint": fp[:16],
+                        },
+                    )
             br = _BatchResult(
                 self, res, pat, [r.ticket for r in live], Bb,
                 t_flush, t_dispatch,
@@ -1347,6 +1533,25 @@ class BatchedSolveService:
                     r.ticket._done = True
                     self.metrics.inc("quarantined_solves")
                     self.metrics.inc("solved")
+                    if telemetry_enabled():
+                        t = r.ticket
+                        self._flight_record(
+                            fingerprint=pat.fingerprint,
+                            config=self.cfg_key,
+                            lane=t._lane,
+                            tenant=t._tenant,
+                            iterations=int(res.iters),
+                            final_residual=float(
+                                np.max(np.asarray(res.final_norm))
+                            ),
+                            status=int(res.status),
+                            stages={},
+                            path="quarantine",
+                            trace_id=(
+                                t._trace.trace_id
+                                if t._trace is not None else None
+                            ),
+                        )
         finally:
             self._release_group_slot(grp)
 
@@ -1378,4 +1583,25 @@ class BatchedSolveService:
             r.ticket._done = True
             self.metrics.inc("fallback_solves")
             self.metrics.inc("solved")
+            if telemetry_enabled():
+                # block=False above: reading iters/status here would
+                # force a per-request device sync and serialize the
+                # fallback loop — record the solve without the
+                # device-resident scalars (-1 = not synced)
+                t = r.ticket
+                self._flight_record(
+                    fingerprint=pat.fingerprint,
+                    config=self.cfg_key,
+                    lane=t._lane,
+                    tenant=t._tenant,
+                    iterations=-1,
+                    final_residual=float("nan"),
+                    status=-1,
+                    stages={},
+                    path="fallback",
+                    trace_id=(
+                        t._trace.trace_id
+                        if t._trace is not None else None
+                    ),
+                )
         self._release_group_slot(grp)

@@ -691,7 +691,60 @@ class Solver:
                     f"    Mem Usage: {mem[0] / 2**30:10.4f} GB in use, "
                     f"peak {mem[1] / 2**30:10.4f} GB"
                 )
+            # re-emit the same timing lines through the telemetry
+            # registry (amgx_solver_* / amgx_setup_phase_* metrics) and
+            # drop a flight record for the direct-API solve — this
+            # branch already synchronized, so reading iters/status
+            # costs nothing extra.  Telemetry must never fail a solve.
+            self._telemetry_observe(res, setup_prof)
         return res
+
+    def _telemetry_observe(self, res: SolveResult, setup_prof: dict):
+        """Fold one timed solve into the process telemetry registry
+        (obtain_timings re-emission) and the default flight-record
+        path (``path="direct"``).  Best-effort: any failure —
+        including the ``telemetry_export`` injected fault — is
+        swallowed; the solve result is already computed."""
+        try:
+            from amgx_tpu import telemetry
+
+            if not telemetry.telemetry_enabled():
+                return
+            reg = telemetry.get_registry()
+            reg.record_solver(
+                self.registry_name,
+                setup_s=self.setup_time,
+                compile_s=self.last_compile_s,
+                solve_s=self.solve_time,
+                iterations=int(res.iters),
+                setup_phases={
+                    k: v for k, v in (setup_prof or {}).items()
+                    if isinstance(v, float)
+                },
+            )
+            from amgx_tpu.telemetry.registry import default_recorder
+
+            default_recorder().record(
+                fingerprint=(
+                    self.A.fingerprint() if self.A is not None else ""
+                ),
+                config=self.cfg.content_hash(),
+                lane="direct",
+                tenant="-",
+                iterations=int(res.iters),
+                final_residual=float(np.max(np.asarray(res.final_norm))),
+                status=int(res.status),
+                stages={
+                    "setup": self.setup_time,
+                    "compile": self.last_compile_s,
+                    "solve": self.solve_time,
+                },
+                path="direct",
+            )
+        except Exception:
+            # observability is free to fail; the solve is not —
+            # but KeyboardInterrupt/SystemExit must still propagate
+            pass
 
     def _compile_solve(self, key, b, x0, donate):
         """AOT-compile the jitted solve for this signature, timing the

@@ -54,6 +54,21 @@ class ArtifactStore:
         self._lock = threading.Lock()
         self.counters: dict = defaultdict(int)
         self._sweep_tmp()
+        # telemetry: store hit/miss/eviction/corruption counters join
+        # the process registry (weakref — registration never extends
+        # this store's lifetime)
+        from amgx_tpu.telemetry import get_registry
+
+        self.telemetry_name = get_registry().register("store", self)
+
+    def telemetry_snapshot(self) -> dict:
+        """Registry source (kind="store"): counters plus on-disk
+        entry count and the configured byte budget."""
+        return {
+            "counters": self.stats(),
+            "entries": len(self),
+            "max_bytes": self.max_bytes,
+        }
 
     # tmp files older than this are crash leftovers, not live writers
     _TMP_MAX_AGE_S = 300.0

@@ -401,6 +401,42 @@ def _isolate_kernel_probes(timeout_s=300):
             )
 
 
+def _telemetry_record():
+    """Telemetry overhead A/B (armed sample=0 vs disarmed, one warmed
+    service; ci/telemetry_check.py, reduced reps) plus exposition /
+    trace-chain counts.  Guarded — must never take the headline bench
+    down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.telemetry_check import run as telemetry_run
+
+        rec, problems = telemetry_run(reps=2, waves=4)
+        out = {
+            k: rec[k]
+            for k in (
+                "value",
+                "unit",
+                "overhead_frac",
+                "solves_per_s_on",
+                "solves_per_s_off",
+                "metric_names",
+                "trace_events",
+                "connected_chains",
+                "ok",
+            )
+            if k in rec
+        }
+        if problems:
+            out["problems"] = problems
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: telemetry record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def main():
     import os
     import subprocess
@@ -512,6 +548,10 @@ def main():
     setup_rec = _setup_record()
     print(f"bench: setup {setup_rec}", file=sys.stderr)
 
+    # ---- unified telemetry (overhead A/B) --------------------------
+    telemetry_rec = _telemetry_record()
+    print(f"bench: telemetry {telemetry_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -533,6 +573,7 @@ def main():
                 "fleet": fleet_rec,
                 "store": store_rec,
                 "setup": setup_rec,
+                "telemetry": telemetry_rec,
             }
         )
     )

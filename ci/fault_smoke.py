@@ -311,6 +311,46 @@ def site_drain_timeout():
     return ok, f"outcomes={outcomes} report={report} post={post}"
 
 
+def site_telemetry_export():
+    """Telemetry failures (flight-record append, registry snapshot
+    collection, JSON dump) degrade to a counted ``telemetry_errors``:
+    the solves still SUCCEED, the Prometheus page still renders (with
+    the error counter on it), and dump() returns False instead of
+    raising."""
+    import tempfile
+
+    from amgx_tpu import telemetry
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(7)
+    svc = BatchedSolveService(max_batch=2)
+    with tempfile.TemporaryDirectory() as td:
+        with faults.inject("telemetry_export", times=-1):
+            t1 = svc.submit(sp, rng.standard_normal(n))
+            t2 = svc.submit(sp, rng.standard_normal(n))
+            svc.flush()
+            r1, r2 = t1.result(), t2.result()
+            prom = telemetry.get_registry().render_prometheus()
+            dumped = telemetry.get_registry().dump(
+                path=f"{td}/dump.json"
+            )
+    errs = svc.metrics.get("telemetry_errors")
+    ok = (
+        int(r1.status) == SUCCESS
+        and int(r2.status) == SUCCESS
+        and errs >= 2  # one failed flight record per ticket
+        and isinstance(prom, str)
+        and "amgx_telemetry_errors_total" in prom
+        and dumped is False
+    )
+    return ok, (
+        f"status=({int(r1.status)},{int(r2.status)}) "
+        f"telemetry_errors={errs} dump={dumped}"
+    )
+
+
 def baseline_determinism():
     """All sites disarmed: two fresh solves are bit-identical."""
     faults.disarm()
@@ -331,6 +371,7 @@ MATRIX = [
     ("gateway_shed", site_gateway_shed),
     ("admission_quota", site_admission_quota),
     ("drain_timeout", site_drain_timeout),
+    ("telemetry_export", site_telemetry_export),
     ("baseline_determinism", baseline_determinism),
 ]
 

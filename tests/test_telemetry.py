@@ -1,0 +1,557 @@
+"""Unified-telemetry tests: Prometheus exposition grammar + catalog
+coverage, Chrome trace-event export with a connected request span
+chain, flight-recorder ring bounds + incident capture, sampling
+semantics, fault degradation, and registry/metrics stability under
+multi-threaded submit load (the PR 7 torn-read audit contract)."""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import amgx_tpu
+from amgx_tpu import telemetry
+from amgx_tpu.core import faults
+from amgx_tpu.io.poisson import poisson_scipy
+from amgx_tpu.serve import BatchedSolveService, SolveGateway
+from amgx_tpu.serve.metrics import ServeMetrics
+from amgx_tpu.telemetry import FlightRecorder, tracing
+from amgx_tpu.telemetry.promtext import sanitize_name
+
+amgx_tpu.initialize()
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def sysmat():
+    sp = poisson_scipy((8, 8)).tocsr()
+    sp.sort_indices()
+    return sp
+
+
+@pytest.fixture()
+def traced():
+    """Sample every request; clear the span ring before and after."""
+    tracing.set_sample_rate(1.0)
+    tracing.clear()
+    try:
+        yield
+    finally:
+        tracing.set_sample_rate(None)
+        tracing.clear()
+
+
+def _run_group(sp, n_req=4, gateway=False, **kw):
+    rng = np.random.default_rng(3)
+    n = sp.shape[0]
+    front = (
+        SolveGateway(max_batch=max(n_req, 2), **kw)
+        if gateway
+        else BatchedSolveService(max_batch=max(n_req, 2), **kw)
+    )
+    tickets = [
+        front.submit(sp, rng.standard_normal(n)) for _ in range(n_req)
+    ]
+    front.flush()
+    results = [t.result() for t in tickets]
+    return front, results
+
+
+# ----------------------------------------------------------------------
+# Prometheus exposition
+
+
+# one sample line: name{labels} value  |  name value
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{[a-zA-Z0-9_]+=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z0-9_]+=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" (-?[0-9.e+-]+|NaN)$"
+)
+
+
+def test_prometheus_grammar_and_catalog(sysmat, tmp_path):
+    import os
+
+    svc, results = _run_group(sysmat, gateway=True,
+                              store=str(tmp_path / "store"))
+    assert all(int(r.status) == 0 for r in results)
+    svc.service.flush_store()
+    text = telemetry.get_registry().render_prometheus()
+    names = set()
+    helped = set()
+    typed = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[3] in ("counter", "gauge", "summary")
+            typed.add(parts[2])
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        names.add(m.group(1))
+    # every sample belongs to a declared family
+    base = {n[:-6] if n.endswith("_count") else n for n in names}
+    base = {n[:-4] if n.endswith("_max") else n for n in base}
+    assert base <= typed and base <= helped
+    # acceptance: >= 25 distinct metric names spanning serve,
+    # admission/gateway, store, cache, and setup-phase sources
+    assert len(names) >= 25, sorted(names)
+    for prefix in ("amgx_serve_", "amgx_gateway_", "amgx_store_",
+                   "amgx_cache_"):
+        assert any(n.startswith(prefix) for n in names), (
+            prefix, sorted(names))
+    del os
+
+
+def test_prometheus_setup_phase_source(sysmat):
+    """An AMG-preconditioned service exposes the PR 5 setup-phase
+    anatomy as amgx_setup_phase_seconds_total{phase=...}."""
+    amg_cfg = (
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "max_iters": 100, "tolerance": 1e-8,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+        ' "algorithm": "AGGREGATION", "selector": "SIZE_2",'
+        ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+        ' "monitor_residual": 0}, "min_coarse_rows": 8,'
+        ' "max_iters": 1, "monitor_residual": 0}}}'
+    )
+    svc, results = _run_group(sysmat, config=amg_cfg)
+    assert all(int(r.status) == 0 for r in results)
+    text = telemetry.get_registry().render_prometheus()
+    lines = [
+        l for l in text.splitlines()
+        if l.startswith("amgx_setup_phase_seconds_total{")
+    ]
+    assert lines, "no setup-phase metrics exported"
+    phases = {
+        m.group(1)
+        for m in (re.search(r'phase="([^"]+)"', l) for l in lines)
+        if m
+    }
+    assert phases & {"strength", "aggregation", "transfer", "finalize",
+                     "host_csr", "rap_plan", "rap_execute", "interp",
+                     "cf_split", "device_s", "host_s"}
+
+
+def test_label_escaping():
+    from amgx_tpu.telemetry.promtext import escape_label_value
+
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert sanitize_name("setup:host csr") == "setup:host_csr"
+
+
+# ----------------------------------------------------------------------
+# tracing
+
+
+def test_trace_chain_and_chrome_format(sysmat, traced, tmp_path):
+    gw, results = _run_group(sysmat, gateway=True)
+    assert all(int(r.status) == 0 for r in results)
+    out = tmp_path / "trace.json"
+    trace = tracing.export_chrome(str(out))
+    loaded = json.loads(out.read_text())
+    assert loaded["traceEvents"] == trace["traceEvents"]
+    events = trace["traceEvents"]
+    assert events
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["name"], str)
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+    # acceptance: a sampled gateway request has a CONNECTED
+    # submit -> admission -> pad -> dispatch -> device -> fetch chain
+    roots = [e for e in events if e["name"] == "submit"]
+    assert roots
+    tid = roots[0]["args"]["trace_id"]
+    chain = {
+        e["name"] for e in events
+        if e["args"].get("trace_id") == tid
+    }
+    assert {"submit", "admission", "pad", "dispatch", "device",
+            "fetch"} <= chain, chain
+    # connected: children carry parent ids that resolve to spans of
+    # the same trace
+    ids = {
+        e["args"]["span_id"] for e in events
+        if e["args"].get("trace_id") == tid
+    }
+    for e in events:
+        if e["args"].get("trace_id") == tid and "parent_id" in e["args"]:
+            assert e["args"]["parent_id"] in ids
+
+
+def test_group_span_links_member_traces(sysmat, traced):
+    gw, _ = _run_group(sysmat, gateway=True, n_req=3)
+    spans = tracing.span_buffer().spans()
+    groups = [s for s in spans if s["name"] == "flush_group"]
+    assert groups
+    members = groups[0]["args"]["members"]
+    assert len(members) == 3
+    submit_tids = {
+        s["trace_id"] for s in spans if s["name"] == "submit"
+    }
+    assert set(members) <= submit_tids
+
+
+def test_sampling_zero_exports_nothing(sysmat):
+    tracing.set_sample_rate(0.0)
+    tracing.clear()
+    try:
+        _run_group(sysmat, gateway=True)
+        assert len(tracing.span_buffer()) == 0
+        assert tracing.export_chrome()["traceEvents"] == []
+    finally:
+        tracing.set_sample_rate(None)
+
+
+def test_fractional_sampling_is_deterministic():
+    tracing.set_sample_rate(0.25)
+    try:
+        minted = [tracing.new_trace() for _ in range(40)]
+        sampled = [c for c in minted if c is not None]
+        assert 8 <= len(sampled) <= 12  # every 4th, phase-dependent
+    finally:
+        tracing.set_sample_rate(None)
+        tracing.clear()
+
+
+def test_setup_phases_share_the_timeline(sysmat, traced):
+    """trace_range + setup_phase feed the span buffer: an AMG cold
+    setup's phases land in the SAME ring as the serve spans."""
+    amg_cfg = (
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "PCG", "max_iters": 100, "tolerance": 1e-8,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+        ' "algorithm": "AGGREGATION", "selector": "SIZE_2",'
+        ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+        ' "monitor_residual": 0}, "min_coarse_rows": 8,'
+        ' "max_iters": 1, "monitor_residual": 0}}}'
+    )
+    svc, results = _run_group(sysmat, config=amg_cfg)
+    assert all(int(r.status) == 0 for r in results)
+    names = {s["name"] for s in tracing.span_buffer().spans()}
+    assert any(n.startswith("setup:") for n in names), names
+    assert "pad" in names  # serve spans in the same buffer
+    assert "serve_submit" in names  # trace_range integration
+
+
+def test_span_ring_bounded():
+    buf = tracing.SpanBuffer(cap=8)
+    for i in range(20):
+        buf.add({"name": f"s{i}", "sid": i, "t0": 0.0, "t1": 1.0,
+                 "tid": 0, "trace_id": None})
+    assert len(buf) == 8
+    assert buf.total == 20
+    names = [s["name"] for s in buf.spans()]
+    assert names == [f"s{i}" for i in range(12, 20)]
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+
+
+def test_flight_record_fields(sysmat):
+    svc, results = _run_group(sysmat, n_req=3)
+    recs = svc.recorder.records()
+    assert len(recs) == 3
+    for r in recs:
+        assert r.fingerprint and r.config == svc.cfg_key
+        assert r.lane == "interactive" and r.tenant == "default"
+        assert r.status == 0 and r.iterations > 0
+        assert r.path == "batched"
+        assert np.isfinite(r.final_residual)
+        assert set(r.stages) == {"queue", "pad", "dispatch", "device",
+                                 "fetch", "total"}
+    d = recs[0].to_dict()
+    json.dumps(d)  # JSON-safe
+
+
+def test_flight_ring_bounds():
+    rec = FlightRecorder(cap=4, incident_cap=2)
+    for i in range(10):
+        rec.record(fingerprint=f"f{i}", config="c", lane="l",
+                   tenant="t", iterations=i, final_residual=0.0,
+                   status=0, stages={})
+    assert rec.records_total == 10
+    rs = rec.records()
+    assert len(rs) == 4
+    assert [r.iterations for r in rs] == [6, 7, 8, 9]
+    for i in range(5):
+        rec.incident(f"k{i % 2}", detail=str(i))
+    assert rec.incidents_total == 5
+    incs = rec.incidents()
+    assert len(incs) == 2
+    assert [i["detail"] for i in incs] == ["3", "4"]
+
+
+def test_incident_on_forced_quarantine(sysmat):
+    """A serve_compile fault forces a quarantine: the incident log
+    captures it (kind + registry snapshot) and health() reports it."""
+    rng = np.random.default_rng(5)
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=2)
+    with faults.inject("serve_compile", times=1):
+        t1 = gw.submit(sysmat, rng.standard_normal(n))
+        t2 = gw.submit(sysmat, rng.standard_normal(n))
+        gw.flush()
+        t1.result(), t2.result()
+    incs = gw.recorder.incidents()
+    kinds = [i["kind"] for i in incs]
+    assert "quarantine" in kinds
+    q = incs[kinds.index("quarantine")]
+    assert q["snapshot"] is not None  # registry/metrics state attached
+    assert q["snapshot"].get("quarantines", 0) >= 0
+    h = gw.health()
+    assert h["incidents"]["incidents_by_kind"].get("quarantine") == 1
+    # quarantined solves still produce flight records
+    assert any(r.path == "quarantine" for r in gw.recorder.records())
+    rep = gw.debug_report()
+    assert rep["flight"]["summary"]["incidents_total"] >= 1
+    assert "metrics" in rep and "health" in rep
+
+
+def test_shed_incident_and_tenant_counters(sysmat):
+    from amgx_tpu.core.errors import Overloaded
+
+    gw = SolveGateway(max_batch=2)
+    with faults.inject("gateway_shed", times=1):
+        with pytest.raises(Overloaded):
+            gw.submit(sysmat, np.ones(sysmat.shape[0]), tenant="web")
+    t = gw.submit(sysmat, np.ones(sysmat.shape[0]), tenant="web")
+    gw.flush()
+    t.result()
+    kinds = [i["kind"] for i in gw.recorder.incidents()]
+    assert "shed" in kinds
+    snap = gw.telemetry_snapshot()
+    assert snap["tenants"]["web"]["sheds"] == 1
+    assert snap["tenants"]["web"]["admitted"] == 1
+    assert snap["tenants"]["web"]["completed"] == 1
+
+
+def test_telemetry_disabled_records_nothing(sysmat):
+    telemetry.set_telemetry_enabled(False)
+    try:
+        svc, results = _run_group(sysmat)
+        assert all(int(r.status) == 0 for r in results)
+        assert svc.recorder.records_total == 0
+    finally:
+        telemetry.set_telemetry_enabled(None)
+
+
+def test_telemetry_export_fault_degrades(sysmat):
+    """The telemetry_export site proves the contract: record/incident
+    failures count telemetry_errors, the solve still succeeds."""
+    with faults.inject("telemetry_export", times=-1):
+        svc, results = _run_group(sysmat, n_req=2)
+    assert all(int(r.status) == 0 for r in results)
+    assert svc.metrics.get("telemetry_errors") == 2
+    assert svc.recorder.records_total == 0
+
+
+# ----------------------------------------------------------------------
+# registry
+
+
+def test_registry_dump(tmp_path, sysmat):
+    svc, _ = _run_group(sysmat)
+    path = tmp_path / "telemetry.json"
+    assert telemetry.get_registry().dump(str(path)) is True
+    payload = json.loads(path.read_text())
+    assert "snapshot" in payload and payload["pid"]
+    kinds = {v["kind"] for v in payload["snapshot"].values()}
+    assert {"serve", "tracing", "solvers"} <= kinds
+
+
+def test_registry_drops_dead_components(sysmat):
+    reg = telemetry.get_registry()
+    svc = BatchedSolveService(max_batch=2)
+    name = svc.telemetry_name
+    assert name in reg.snapshot()
+    del svc
+    import gc
+
+    gc.collect()
+    assert name not in reg.snapshot()
+
+
+def test_registry_component_failure_degrades():
+    reg = telemetry.TelemetryRegistry()
+
+    def bad():
+        raise RuntimeError("broken source")
+
+    reg.register("serve", bad, name="bad")
+    before = reg.telemetry_errors
+    snap = reg.snapshot()
+    assert "bad" not in snap
+    assert reg.telemetry_errors == before + 1
+    text = reg.render_prometheus()
+    assert "amgx_telemetry_errors_total" in text
+
+
+def test_obtain_timings_reemission(sysmat):
+    """A direct obtain_timings solve lands in the registry's solver
+    aggregate and the default flight recorder (path='direct')."""
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.solvers import create_solver
+
+    cfg = (
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "BLOCK_JACOBI", "monitor_residual": 1,'
+        ' "tolerance": 1e-6, "convergence": "RELATIVE_INI",'
+        ' "max_iters": 500, "relaxation_factor": 0.9,'
+        ' "obtain_timings": 1}}'
+    )
+    reg = telemetry.get_registry()
+    before = reg._solver_snapshot().get("BLOCK_JACOBI", {})
+    rec = telemetry.registry.default_recorder()
+    n_before = rec.records_total
+    s = create_solver(AMGConfig.from_string(cfg), "default")
+    A = SparseMatrix.from_scipy(sysmat)
+    s.setup(A)
+    res = s.solve(np.ones(A.n_rows))
+    assert int(res.status) == 0
+    after = reg._solver_snapshot()["BLOCK_JACOBI"]
+    assert after["solves"] == before.get("solves", 0) + 1
+    assert after["iterations"] >= before.get("iterations", 0) + 1
+    assert rec.records_total == n_before + 1
+    last = rec.records()[-1]
+    assert last.path == "direct" and last.lane == "direct"
+
+
+def test_capi_telemetry_json(sysmat):
+    from amgx_tpu.api import capi
+
+    capi.initialize()
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG", "monitor_residual": 1, "tolerance": 1e-8,'
+        ' "convergence": "RELATIVE_INI", "max_iters": 100,'
+        ' "preconditioner": {"scope": "j", "solver": "BLOCK_JACOBI",'
+        ' "max_iters": 2, "monitor_residual": 0}}}'
+    )
+    res_h = capi.resources_create_simple(cfg)
+    m = capi.matrix_create(res_h)
+    capi.matrix_upload_all(
+        m, sysmat.shape[0], sysmat.nnz, 1, 1,
+        sysmat.indptr.astype(np.int32),
+        sysmat.indices.astype(np.int32), sysmat.data,
+    )
+    r = capi.vector_create(res_h)
+    capi.vector_upload(r, sysmat.shape[0], 1, np.ones(sysmat.shape[0]))
+    x = capi.vector_create(res_h)
+    capi.vector_set_zero(x, sysmat.shape[0], 1)
+    slv = capi.solver_create(res_h, "dDDI", cfg)
+    capi.solver_setup(slv, m)
+    capi.solver_solve(slv, r, x)
+    out = capi.solver_get_telemetry(slv)
+    assert out["solver"]["setup_s"] > 0
+    assert "registry" in out
+    parsed = json.loads(capi.solver_telemetry_json(slv))
+    assert parsed["solver"]["solve_s"] >= 0
+
+
+# ----------------------------------------------------------------------
+# concurrency (the PR 7 torn-read audit)
+
+
+def test_metrics_hammer_concurrent_snapshot():
+    """8 writer threads × counters/reservoirs/buckets/profile against
+    a snapshot/percentile reader loop: no RuntimeError('dictionary
+    changed size'), no lost increments."""
+    m = ServeMetrics()
+    N = 400
+    errs = []
+
+    def writer(k):
+        try:
+            for i in range(N):
+                m.inc("submitted")
+                m.record_ticket({"total": 0.001 * i, "pad": 1e-6})
+                m.record_lane("interactive" if i % 2 else f"lane{k}",
+                              0.001)
+                m.record_batch((8, 40, 4), 0.01, 3, 1)
+                m.profile.add("pad", 1e-6)
+                with m.profile.phase(f"phase{k}"):
+                    pass
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    def reader():
+        try:
+            for _ in range(200):
+                snap = m.snapshot()
+                json.dumps(snap, default=str)
+                m.latency_percentile("total", 99.0)
+                m.lane_percentile("interactive", 50.0)
+                m.table()
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(k,)) for k in range(8)
+    ] + [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert m.get("submitted") == 8 * N
+    snap = m.snapshot()
+    assert snap["latency"]["total"]["count"] == 8 * N
+    assert snap["profile"]["counts"]["pad"] == 8 * N
+
+
+def test_registry_snapshot_stable_under_submit_load(sysmat):
+    """Acceptance: registry snapshot/prometheus stay consistent while
+    8 threads hammer submit on one service."""
+    rng = np.random.default_rng(11)
+    n = sysmat.shape[0]
+    svc = BatchedSolveService(max_batch=8, max_wait_s=0.001)
+    svc.solve_many([(sysmat, rng.standard_normal(n))])  # warm
+    errs = []
+    stop = threading.Event()
+
+    def submitter():
+        try:
+            local = np.random.default_rng(threading.get_ident() % 997)
+            for _ in range(25):
+                t = svc.submit(sysmat, local.standard_normal(n))
+                svc.flush()
+                t.result()
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    def scraper():
+        reg = telemetry.get_registry()
+        try:
+            while not stop.is_set():
+                reg.snapshot()
+                text = reg.render_prometheus()
+                assert "amgx_serve_submitted_total" in text
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    subs = [threading.Thread(target=submitter) for _ in range(8)]
+    scr = threading.Thread(target=scraper)
+    scr.start()
+    for t in subs:
+        t.start()
+    for t in subs:
+        t.join()
+    stop.set()
+    scr.join()
+    assert not errs, errs
+    assert svc.metrics.get("solved") == 8 * 25 + 1
