@@ -452,23 +452,36 @@ class AMGSolver(Solver):
                 old._propagate_structure_memo(new)
             setattr(lvl, name, new)
 
+    def _refresh_smoother(self, lvl: AMGLevel):
+        """Level-smoother refresh policy: a surviving smoother (the
+        values-only resetup path keeps level objects) RESETUPS in
+        place — so smoothers with pattern-level cached setup state
+        (Chebyshev/OPT_POLYNOMIAL spectral bounds) keep their cache
+        instead of re-estimating per resetup (the PR 8 bound-caching
+        fix; ``reestimate_eigs`` forces a refresh cadence).  Fresh
+        levels build a new smoother as before."""
+        if lvl.smoother is None:
+            lvl.smoother = self._make_smoother(lvl.A)
+        else:
+            lvl.smoother.resetup(lvl.A)
+
     def _finalize_setup(self, reuse_smoothers: bool = False):
         self._upload_levels()
         # smoothers on all but the coarsest; coarse solver on the last.
         # reuse_smoothers (store-restore path ONLY): keep smoothers the
         # importer already restored — setup/resetup must NOT pass it
-        # (their level values changed, so smoother params must rebuild)
+        # (their level values changed, so smoother params must refresh)
         with setup_phase("finalize"):
             for lvl in self.levels[:-1]:
                 if not (reuse_smoothers and lvl.smoother is not None):
-                    lvl.smoother = self._make_smoother(lvl.A)
+                    self._refresh_smoother(lvl)
             coarsest = self.levels[-1]
             self.coarse_solver = self._make_coarse_solver(coarsest.A)
             if self.coarse_solver is None and len(self.levels) > 0:
                 # coarsest-level smoothing fallback
                 # (coarse_solver=NOSOLVER)
                 if not (reuse_smoothers and coarsest.smoother is not None):
-                    coarsest.smoother = self._make_smoother(coarsest.A)
+                    self._refresh_smoother(coarsest)
 
         self._params = self._collect_params()
         # reference solver.cu:541-546: grid stats and vis data print

@@ -81,6 +81,22 @@ register("gmres_n_restart", I, 20, "Krylov vectors in (F)GMRES")
 register("gmres_krylov_dim", I, 0, "max Krylov dim (0: match restart)")
 register("subspace_dim_s", I, 8, "IDR(s) shadow-space dimension")
 
+# --- s-step / communication-avoiding Krylov (solvers/sstep.py) -------------
+register("s_step", I, 4,
+         "SSTEP_PCG block size: s SpMVs and one fused Gram reduction "
+         "per outer iteration (= s PCG steps); 1 degenerates to "
+         "classic PCG")
+register("sstep_basis", S, "SCALED",
+         "s-step Krylov basis conditioning: MONOMIAL keeps the raw "
+         "M^-1 A powers, SCALED renormalizes basis columns by their "
+         "A-norm (from the Gram diagonal — no extra reduction) for "
+         "numerical stability at larger s",
+         ("MONOMIAL", "SCALED"))
+register("sstep_replace_every", I, 0,
+         "residual-replacement guard for s-step drift: every N outer "
+         "iterations the recurred residual is recomputed as b - A x "
+         "(one extra SpMV, no extra reduction); 0: off")
+
 # --- coarse / dense ---------------------------------------------------------
 register("dense_lu_num_rows", I, 128, "densify when rows <= this")
 register("dense_lu_max_rows", I, 0, "never densify above this (0: unused)")
@@ -112,6 +128,11 @@ register("chebyshev_lambda_estimate_mode", I, 0,
          "0-2: power-iteration estimate, 3: user cheby_min/max_lambda")
 register("cheby_max_lambda", F, 1.0, "user max eigenvalue guess")
 register("cheby_min_lambda", F, 0.125, "user min eigenvalue guess")
+register("reestimate_eigs", I, 0,
+         "Chebyshev/OPT_POLYNOMIAL spectral-bound refresh cadence on "
+         "values-only resetup: 0 reuses the cached bounds (pattern "
+         "unchanged, bump bound_staleness), N>0 re-runs the power "
+         "iteration every Nth resetup")
 register("kaczmarz_coloring_needed", I, 1, "")
 register("cf_smoothing_mode", I, 0, "CF smoothing flavour")
 

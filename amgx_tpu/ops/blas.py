@@ -4,11 +4,63 @@ These are trivial jnp expressions; they exist as named functions for parity
 with the reference call sites and so solvers read like the algorithms they
 implement.  All are pure and jit-safe.  Offset/size view windows from the
 reference are expressed by slicing at the call site (static shapes).
+
+Communication accounting (PR 8): every GLOBAL reduction — a dot, a
+fused multi-dot, a Gram block, a norm — funnels through this module's
+``record_reduction`` hook.  Each call site counts as ONE reduction
+regardless of how many scalars it produces, because on a sharded mesh
+one stacked reduction is one ``psum`` (the sync point the s-step and
+fused-dot paths exist to amortize).  ``reduction_counter()`` counts
+reduction SITES at trace time: enter the context, trace the iteration
+body (``jax.eval_shape``), read ``.count`` — that is the number of
+reductions the compiled loop body will execute per iteration.
 """
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import jax.numpy as jnp
+
+_TLS = threading.local()
+
+
+def record_reduction(n: int = 1) -> None:
+    """Count ``n`` global-reduction sites into the active
+    :func:`reduction_counter`, if any (trace-time; no-op and
+    near-free otherwise)."""
+    c = getattr(_TLS, "counter", None)
+    if c is not None:
+        c.count += n
+
+
+class ReductionCount:
+    """Mutable counter yielded by :func:`reduction_counter`."""
+
+    def __init__(self):
+        self.count = 0
+
+
+@contextlib.contextmanager
+def reduction_counter():
+    """Count global-reduction call sites traced while active.
+
+    Thread-local (a concurrent serve-worker trace on another thread
+    does not pollute the count).  Nesting restores the outer counter.
+    Typical use::
+
+        with blas.reduction_counter() as c:
+            jax.eval_shape(iterate, params, b, x, extra)
+        reductions_per_iteration = c.count
+    """
+    prev = getattr(_TLS, "counter", None)
+    c = ReductionCount()
+    _TLS.counter = c
+    try:
+        yield c
+    finally:
+        _TLS.counter = prev
 
 
 def axpy(y, x, alpha):
@@ -43,11 +95,60 @@ def dot(x, y):
     guardrails and retry hook must recover from."""
     from amgx_tpu.core import faults
 
+    record_reduction()
     if faults.should_fire("dot_breakdown"):
         return jnp.zeros((), jnp.result_type(x, y))
     if jnp.iscomplexobj(x):
         return jnp.vdot(x, y)
     return jnp.dot(x, y)
+
+
+def fused_dots(pairs):
+    """k dot products as ONE stacked reduction.
+
+    ``pairs`` is a sequence of ``(x_i, y_i)`` same-shape vectors;
+    returns a ``(k,)`` vector with entry i = ``dot(x_i, y_i)``
+    (complex: conjugation on ``x_i``, matching :func:`dot`).  Use when
+    two or more dots share operands or are needed at the same point of
+    an iteration: the stacked form is one reduction — on a sharded
+    mesh, one ``psum`` instead of k.
+
+    Same ``dot_breakdown`` fault surface as :func:`dot` (the fused
+    site breaks down as a unit — all k products return 0)."""
+    from amgx_tpu.core import faults
+
+    record_reduction()
+    xs = jnp.stack([p[0] for p in pairs])
+    ys = jnp.stack([p[1] for p in pairs])
+    if faults.should_fire("dot_breakdown"):
+        return jnp.zeros((xs.shape[0],), jnp.result_type(xs, ys))
+    if jnp.iscomplexobj(xs):
+        xs = jnp.conj(xs)
+    return jnp.sum(xs * ys, axis=1)
+
+
+def gram_block(X, Y):
+    """Block of inner products ``G[i, j] = <X_i, Y_j>`` in ONE fused
+    reduction.
+
+    ``X`` is ``(k, n)``, ``Y`` is ``(m, n)`` (rows are vectors);
+    returns ``(k, m)``.  Complex: conjugation on ``X`` rows, matching
+    :func:`dot`.  This is the s-step Krylov workhorse: ALL the inner
+    products of an s-step outer iteration form as one matmul —
+    one reduction (one ``psum`` on a mesh) per s steps instead of ~2s
+    scalar dots.
+
+    Same ``dot_breakdown`` fault surface as :func:`dot`."""
+    from amgx_tpu.core import faults
+
+    record_reduction()
+    if faults.should_fire("dot_breakdown"):
+        return jnp.zeros(
+            (X.shape[0], Y.shape[0]), jnp.result_type(X, Y)
+        )
+    if jnp.iscomplexobj(X):
+        X = jnp.conj(X)
+    return X @ Y.T
 
 
 def scal(x, alpha):

@@ -13,9 +13,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from amgx_tpu.core.types import NormType
+from amgx_tpu.ops.blas import record_reduction
 
 
 def norm(x, norm_type: NormType = NormType.L2):
+    record_reduction()
     a = jnp.abs(x)
     if norm_type == NormType.L1:
         return jnp.sum(a)
@@ -30,6 +32,7 @@ def norm(x, norm_type: NormType = NormType.L2):
 
 def block_norm(x, block_size: int, norm_type: NormType = NormType.L2):
     """Per-block-component norms; x flat (n*b,) -> (b,)."""
+    record_reduction()
     xb = jnp.abs(x.reshape(-1, block_size))
     if norm_type == NormType.L1:
         return jnp.sum(xb, axis=0)

@@ -38,6 +38,7 @@ from amgx_tpu.serve.batched import make_batched_solve
 from amgx_tpu.serve.cache import HierarchyCache, config_hash
 from amgx_tpu.serve.metrics import ServeMetrics
 from amgx_tpu.serve.service import (
+    COMM_AVOIDING_CONFIG,
     DEFAULT_CONFIG,
     BatchedSolveService,
     SolveTicket,
@@ -57,6 +58,7 @@ __all__ = [
     "BatchedSolveService",
     "SolveService",
     "DEFAULT_CONFIG",
+    "COMM_AVOIDING_CONFIG",
     "SolveTicket",
     "SolveGateway",
     "GatewayTicket",

@@ -162,6 +162,31 @@ DEFAULT_CONFIG = (
     ' "monitor_residual": 0}}}'
 )
 
+# the recommended serve configuration (PR 8, doc/PERFORMANCE.md
+# "Communication-free inner loops"): s-step PCG (s=4 CG steps per
+# fused Gram reduction) over an aggregation AMG V-cycle smoothed by
+# the optimal-weight fourth-kind Chebyshev polynomial — no colorings,
+# no triangular solves, no per-step scalar dots; every inner-loop
+# global reduction a future mesh shard would psum over is amortized
+# s-fold.  ci/smoother_bench.py gates its iteration parity against
+# the PCG+Jacobi baseline; ci/serve_bench.py gates its per-iteration
+# time at B=16.
+COMM_AVOIDING_CONFIG = (
+    '{"config_version": 2, "solver": {"scope": "main",'
+    ' "solver": "SSTEP_PCG", "s_step": 4, "max_iters": 200,'
+    ' "tolerance": 1e-8, "monitor_residual": 1,'
+    ' "convergence": "RELATIVE_INI",'
+    ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+    ' "algorithm": "AGGREGATION", "selector": "SIZE_8",'
+    ' "smoother": {"scope": "sm", "solver": "OPT_POLYNOMIAL",'
+    ' "chebyshev_polynomial_order": 2, "monitor_residual": 0},'
+    ' "presweeps": 1, "postsweeps": 1, "max_iters": 1,'
+    ' "min_coarse_rows": 32, "max_levels": 10,'
+    ' "structure_reuse_levels": -1,'
+    ' "coarse_solver": "DENSE_LU_SOLVER", "cycle": "V",'
+    ' "monitor_residual": 0}}}'
+)
+
 
 # process-wide single-worker device-dispatch stage: ship-and-launch of
 # batched groups serializes here (device_put + async XLA dispatch, no

@@ -3,10 +3,11 @@
 Importing this package registers all built-in solvers (the analogue of
 registerClasses at amgx::initialize, reference core.cu:552-688).
 
-Registered here: PCG, CG, PCGF, PBICGSTAB, BICGSTAB, FGMRES, GMRES,
-IDR, IDRMSYNC, BLOCK_JACOBI, JACOBI_L1, GS, MULTICOLOR_GS, FIXCOLOR_GS,
-MULTICOLOR_DILU, MULTICOLOR_ILU, CHEBYSHEV, CHEBYSHEV_POLY, POLYNOMIAL,
-KPZ_POLYNOMIAL, KACZMARZ, CF_JACOBI, DENSE_LU_SOLVER, NOSOLVER.
+Registered here: PCG, CG, PCGF, SSTEP_PCG, PBICGSTAB, BICGSTAB, FGMRES,
+GMRES, IDR, IDRMSYNC, BLOCK_JACOBI, JACOBI_L1, GS, MULTICOLOR_GS,
+FIXCOLOR_GS, MULTICOLOR_DILU, MULTICOLOR_ILU, CHEBYSHEV, CHEBYSHEV_POLY,
+POLYNOMIAL, KPZ_POLYNOMIAL, OPT_POLYNOMIAL, KACZMARZ, CF_JACOBI,
+DENSE_LU_SOLVER, NOSOLVER.
 The AMG solver registers when amgx_tpu.amg is imported (amgx_tpu.initialize
 does both).
 """
@@ -33,6 +34,7 @@ from amgx_tpu.solvers import (  # noqa: F401
     krylov,
     polynomial,
     refinement,
+    sstep,
 )
 
 __all__ = [

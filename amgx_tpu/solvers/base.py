@@ -102,6 +102,10 @@ class Solver:
     registry_name = "?"
     # True if this solver ignores its operator (e.g. NOSOLVER)
     is_identity = False
+    # inner steps per reported iteration (s-step solvers override: one
+    # SSTEP_PCG outer iteration = s CG steps); telemetry and benches
+    # multiply SolveResult.iters by this for cross-solver comparisons
+    iterations_scale = 1
 
     def __init__(self, cfg, scope: str = "default"):
         self.cfg = cfg
@@ -544,6 +548,80 @@ class Solver:
             path, cfg=cfg, expect_dtype=expect_dtype
         )
 
+    def reductions_per_iteration(self):
+        """Global reductions (dots + norms — the cross-chip ``psum``
+        sync points of a sharded solve) one monitored iteration of
+        this solver's compiled loop body executes, counted by tracing
+        the iteration protocol under
+        :func:`amgx_tpu.ops.blas.reduction_counter`.  ``None`` when
+        the solver exposes no step/iterate protocol (GMRES/IDR
+        override ``make_solve`` wholesale).  Cached per setup (the
+        ``_jit_cache`` clears on setup/resetup); the number behind the
+        ``amgx_solver_reductions_total`` telemetry family and the
+        ci/smoother_bench.py reductions-per-s-steps gate."""
+        key = "__reductions_per_iteration__"
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        try:
+            val = self._count_iteration_reductions()
+        except Exception:  # noqa: BLE001 — accounting must never fail
+            val = None
+        self._jit_cache[key] = val
+        return val
+
+    def _count_iteration_reductions(self):
+        """Trace one monitored-loop body (iterate + residual-norm
+        monitor) and count the reduction sites."""
+        from amgx_tpu.ops import blas
+
+        if self.A is None:
+            return None
+        params = self.apply_params()
+        spec = jax.ShapeDtypeStruct(
+            (self.A.n_rows * self.A.block_size,),
+            jnp.zeros((), self.A.values.dtype).dtype,
+        )
+        norm_of = self.make_norm() if self.monitor_residual else None
+
+        if hasattr(self, "_make_init"):
+            try:
+                init_fn, iter_fn = self._make_init(), self._make_iter()
+            except NotImplementedError:
+                init_fn = None
+            if init_fn is not None:
+                extra = jax.eval_shape(init_fn, params, spec, spec)
+
+                def body(p, b, x, e):
+                    x, e = iter_fn(p, b, x, e)
+                    return norm_of(e[0]) if norm_of is not None else x
+
+                with blas.reduction_counter() as c:
+                    jax.eval_shape(body, params, spec, spec, extra)
+                return c.count
+
+        rstep = self.make_residual_step()
+        if rstep is not None:
+            def body_r(p, b, x, r):
+                x = rstep(p, b, x, r)
+                r = b - spmv(self.operator_of(p), x)
+                return norm_of(r) if norm_of is not None else x
+
+            with blas.reduction_counter() as c:
+                jax.eval_shape(body_r, params, spec, spec, spec)
+            return c.count
+
+        step = self.make_step()
+
+        def body_s(p, b, x):
+            x = step(p, b, x)
+            if norm_of is not None:
+                return norm_of(b - spmv(self.operator_of(p), x))
+            return x
+
+        with blas.reduction_counter() as c:
+            jax.eval_shape(body_s, params, spec, spec)
+        return c.count
+
     def make_batch_params(self):
         """Traced values-only params rebuild, for batched group solves
         (:mod:`amgx_tpu.serve`).
@@ -711,12 +789,21 @@ class Solver:
             if not telemetry.telemetry_enabled():
                 return
             reg = telemetry.get_registry()
+            # iterations are reported in INNER-step equivalents
+            # (iterations_scale: one s-step outer = s CG steps) so
+            # histograms compare across solver families; reductions
+            # multiply the per-loop-body count by loop-body
+            # executions (= SolveResult.iters), making the
+            # communication win observable: reductions/iterations
+            # ~ 3 for classic monitored PCG, ~ 2/s for SSTEP_PCG
+            red = self.reductions_per_iteration()
             reg.record_solver(
                 self.registry_name,
                 setup_s=self.setup_time,
                 compile_s=self.last_compile_s,
                 solve_s=self.solve_time,
-                iterations=int(res.iters),
+                iterations=int(res.iters) * int(self.iterations_scale),
+                reductions=(red or 0) * int(res.iters),
                 setup_phases={
                     k: v for k, v in (setup_prof or {}).items()
                     if isinstance(v, float)

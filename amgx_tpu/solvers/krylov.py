@@ -16,7 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from amgx_tpu.ops.blas import dot
+from amgx_tpu.ops.blas import dot, fused_dots
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import (
@@ -247,10 +247,13 @@ class PCGFSolver(KrylovSolver):
             x = x + alpha * p
             r_new = r - alpha * q
             z = M(Mp, r_new)
-            rho_new = dot(r_new, z)
+            # the Polak-Ribiere arm needs <r_new, z> AND <z, r_new - r>
+            # at the same point, and both share operands: ONE stacked
+            # reduction instead of two (ops/blas.fused_dots)
+            rho_new, zdr = fused_dots(((r_new, z), (z, r_new - r)))
             beta = jnp.where(
                 rho != 0,
-                dot(z, r_new - r) / jnp.where(rho != 0, rho, 1.0),
+                zdr / jnp.where(rho != 0, rho, 1.0),
                 0.0,
             )
             p = z + beta * p
@@ -298,8 +301,9 @@ class PBiCGStabSolver(KrylovSolver):
             s = r - alpha * v
             shat = M(Mp, s)
             t = spmv(A, shat)
-            tt = dot(t, t)
-            omega = jnp.where(tt != 0, dot(t, s) / tt, 0.0)
+            # <t, t> and <t, s> share t: one stacked reduction
+            tt, ts = fused_dots(((t, t), (t, s)))
+            omega = jnp.where(tt != 0, ts / tt, 0.0)
             x = x + alpha * phat + omega * shat
             r = s - omega * t
             return x, (r, r0, p, v, rho1, alpha, omega)

@@ -24,6 +24,11 @@ import re
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
 
+# per-config iteration histogram bucket upper bounds (inner-step
+# equivalents; the registry's record_solver sorts each timed solve
+# into the first bucket that covers it, "+Inf" past the last)
+ITERATION_BUCKETS = (1, 2, 5, 10, 20, 50, 100, 200, 500)
+
 
 def sanitize_name(name: str) -> str:
     """Coerce an internal counter key into a legal metric name."""
@@ -284,8 +289,35 @@ def solver_families(fams: FamilyTable, comp: str, snap: dict) -> None:
         fams.add("amgx_solver_solves_total", "counter",
                  "timed solves observed", labels, st.get("solves", 0))
         fams.add("amgx_solver_iterations_total", "counter",
-                 "iterations across timed solves", labels,
+                 "iterations across timed solves (inner-step "
+                 "equivalents: one s-step outer = s CG steps)", labels,
                  st.get("iterations", 0))
+        fams.add("amgx_solver_reductions_total", "counter",
+                 "global dot/norm reductions across timed solves (the "
+                 "cross-chip psum sync points; ~3/iter for monitored "
+                 "PCG, ~2/s per iter for SSTEP_PCG)", labels,
+                 st.get("reductions", 0))
+        hist = st.get("iter_hist") or {}
+        if hist:
+            # histogram-shaped per-config iteration distribution:
+            # cumulative le-labelled buckets + _sum/_count
+            cum = 0
+            for le in ITERATION_BUCKETS:
+                cum += hist.get(le, 0)
+                fams.add("amgx_solver_iterations_bucket", "counter",
+                         "timed solves by iteration count "
+                         "(cumulative buckets)",
+                         {**labels, "le": str(le)}, cum)
+            fams.add("amgx_solver_iterations_bucket", "counter",
+                     "timed solves by iteration count "
+                     "(cumulative buckets)",
+                     {**labels, "le": "+Inf"}, st.get("solves", 0))
+            fams.add("amgx_solver_iterations_sum", "counter",
+                     "iteration histogram sum", labels,
+                     st.get("iterations", 0))
+            fams.add("amgx_solver_iterations_count", "counter",
+                     "iteration histogram count", labels,
+                     st.get("solves", 0))
         fams.add("amgx_solver_setup_seconds_total", "counter",
                  "setup seconds across timed solves", labels,
                  st.get("setup_s", 0.0))

@@ -123,18 +123,31 @@ class TelemetryRegistry:
 
     def record_solver(self, solver: str, setup_s: float = 0.0,
                       compile_s: float = 0.0, solve_s: float = 0.0,
-                      iterations: int = 0,
+                      iterations: int = 0, reductions: int = 0,
                       setup_phases: Optional[dict] = None) -> None:
         """Fold one timed solve's ``obtain_timings`` lines into the
         per-solver-class aggregate (the registry's ``solvers``
-        component)."""
+        component).  ``reductions`` counts the solve's global
+        dot/norm reductions (``amgx_solver_reductions_total`` — the
+        communication-free-inner-loop observability of PR 8);
+        ``iterations`` additionally feeds a per-solver iteration
+        histogram (``promtext.ITERATION_BUCKETS``)."""
         with self._solver_lock:
             st = self._solver_stats.setdefault(solver, {
-                "solves": 0, "iterations": 0, "setup_s": 0.0,
-                "compile_s": 0.0, "solve_s": 0.0, "setup_phases": {},
+                "solves": 0, "iterations": 0, "reductions": 0,
+                "setup_s": 0.0, "compile_s": 0.0, "solve_s": 0.0,
+                "setup_phases": {}, "iter_hist": {},
             })
             st["solves"] += 1
             st["iterations"] += int(iterations)
+            st["reductions"] += int(reductions)
+            hist = st["iter_hist"]
+            for le in promtext.ITERATION_BUCKETS:
+                if iterations <= le:
+                    hist[le] = hist.get(le, 0) + 1
+                    break
+            else:
+                hist["+Inf"] = hist.get("+Inf", 0) + 1
             st["setup_s"] += float(setup_s)
             st["compile_s"] += float(compile_s)
             st["solve_s"] += float(solve_s)
@@ -147,7 +160,9 @@ class TelemetryRegistry:
     def _solver_snapshot(self) -> dict:
         with self._solver_lock:
             return {
-                name: {**st, "setup_phases": dict(st["setup_phases"])}
+                name: {**st,
+                       "setup_phases": dict(st["setup_phases"]),
+                       "iter_hist": dict(st["iter_hist"])}
                 for name, st in self._solver_stats.items()
             }
 

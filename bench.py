@@ -401,6 +401,50 @@ def _isolate_kernel_probes(timeout_s=300):
             )
 
 
+def _sstep_record():
+    """Communication-free inner loops (PR 8): traced reductions per s
+    steps + iteration parity (ci/smoother_bench.py, reduced matrix)
+    and the recommended-config serve A/B solves/s at B=16
+    (ci/serve_bench.comm_free_compare).  Guarded — must never take
+    the headline bench down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.serve_bench import comm_free_compare
+        from ci.smoother_bench import run as smoother_run
+
+        rec, problems = smoother_run(small=True)
+        cf = comm_free_compare(reps=2)
+        out = {
+            "reductions_per_s_steps": rec["value"],
+            "s_step": rec["s_step"],
+            "unit": rec["unit"],
+            "iterations": rec["iterations"],
+            "reductions": rec["reductions"],
+            "serve_solves_per_s": {
+                k: cf[k]["solves_per_s"]
+                for k in ("baseline", "recommended")
+            },
+            "serve_per_iteration_ms": {
+                k: cf[k]["per_iteration_ms"]
+                for k in ("baseline", "recommended")
+            },
+            "serve_throughput_speedup": cf["throughput_speedup"],
+            "serve_per_iteration_speedup": cf[
+                "per_iteration_speedup"
+            ],
+            "ok": rec["ok"],
+        }
+        if problems:
+            out["problems"] = problems
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: sstep record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _telemetry_record():
     """Telemetry overhead A/B (armed sample=0 vs disarmed, one warmed
     service; ci/telemetry_check.py, reduced reps) plus exposition /
@@ -552,6 +596,10 @@ def main():
     telemetry_rec = _telemetry_record()
     print(f"bench: telemetry {telemetry_rec}", file=sys.stderr)
 
+    # ---- communication-free inner loops ----------------------------
+    sstep_rec = _sstep_record()
+    print(f"bench: sstep {sstep_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -574,6 +622,7 @@ def main():
                 "store": store_rec,
                 "setup": setup_rec,
                 "telemetry": telemetry_rec,
+                "sstep": sstep_rec,
             }
         )
     )

@@ -5,8 +5,10 @@ Prints ONE JSON line (same contract as the other ci/ gates) and exits
 non-zero when:
 
 * the Prometheus exposition fails to parse, exports fewer than 25
-  distinct metric names, or misses one of the required sources
-  (serve, gateway/admission, store, cache, setup-phase);
+  distinct metric names, misses one of the required sources
+  (serve, gateway/admission, store, cache, setup-phase, solver), or
+  misses the PR 8 communication-observability names
+  (amgx_solver_reductions_total, amgx_solver_iterations_bucket);
 * a sampled gateway request does not produce a CONNECTED
   submit -> admission -> pad -> dispatch -> device -> fetch span
   chain in the exported Chrome trace JSON;
@@ -94,6 +96,30 @@ def _validate_observability(problems, store_dir):
             problems.append(f"workload solves failed: {statuses}")
         gw.service.flush_store()
 
+        # one direct timed solve of the recommended comm-avoiding
+        # config feeds the built-in solver aggregate, so the catalog
+        # gate covers amgx_solver_reductions_total + the per-config
+        # iteration histogram (PR 8) on a config where reductions
+        # actually amortize (SSTEP_PCG: 2 per s steps)
+        from amgx_tpu.config.amg_config import AMGConfig
+        from amgx_tpu.core.matrix import SparseMatrix
+        from amgx_tpu.serve import COMM_AVOIDING_CONFIG
+        from amgx_tpu.solvers.registry import create_solver, make_nested
+
+        # obtain_timings: the solver aggregate is the obtain_timings
+        # re-emission path — without it a direct solve records nothing
+        cfg_json = json.loads(COMM_AVOIDING_CONFIG)
+        cfg_json["solver"]["obtain_timings"] = 1
+        solver = make_nested(create_solver(
+            AMGConfig.from_string(json.dumps(cfg_json)), "default"
+        ))
+        solver.setup(SparseMatrix.from_scipy(sp))
+        sres = solver.solve(rng.standard_normal(n))
+        if int(sres.status) != 0:
+            problems.append(
+                f"direct SSTEP_PCG solve failed: {int(sres.status)}"
+            )
+
         # ---- prometheus ------------------------------------------
         text = telemetry.get_registry().render_prometheus()
         names = set()
@@ -110,9 +136,17 @@ def _validate_observability(problems, store_dir):
                 f"only {len(names)} metric names exported (floor 25)"
             )
         for prefix in ("amgx_serve_", "amgx_gateway_", "amgx_store_",
-                       "amgx_cache_", "amgx_setup_phase_"):
+                       "amgx_cache_", "amgx_setup_phase_",
+                       "amgx_solver_"):
             if not any(nm.startswith(prefix) for nm in names):
                 problems.append(f"no metric from source {prefix}*")
+        for required in ("amgx_solver_reductions_total",
+                         "amgx_solver_iterations_bucket"):
+            if required not in names:
+                problems.append(
+                    f"required metric {required} missing (PR 8 "
+                    "communication observability)"
+                )
 
         # ---- chrome trace ----------------------------------------
         trace = tracing.export_chrome()

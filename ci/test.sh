@@ -44,6 +44,14 @@ JAX_PLATFORMS=cpu python ci/store_bench.py
 # one host->device transfer batch per hierarchy.
 JAX_PLATFORMS=cpu python ci/setup_bench.py
 
+# ---- communication-free inner loops: parity + reduction gates --------
+# One JSON line; non-zero exit when OPT_POLYNOMIAL or SSTEP_PCG needs
+# more than +10% iterations (inner-CG-step equivalents, +s-1 s-step
+# quantization allowance) over the PCG+AMG(Jacobi) baseline on the
+# bench matrix, or when SSTEP_PCG traces to more than 2 global
+# reductions per s steps (monitored PCG: 3 per step).
+JAX_PLATFORMS=cpu python ci/smoother_bench.py
+
 # ---- unified telemetry: exposition + tracing + overhead --------------
 # One JSON line; non-zero exit when the Prometheus exposition fails to
 # parse or exports fewer than 25 metric names across the serve /

@@ -1,0 +1,463 @@
+"""Communication-free inner loops (PR 8): s-step PCG, fused
+multi-dot/Gram reductions, optimal-weight polynomial smoothing, and
+the spectral-bound resetup cache."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import amgx_tpu
+
+amgx_tpu.initialize()
+
+from amgx_tpu.config.amg_config import AMGConfig
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.io.poisson import jittered_poisson_family, poisson_scipy
+from amgx_tpu.ops import blas
+from amgx_tpu.solvers.registry import create_solver, make_nested
+
+
+def _poisson(shape=(24, 24), seed=0):
+    sp = poisson_scipy(shape).tocsr()
+    sp.sort_indices()
+    rng = np.random.default_rng(seed)
+    return sp, rng.standard_normal(sp.shape[0])
+
+
+def _krylov_cfg(solver, extra="", precond="BLOCK_JACOBI",
+                max_iters=400, tol=1e-10):
+    return AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        f' "solver": "{solver}", "max_iters": {max_iters},'
+        f' "tolerance": {tol}, "monitor_residual": 1,'
+        f' "convergence": "RELATIVE_INI", {extra}'
+        ' "preconditioner": {"scope": "p",'
+        f' "solver": "{precond}", "max_iters": 2,'
+        ' "monitor_residual": 0}}}'
+    )
+
+
+def _solve(cfg, sp, b):
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(sp))
+    return s, s.solve(b)
+
+
+def _true_rel_res(sp, x, b):
+    return float(
+        np.linalg.norm(sp @ np.asarray(x) - b) / np.linalg.norm(b)
+    )
+
+
+# ---------------------------------------------------------------------
+# fused BLAS helpers
+
+
+def test_fused_dots_matches_dot_real_and_complex():
+    rng = np.random.default_rng(1)
+    for dt in (np.float64, np.complex128):
+        x = jnp.asarray(rng.standard_normal(37).astype(dt))
+        y = jnp.asarray(rng.standard_normal(37).astype(dt))
+        if np.issubdtype(dt, np.complexfloating):
+            x = x + 1j * jnp.asarray(rng.standard_normal(37))
+            y = y - 1j * jnp.asarray(rng.standard_normal(37))
+        got = blas.fused_dots(((x, y), (y, x), (x, x)))
+        np.testing.assert_allclose(
+            np.asarray(got),
+            [np.asarray(blas.dot(x, y)), np.asarray(blas.dot(y, x)),
+             np.asarray(blas.dot(x, x))],
+            rtol=1e-13,
+        )
+
+
+def test_gram_block_matches_pairwise_dots():
+    rng = np.random.default_rng(2)
+    X = jnp.asarray(rng.standard_normal((3, 29)))
+    Y = jnp.asarray(rng.standard_normal((5, 29)))
+    G = np.asarray(blas.gram_block(X, Y))
+    for i in range(3):
+        for j in range(5):
+            np.testing.assert_allclose(
+                G[i, j], float(blas.dot(X[i], Y[j])), rtol=1e-13
+            )
+    # complex: conjugation on the first block, matching dot()
+    Xc = X[:2] + 1j * jnp.asarray(rng.standard_normal((2, 29)))
+    Gc = np.asarray(blas.gram_block(Xc, Xc))
+    assert Gc[0, 0].imag == pytest.approx(0.0, abs=1e-12)
+    assert Gc[0, 0].real > 0
+
+
+def test_reduction_counter_counts_sites():
+    x = jnp.ones(8)
+    with blas.reduction_counter() as c:
+        blas.dot(x, x)
+        blas.fused_dots(((x, x), (x, 2 * x)))
+        blas.gram_block(jnp.stack([x, x]), jnp.stack([x, x]))
+    # one site per CALL, not per scalar produced (= one psum each)
+    assert c.count == 3
+    # context exit restores the outer (no-counter) state
+    blas.dot(x, x)
+    assert c.count == 3
+
+
+# ---------------------------------------------------------------------
+# SSTEP_PCG
+
+
+def test_s1_is_classic_pcg_bitwise():
+    """s_step=1 degenerates to PCG exactly: same iterates, same
+    iteration count, bitwise-identical solution."""
+    sp, b = _poisson()
+    _, ref = _solve(_krylov_cfg("PCG"), sp, b)
+    s, res = _solve(_krylov_cfg("SSTEP_PCG", '"s_step": 1,'), sp, b)
+    assert s.iterations_scale == 1
+    assert int(res.iters) == int(ref.iters)
+    assert np.array_equal(np.asarray(res.x), np.asarray(ref.x))
+    np.testing.assert_array_equal(
+        np.asarray(res.history), np.asarray(ref.history)
+    )
+
+
+@pytest.mark.parametrize("s_val", [2, 4])
+def test_sstep_matches_pcg_iteration_for_iteration(s_val):
+    """s inner steps per outer: inner-equivalent iteration counts stay
+    within the s-step overshoot (< s) of classic PCG, and the solution
+    meets the same tolerance against the TRUE residual."""
+    sp, b = _poisson()
+    _, ref = _solve(_krylov_cfg("PCG"), sp, b)
+    s, res = _solve(
+        _krylov_cfg("SSTEP_PCG", f'"s_step": {s_val},'), sp, b
+    )
+    assert int(res.status) == 0
+    inner = int(res.iters) * s.iterations_scale
+    assert inner <= int(ref.iters) + s_val  # overshoot bound
+    assert inner >= int(ref.iters) - s_val
+    assert _true_rel_res(sp, res.x, b) < 5e-9
+
+
+@pytest.mark.parametrize("basis", ["MONOMIAL", "SCALED"])
+def test_sstep_basis_knob(basis):
+    sp, b = _poisson()
+    _, res = _solve(
+        _krylov_cfg(
+            "SSTEP_PCG", f'"s_step": 4, "sstep_basis": "{basis}",'
+        ),
+        sp, b,
+    )
+    assert int(res.status) == 0
+    assert _true_rel_res(sp, res.x, b) < 5e-9
+
+
+def test_sstep_two_reductions_per_outer_iteration():
+    """The headline contract: one fused Gram + one monitor norm per
+    outer iteration — 2 reductions per s steps, vs 3 per step for
+    classic monitored PCG."""
+    sp, b = _poisson()
+    for s_val in (2, 4, 8):
+        s, _ = _solve(
+            _krylov_cfg("SSTEP_PCG", f'"s_step": {s_val},'), sp, b
+        )
+        assert s.reductions_per_iteration() == 2
+    pcg, _ = _solve(_krylov_cfg("PCG"), sp, b)
+    assert pcg.reductions_per_iteration() == 3
+
+
+def test_residual_replacement_guard_on_ill_conditioned():
+    """Large s + tight tolerance on an ill-conditioned operator makes
+    the recurred residual drift from the true one; the replacement
+    guard restores true-residual accuracy at the cost of one SpMV per
+    cadence."""
+    sp, b = _poisson()
+    # push conditioning: strong anisotropy scales the spectrum spread
+    sp = sp + sps.diags_array(
+        np.linspace(0.0, 50.0, sp.shape[0]) ** 2 * 1e-4
+    )
+    sp = sp.tocsr()
+    sp.sort_indices()
+    off = _krylov_cfg("SSTEP_PCG", '"s_step": 8,')
+    on = _krylov_cfg(
+        "SSTEP_PCG", '"s_step": 8, "sstep_replace_every": 1,'
+    )
+    _, r_off = _solve(off, sp, b)
+    _, r_on = _solve(on, sp, b)
+    assert int(r_on.status) == 0
+    res_off = _true_rel_res(sp, r_off.x, b)
+    res_on = _true_rel_res(sp, r_on.x, b)
+    # the guard must measurably close the drift gap...
+    assert res_on < res_off / 10
+    # ...and land the true residual near the monitored tolerance
+    assert res_on < 5e-9
+
+
+def test_sstep_with_amg_preconditioner():
+    sp, b = _poisson((16, 16))
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "SSTEP_PCG", "s_step": 4, "max_iters": 100,'
+        ' "tolerance": 1e-8, "monitor_residual": 1,'
+        ' "convergence": "RELATIVE_INI",'
+        ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+        ' "algorithm": "AGGREGATION", "selector": "SIZE_8",'
+        ' "smoother": {"scope": "p", "solver": "OPT_POLYNOMIAL",'
+        ' "chebyshev_polynomial_order": 2, "monitor_residual": 0},'
+        ' "presweeps": 1, "postsweeps": 1, "max_iters": 1,'
+        ' "min_coarse_rows": 32, "max_levels": 10,'
+        ' "structure_reuse_levels": -1,'
+        ' "coarse_solver": "DENSE_LU_SOLVER", "cycle": "V",'
+        ' "monitor_residual": 0}}}'
+    )
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(sp))
+    res = s.solve(b)
+    assert int(res.status) == 0
+    assert s.reductions_per_iteration() == 2
+    assert _true_rel_res(sp, res.x, b) < 1e-6
+
+
+# ---------------------------------------------------------------------
+# optimal-weight polynomial smoothing
+
+
+def _amg_cfg(outer, smoother, pre, post, extra_outer="",
+             extra_smoother=""):
+    return (
+        '{"config_version": 2, "solver": {"scope": "main",'
+        f' "solver": "{outer}", "max_iters": 100, "tolerance": 1e-8,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI",'
+        f' {extra_outer}'
+        ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+        ' "algorithm": "AGGREGATION", "selector": "SIZE_8",'
+        ' "smoother": {"scope": "sm",'
+        f' "solver": "{smoother}", "relaxation_factor": 0.8,'
+        ' "chebyshev_polynomial_order": 2, "kpz_order": 2,'
+        f' {extra_smoother} "monitor_residual": 0}},'
+        f' "presweeps": {pre}, "postsweeps": {post}, "max_iters": 1,'
+        ' "min_coarse_rows": 32, "max_levels": 10,'
+        ' "structure_reuse_levels": -1,'
+        ' "coarse_solver": "DENSE_LU_SOLVER", "cycle": "V",'
+        ' "monitor_residual": 0}}}'
+    )
+
+
+def test_opt_poly_weights_table():
+    from amgx_tpu.solvers.polynomial import opt_fourth_kind_weights
+
+    for k in range(1, 7):
+        w = opt_fourth_kind_weights(k)
+        assert len(w) == k
+        # optimized weights are increasing and > 1 (Lottes table 1)
+        assert all(b > 1.0 for b in w)
+        assert list(w) == sorted(w)
+    # beyond the published table: unweighted fourth kind
+    assert opt_fourth_kind_weights(9) == (1.0,) * 9
+
+
+def test_opt_poly_smoother_beats_jacobi_iterations():
+    """Equal smoother flops (Jacobi 2+2 sweeps vs degree-2 opt-poly
+    1+1): the optimal polynomial must not need more PCG iterations —
+    the 2407.09848 claim this PR ships."""
+    sp, b = _poisson((16, 16))
+    _, r_jac = _solve(
+        AMGConfig.from_string(_amg_cfg("PCG", "BLOCK_JACOBI", 2, 2)),
+        sp, b,
+    )
+    _, r_opt = _solve(
+        AMGConfig.from_string(_amg_cfg("PCG", "OPT_POLYNOMIAL", 1, 1)),
+        sp, b,
+    )
+    assert int(r_jac.status) == 0 and int(r_opt.status) == 0
+    assert int(r_opt.iters) <= int(r_jac.iters)
+
+
+def test_opt_poly_standalone_converges():
+    sp, b = _poisson((16, 16))
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "OPT_POLYNOMIAL",'
+        ' "chebyshev_polynomial_order": 3, "max_iters": 300,'
+        ' "tolerance": 1e-6, "monitor_residual": 1,'
+        ' "convergence": "RELATIVE_INI"}}'
+    )
+    s, res = _solve(cfg, sp, b)
+    assert int(res.status) == 0
+    assert _true_rel_res(sp, res.x, b) < 1e-5
+    # needs only the upper bound; both cached on the solver
+    assert s.lmax > 0
+
+
+# ---------------------------------------------------------------------
+# spectral-bound resetup cache
+
+
+def _cheb_cfg(solver="CHEBYSHEV", extra=""):
+    return AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        f' "solver": "{solver}", "chebyshev_polynomial_order": 4,'
+        f' {extra} "max_iters": 200, "tolerance": 1e-6,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI"}}'
+    )
+
+
+@pytest.mark.parametrize("solver", ["CHEBYSHEV", "OPT_POLYNOMIAL"])
+def test_bounds_cached_across_resetup(solver):
+    """Values-only resetup reuses the cached spectral window instead
+    of re-running the power iteration (the PR 8 bugfix), tracking
+    staleness explicitly."""
+    sp, b = _poisson((16, 16))
+    s = make_nested(create_solver(_cheb_cfg(solver), "default"))
+    s.setup(SparseMatrix.from_scipy(sp))
+    lmax0, lmin0 = s.lmax, s.lmin
+    assert s.bound_staleness == 0
+    for k in range(3):
+        sp2 = sp.copy()
+        sp2.data = sp2.data * (1.0 + 0.01 * (k + 1))
+        s.resetup(SparseMatrix.from_scipy(sp2))
+        assert s.bound_staleness == k + 1
+        assert s.lmax == lmax0 and s.lmin == lmin0
+    res = s.solve(b)
+    assert int(res.status) == 0
+
+
+def test_reestimate_eigs_knob_refreshes_bounds():
+    sp, b = _poisson((16, 16))
+    s = make_nested(
+        create_solver(_cheb_cfg(extra='"reestimate_eigs": 2,'),
+                      "default")
+    )
+    s.setup(SparseMatrix.from_scipy(sp))
+    lmax0 = s.lmax
+    # non-uniform diagonal boost: uniform scaling cancels in D^-1 A,
+    # so shift the Jacobi-preconditioned spectrum for real
+    sp2 = (sp + sps.diags_array(
+        np.linspace(0.0, 8.0, sp.shape[0])
+    )).tocsr()
+    sp2.sort_indices()
+    assert sp2.nnz == sp.nnz  # same pattern (diagonal present)
+    s.resetup(SparseMatrix.from_scipy(sp2))
+    assert s.bound_staleness == 1  # first resetup: cached
+    assert s.lmax == lmax0
+    s.resetup(SparseMatrix.from_scipy(sp2))
+    assert s.bound_staleness == 0  # second: re-estimated
+    assert s.lmax != lmax0
+
+
+def test_amg_level_smoothers_keep_bounds_on_resetup():
+    """The hierarchy caches smoother spectral bounds: a values-only
+    AMG resetup resetups surviving level smoothers in place (no
+    power-iteration re-estimate) instead of rebuilding them."""
+    sp, b = _poisson((16, 16))
+    cfg = AMGConfig.from_string(
+        _amg_cfg("PCG", "OPT_POLYNOMIAL", 1, 1)
+    )
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(sp))
+    amg = s.precond
+    sm0 = [lvl.smoother for lvl in amg.levels if lvl.smoother]
+    bounds0 = [sm.lmax for sm in sm0]
+    sp2 = sp.copy()
+    sp2.data = sp2.data * 1.02
+    s.resetup(SparseMatrix.from_scipy(sp2))
+    sm1 = [lvl.smoother for lvl in amg.levels if lvl.smoother]
+    # same smoother objects, same cached bounds, staleness bumped
+    assert [id(x) for x in sm0] == [id(x) for x in sm1]
+    assert [sm.lmax for sm in sm1] == bounds0
+    assert all(sm.bound_staleness == 1 for sm in sm1)
+    res = s.solve(b)
+    assert int(res.status) == 0
+
+
+# ---------------------------------------------------------------------
+# vmapped serve-group batch parity (make_batch_params wiring)
+
+
+@pytest.mark.serve
+@pytest.mark.parametrize(
+    "outer,extra_outer,smoother",
+    [
+        ("PCG", "", "POLYNOMIAL"),
+        ("PCG", "", "KPZ_POLYNOMIAL"),
+        ("PCG", "", "CHEBYSHEV"),
+        ("PCG", "", "OPT_POLYNOMIAL"),
+        ("SSTEP_PCG", '"s_step": 4,', "OPT_POLYNOMIAL"),
+    ],
+)
+def test_batched_group_parity(outer, extra_outer, smoother):
+    """make_batch_params wiring for the new smoothers and SSTEP_PCG:
+    a vmapped serve group must match the sequential values-only
+    resetup reference iteration-for-iteration."""
+    from amgx_tpu.serve import BatchedSolveService
+
+    cfg_text = _amg_cfg(outer, smoother, 1, 1,
+                        extra_outer=extra_outer)
+    systems = jittered_poisson_family((16, 16), 6, seed=1,
+                                      jitter=0.05)
+    svc = BatchedSolveService(config=cfg_text, max_batch=8)
+    results = svc.solve_many(systems)
+    m = svc.metrics.snapshot()
+    assert m["batches"] == 1
+    assert m.get("fallback_solves", 0) == 0
+    cfg = AMGConfig.from_string(cfg_text)
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(systems[0][0]))
+    for (sp, b), r in zip(systems, results):
+        s.resetup(SparseMatrix.from_scipy(sp))
+        ref = s.solve(b)
+        assert int(r.status) == 0
+        assert int(r.iters) == int(ref.iters)
+        ref_x = np.asarray(ref.x)
+        err = np.linalg.norm(np.asarray(r.x) - ref_x) / max(
+            np.linalg.norm(ref_x), 1e-300
+        )
+        assert err < 1e-9
+
+
+@pytest.mark.serve
+def test_kpz_batch_params_rederive_spectrum_per_instance():
+    """KPZ's smax = ||A||_inf estimate re-derives on device per
+    instance (segment-sum over columns), matching the host setup
+    estimate for the same values."""
+    sp, _ = _poisson((12, 12))
+    cfg = AMGConfig.from_string(
+        '{"config_version": 2, "solver": {"scope": "main",'
+        ' "solver": "KPZ_POLYNOMIAL", "kpz_order": 2,'
+        ' "max_iters": 10, "monitor_residual": 0}}'
+    )
+    s = make_nested(create_solver(cfg, "default"))
+    s.setup(SparseMatrix.from_scipy(sp))
+    tmpl, fn = s.make_batch_params()
+    vals2 = jnp.asarray(sp.data * 1.7)
+    _, coef_traced = fn(tmpl, vals2)
+    sp2 = sp.copy()
+    sp2.data = sp2.data * 1.7
+    s2 = make_nested(create_solver(cfg, "default"))
+    s2.setup(SparseMatrix.from_scipy(sp2))
+    _, coef_host = s2.apply_params()
+    for ct, ch in zip(coef_traced, coef_host):
+        np.testing.assert_allclose(
+            np.asarray(ct), np.asarray(ch), rtol=1e-12
+        )
+
+
+# ---------------------------------------------------------------------
+# fused dots in the existing Krylov solvers (regression)
+
+
+def test_pcgf_fused_polak_ribiere_converges():
+    sp, b = _poisson()
+    s, res = _solve(_krylov_cfg("PCGF", max_iters=300, tol=1e-8),
+                    sp, b)
+    assert int(res.status) == 0
+    assert _true_rel_res(sp, res.x, b) < 1e-6
+    # the fused arm saves a reduction site vs the naive 4
+    assert s.reductions_per_iteration() == 3
+
+
+def test_pbicgstab_fused_tt_ts_converges():
+    sp, b = _poisson()
+    s, res = _solve(_krylov_cfg("PBICGSTAB", max_iters=300, tol=1e-8),
+                    sp, b)
+    assert int(res.status) == 0
+    assert _true_rel_res(sp, res.x, b) < 1e-6
+    assert s.reductions_per_iteration() == 4
