@@ -209,6 +209,9 @@ class _SolverHandle:
         # optional fleet gateway in front of it (admission control /
         # load shedding), built when AMGX_TPU_CAPI_ADMISSION is set
         self.batch_gateway = None
+        # streaming-session manager (solver_session_*), lazily built
+        # over the same batch service/gateway
+        self.session_manager = None
         self.batch_results = None
         # in-flight tickets of a non-blocking solver_solve_batch call:
         # (ticket-or-None, n, sol_handle) triples, drained on the first
@@ -1082,6 +1085,54 @@ def solver_get_iteration_residual(slv_h: int, it: int, idx: int = 0):
     return float(hist[it, idx])
 
 
+def _ensure_batch_front(s):
+    """Build the handle's serve layer on first use (shared by
+    solver_solve_batch and solver_session_create); returns the
+    submit front (gateway when admission control is enabled, else
+    the bare service)."""
+    if s.batch_service is None:
+        import os
+
+        from amgx_tpu.serve import BatchedSolveService
+
+        # AMGX_TPU_CAPI_ADMISSION=<budget>: front the embedded batch
+        # service with the fleet gateway — submits beyond the
+        # concurrency budget shed TYPED (per-system FAILED status +
+        # RC_NO_MEMORY wording) instead of queueing unboundedly in a
+        # long-running host process.  Parse BEFORE any handle state is
+        # assigned: a malformed value must fail every call loudly
+        # (RC_BAD_CONFIGURATION), not error once and then silently
+        # run the rest of the process without admission control.
+        budget_env = os.environ.get("AMGX_TPU_CAPI_ADMISSION", "")
+        budget = None
+        if budget_env:
+            try:
+                budget = int(budget_env)
+            except ValueError:
+                raise AMGXError(
+                    RC_BAD_CONFIGURATION,
+                    "AMGX_TPU_CAPI_ADMISSION must be an integer "
+                    f"concurrency budget, got {budget_env!r}",
+                ) from None
+            if budget <= 0:
+                # a zero/negative budget would either silently disable
+                # admission control or shed EVERY submit — both
+                # contradict the set-but-malformed-fails-loudly intent
+                raise AMGXError(
+                    RC_BAD_CONFIGURATION,
+                    "AMGX_TPU_CAPI_ADMISSION must be a positive "
+                    f"concurrency budget, got {budget_env!r}",
+                )
+        s.batch_service = BatchedSolveService(config=s.cfg.cfg)
+        if budget:
+            from amgx_tpu.serve import SolveGateway
+
+            s.batch_gateway = SolveGateway(
+                s.batch_service, max_inflight=budget
+            )
+    return s.batch_gateway or s.batch_service
+
+
 @_traced
 def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
     """Batched solve of N independent systems through the serve layer
@@ -1126,46 +1177,7 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
     if not mtx_handles:
         s.batch_results = []
         return RC_OK
-    if s.batch_service is None:
-        import os
-
-        from amgx_tpu.serve import BatchedSolveService
-
-        # AMGX_TPU_CAPI_ADMISSION=<budget>: front the embedded batch
-        # service with the fleet gateway — submits beyond the
-        # concurrency budget shed TYPED (per-system FAILED status +
-        # RC_NO_MEMORY wording) instead of queueing unboundedly in a
-        # long-running host process.  Parse BEFORE any handle state is
-        # assigned: a malformed value must fail every call loudly
-        # (RC_BAD_CONFIGURATION), not error once and then silently
-        # run the rest of the process without admission control.
-        budget_env = os.environ.get("AMGX_TPU_CAPI_ADMISSION", "")
-        budget = None
-        if budget_env:
-            try:
-                budget = int(budget_env)
-            except ValueError:
-                raise AMGXError(
-                    RC_BAD_CONFIGURATION,
-                    "AMGX_TPU_CAPI_ADMISSION must be an integer "
-                    f"concurrency budget, got {budget_env!r}",
-                ) from None
-            if budget <= 0:
-                # a zero/negative budget would either silently disable
-                # admission control or shed EVERY submit — both
-                # contradict the set-but-malformed-fails-loudly intent
-                raise AMGXError(
-                    RC_BAD_CONFIGURATION,
-                    "AMGX_TPU_CAPI_ADMISSION must be a positive "
-                    f"concurrency budget, got {budget_env!r}",
-                )
-        s.batch_service = BatchedSolveService(config=s.cfg.cfg)
-        if budget:
-            from amgx_tpu.serve import SolveGateway
-
-            s.batch_gateway = SolveGateway(
-                s.batch_service, max_inflight=budget
-            )
+    _ensure_batch_front(s)
     systems = []
     for mh, rh, sh in zip(mtx_handles, rhs_handles, sol_handles):
         m = _get(mh, _Matrix)
@@ -1413,6 +1425,157 @@ def solver_load(slv_h: int, path: str):
 
 def solver_destroy(slv_h):
     _objects.pop(slv_h, None)
+    return RC_OK
+
+
+# ---------------------------------------------------------------------------
+# streaming solve sessions (amgx_tpu.sessions): the time-stepping
+# C surface — register a sparsity pattern once, then stream
+# replace_coefficients-style steps with warm starts and pipelined
+# resetup/solve overlap.  No reference analogue: AmgX hosts loop
+# replace_coefficients + resetup + solve by hand; this is that loop as
+# a serve-level object.
+
+
+class _SessionHandle:
+    def __init__(self, owner: _SolverHandle, session):
+        self.owner = owner
+        self.session = session
+        self.pending = None  # (StepTicket, sol_handle) in flight
+        self.last = None  # last resolved SolveResult
+
+
+def _session_settle(h: "_SessionHandle"):
+    """Resolve the in-flight step (the group's one shared host sync)
+    and deliver its solution to the step's solution vector.  A typed
+    per-step failure becomes a FAILED-status result, like the batch
+    API — the stream keeps going."""
+    from amgx_tpu.core.errors import AMGXTPUError
+
+    if h.pending is None:
+        return
+    (ticket, sol_h), h.pending = h.pending, None
+    try:
+        res = ticket.result()
+    except AMGXTPUError:
+        h.last = _batch_failed_result(
+            h.session.n, h.owner.mode.vec_dtype
+        )
+        return
+    h.last = res
+    try:
+        v = _get(sol_h, _Vector)
+    except AMGXError:
+        return  # vector destroyed mid-flight: result unreceivable
+    v.data = np.asarray(res.x, dtype=h.owner.mode.vec_dtype)
+
+
+@_traced
+def solver_session_create(slv_h: int, mtx_h: int) -> int:
+    """Open a streaming session registered on the uploaded matrix's
+    sparsity pattern (AMGX_solver_session_create).  The matrix
+    contributes structure + representative values only; per-step
+    coefficients arrive via :func:`solver_session_step`.  Steps run
+    through the handle's serve layer (and its admission gateway when
+    ``AMGX_TPU_CAPI_ADMISSION`` is set — each step is admitted as one
+    ticket)."""
+    s = _get(slv_h, _SolverHandle)
+    m = _get(mtx_h, _Matrix)
+    if m.A is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+    front = _ensure_batch_front(s)
+    if s.session_manager is None:
+        from amgx_tpu.sessions import SessionManager
+
+        s.session_manager = SessionManager(front)
+    # open() consumes STRUCTURE only and the session dtype is pinned
+    # explicitly, so no values conversion is needed here
+    sess = s.session_manager.open(m.A, dtype=s.mode.mat_dtype)
+    return _new(_SessionHandle(s, sess))
+
+
+@_traced
+def solver_session_step(sess_h: int, mtx_h: int, rhs_h: int,
+                        sol_h: int):
+    """Stream one time step (AMGX_solver_session_step): takes the
+    CURRENT coefficients of ``mtx_h`` (the host app refreshes them
+    with ``matrix_replace_coefficients``) and the rhs, submits with
+    the session's masked warm start, and returns at device DISPATCH.
+    The PREVIOUS step's solution is delivered to its solution vector
+    during this call (its group's one host sync) — or via
+    :func:`solver_session_sync` at end of stream."""
+    h = _get(sess_h, _SessionHandle)
+    m = _get(mtx_h, _Matrix)
+    r = _get(rhs_h, _Vector)
+    _get(sol_h, _Vector)  # validate before submitting
+    if m.A is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
+    if r.data is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "rhs not uploaded")
+    vals = np.asarray(m.A.values).reshape(-1)
+    sess = h.session
+    # settle the previous step FIRST (one sync, delivers its x; a
+    # typed failure becomes a FAILED result, anything untyped
+    # propagates to _rc_guard BEFORE this step stages — so a failed
+    # stream never wedges on a stale prestage), then stage + submit
+    # with the warm start
+    _session_settle(h)
+    sess.prestage(
+        vals, np.asarray(r.data, dtype=h.owner.mode.vec_dtype)
+    )
+    ticket = sess.commit()
+    h.owner.batch_service.flush()  # dispatch without fetching
+    h.pending = (ticket, sol_h)
+    return RC_OK
+
+
+@_traced
+def solver_session_sync(sess_h: int):
+    """Settle the in-flight step: blocks for its group's fetch and
+    writes the solution vector (AMGX_solver_session_sync)."""
+    _session_settle(_get(sess_h, _SessionHandle))
+    return RC_OK
+
+
+def solver_session_get_status(sess_h: int) -> int:
+    """Status of the most recently RESOLVED step (syncs the in-flight
+    one first, mirroring solver_get_batch_status)."""
+    h = _get(sess_h, _SessionHandle)
+    _session_settle(h)
+    if h.last is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no session step yet")
+    return int(h.last.status)
+
+
+def solver_session_get_iterations_number(sess_h: int) -> int:
+    h = _get(sess_h, _SessionHandle)
+    _session_settle(h)
+    if h.last is None:
+        raise AMGXError(RC_BAD_PARAMETERS, "no session step yet")
+    return int(h.last.iters)
+
+
+@_traced
+def solver_session_save(sess_h: int, path: str):
+    """Persist the session's streaming state (step counter, warm
+    start, registered pattern) into the artifact store at ``path``
+    (AMGX_solver_session_save); pairs with the serve layer's
+    hierarchy export for a full drain→warm-boot restart."""
+    h = _get(sess_h, _SessionHandle)
+    _session_settle(h)
+    if not h.session.save(store=path):
+        raise AMGXError(RC_IO_ERROR, "session save failed")
+    return RC_OK
+
+
+def solver_session_destroy(sess_h: int):
+    h = _objects.pop(sess_h, None)
+    if isinstance(h, _SessionHandle):
+        try:
+            _session_settle(h)
+            h.session.close()
+        except Exception:  # noqa: BLE001 — destroy is best-effort
+            pass
     return RC_OK
 
 

@@ -246,6 +246,9 @@ class SolveGateway:
         # the service's flight recorder is the gateway's too: sheds
         # and drains land in the same incident log as quarantines
         self.recorder = self.service.recorder
+        # streaming-session manager (amgx_tpu.sessions), built lazily
+        # by the first open_session(); drain() persists its manifests
+        self._session_mgr = None
         self.telemetry_name = get_registry().register("gateway", self)
 
     # ------------------------------------------------------------------
@@ -276,6 +279,10 @@ class SolveGateway:
         return {
             "state": self._state,
             "tenants": tenants,
+            # per-tenant/lane device-seconds (cost accounting): lives
+            # in the shared serve metrics, exported under the gateway
+            # source as amgx_gateway_tenant_device_seconds_total
+            "tenant_device_s": self.metrics.tenant_device_snapshot(),
             "recorder": self.recorder.summary(),
             **adm,
         }
@@ -319,9 +326,11 @@ class SolveGateway:
     # submission
 
     def _shed(self, err: AdmissionRejected, tenant: str = None,
-              ctx=None, t0: float = None):
+              ctx=None, t0: float = None, root: bool = True):
         """Count one typed shed by reason (and tenant), log the
-        incident, and raise it."""
+        incident, and raise it.  ``root=False`` when a front-end (a
+        streaming session) minted the trace and owns its root span —
+        the shed's submit span then records as a child."""
         self.metrics.inc("gateway_sheds")
         self.metrics.inc(f"shed_{err.reason}")
         if tenant is not None:
@@ -338,7 +347,7 @@ class SolveGateway:
             # appears in the export (dangling parent_id in Perfetto)
             tracing.record_span(
                 "submit", t0, time.perf_counter(), ctx,
-                args={"tenant": tenant, "shed": err.reason}, root=True,
+                args={"tenant": tenant, "shed": err.reason}, root=root,
             )
         raise err
 
@@ -390,19 +399,30 @@ class SolveGateway:
 
     def submit(self, A, b, x0=None, *, tenant: str = "default",
                lane: str = "interactive",
-               deadline_s: Optional[float] = None) -> GatewayTicket:
+               deadline_s: Optional[float] = None,
+               _host=None,
+               _trace=BatchedSolveService._TRACE_UNSET) -> GatewayTicket:
         """Admit-or-shed, then queue.  Raises typed
         :class:`AdmissionRejected`/:class:`Overloaded` (with
         ``retry_after_s``) on shed, typed
         :class:`DeadlineExceededError` for a dead-on-arrival
-        deadline; returns a :class:`GatewayTicket` once admitted."""
+        deadline; returns a :class:`GatewayTicket` once admitted.
+
+        ``_host``/``_trace``: the streaming-session fast path — a
+        session that registered its pattern once passes the
+        pre-extracted ``(ro, ci, vals, n, fingerprint)`` tuple (no
+        per-step CSR extraction or hashing) and the trace context it
+        minted for the step (the session owns the root span; the
+        gateway's submit span records as a child)."""
         from amgx_tpu.core import faults
 
         if lane not in LANES:
             raise ValueError(f"unknown lane {lane!r}; lanes: {LANES}")
         # request tracing: the gateway is the front door, so the trace
         # root is minted here (one float compare when tracing is off)
-        ctx = tracing.new_trace()
+        # — unless a session front-end already minted one
+        root = _trace is BatchedSolveService._TRACE_UNSET
+        ctx = tracing.new_trace() if root else _trace
         t_gw = time.perf_counter()
         if self._state != "serving":
             self._shed(Overloaded(
@@ -411,15 +431,15 @@ class SolveGateway:
                 # timeout's worth of backoff, capped like every hint
                 retry_after_s=min(1.0, self.admission.retry_after_cap_s),
                 reason="draining",
-            ), tenant, ctx=ctx, t0=t_gw)
+            ), tenant, ctx=ctx, t0=t_gw, root=root)
         if faults.should_fire("gateway_shed"):
             self._shed(Overloaded(
                 "injected shed (fault site gateway_shed)",
                 retry_after_s=0.05,
                 reason="overloaded",
-            ), tenant, ctx=ctx, t0=t_gw)
+            ), tenant, ctx=ctx, t0=t_gw, root=root)
         svc = self.service
-        host = None
+        host = _host
         probe_fp = None
         if self.shed_broken and svc._broken:
             # tripped fingerprint sheds BEFORE it queues.  The CSR
@@ -428,7 +448,8 @@ class SolveGateway:
             # the matrix object, so the gate stays cheap even while
             # a breaker is open (exactly the incident window where
             # the door must not get slower)
-            host = _host_csr(A)
+            if host is None:
+                host = _host_csr(A)
             ro, ci, vals, n, raw_fp = host
             pat = svc._pattern_for(ro, ci, n, raw_fp)
             if pat.fingerprint in svc._broken:
@@ -444,7 +465,7 @@ class SolveGateway:
                             self.admission.retry_after_cap_s,
                         ),
                         reason="breaker_open",
-                    ), tenant, ctx=ctx, t0=t_gw)
+                    ), tenant, ctx=ctx, t0=t_gw, root=root)
         try:
             t_adm = time.perf_counter()
             try:
@@ -465,7 +486,7 @@ class SolveGateway:
                         args={"shed": e.reason},
                     )
                 # count by reason, close the trace root, re-raise
-                self._shed(e, tenant, ctx=ctx, t0=t_gw)
+                self._shed(e, tenant, ctx=ctx, t0=t_gw, root=root)
             if ctx is not None:
                 tracing.record_span(
                     "admission", t_adm, time.perf_counter(), ctx
@@ -485,7 +506,7 @@ class SolveGateway:
                     tracing.record_span(
                         "submit", t_gw, time.perf_counter(), ctx,
                         args={"tenant": tenant, "rejected": True},
-                        root=True,
+                        root=root,
                     )
                 raise
         except BaseException:
@@ -512,10 +533,11 @@ class SolveGateway:
         self.metrics.inc("gateway_admitted")
         self._tenant_inc(tenant, "admitted")
         if ctx is not None:
-            # the trace root: gateway entry to admitted ticket
+            # the trace root: gateway entry to admitted ticket (a
+            # plain child span when a session owns the root)
             tracing.record_span(
                 "submit", t_gw, time.perf_counter(), ctx,
-                args={"lane": lane, "tenant": tenant}, root=True,
+                args={"lane": lane, "tenant": tenant}, root=root,
             )
         return gt
 
@@ -533,6 +555,50 @@ class SolveGateway:
         )
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(None, ticket.result)
+
+    # ------------------------------------------------------------------
+    # streaming sessions (amgx_tpu.sessions)
+
+    @property
+    def sessions(self):
+        """The gateway's :class:`~amgx_tpu.sessions.SessionManager`
+        (built on first use): every streamed step submits through THIS
+        gateway, so admission control, lanes, tenant quotas and
+        deadline shedding apply per step."""
+        if self._session_mgr is None:
+            from amgx_tpu.sessions import SessionManager
+
+            mgr = SessionManager(self)
+            with self._state_lock:
+                # locked check-then-set: two concurrent first
+                # open_session() calls must share ONE manager, or the
+                # loser's sessions would be invisible to drain()
+                if self._session_mgr is None:
+                    self._session_mgr = mgr
+        return self._session_mgr
+
+    def open_session(self, A, *, session_id=None,
+                     tenant: str = "default",
+                     lane: str = "interactive", dtype=None,
+                     deadline_s: Optional[float] = None, x0=None):
+        """Open a streaming solve session (transient-PDE workload):
+        registers ``A``'s sparsity fingerprint once; the returned
+        :class:`~amgx_tpu.sessions.SolveSession` then streams
+        ``(values, b)`` steps — each admitted as one ticket — with
+        values-only resetup pipelined against the in-flight previous
+        step and masked warm starts.  ``deadline_s`` applies per
+        step."""
+        return self.sessions.open(
+            A, session_id=session_id, tenant=tenant, lane=lane,
+            dtype=dtype, deadline_s=deadline_s, x0=x0,
+        )
+
+    def restore_session(self, session_id: str):
+        """Resume a persisted session (see
+        :meth:`~amgx_tpu.sessions.SessionManager.restore`); callers
+        warm-boot the service first so the stream continues without a
+        single coarsening call."""
+        return self.sessions.restore(session_id)
 
     def _on_settle(self, ticket: GatewayTicket, error):
         if ticket._probe_fp is not None:
@@ -613,6 +679,18 @@ class SolveGateway:
             except BaseException:  # noqa: BLE001 — typed per-ticket
                 failed += 1
         exported = self.service.export_all_entries()
+        # streaming sessions: every outstanding ticket above has
+        # settled, so each session's warm-start state is final —
+        # persist the manifests now, next to the hierarchies the
+        # replacement worker will warm-boot
+        sessions_saved = 0
+        if self._session_mgr is not None:
+            try:
+                sessions_saved = self._session_mgr.save_all()
+            except Exception:  # noqa: BLE001 — drain stays
+                # best-effort: a broken store must not fail the
+                # handoff (Ctrl-C still propagates)
+                pass
         if timed_out:
             # a drain that force-failed tickets is an operator-grade
             # event: capture it (with a metrics snapshot) so the
@@ -627,6 +705,7 @@ class SolveGateway:
             "failed": failed,
             "timed_out": timed_out,
             "exported": exported,
+            "sessions_saved": sessions_saved,
         }
         with self._state_lock:
             self._state = "drained"

@@ -84,6 +84,47 @@ class ServeMetrics:
         # stays bounded while batch degrades — so the reservoirs are
         # too
         self.lane_latency = defaultdict(LatencyReservoir)
+        # per-(tenant, lane) device-seconds: the first slice of fleet
+        # cost accounting — a big-n tenant's device time is visible
+        # next to a small-n one even though both pay one quota token
+        # per request.  Counter only (no enforcement); bounded
+        # cardinality like the gateway's tenant counters.
+        self.tenant_device: dict = defaultdict(float)
+
+    # bound on distinct (tenant, lane) device-seconds keys; overflow
+    # traffic aggregates under the "_other" tenant
+    _TENANT_DEVICE_CAP = 256
+
+    def record_tenant_device(self, tenant: str, lane: str,
+                             seconds: float):
+        """Accumulate one ticket's share of its group's device time
+        against its tenant/lane."""
+        with self._lock:
+            key = (tenant, lane)
+            if (
+                key not in self.tenant_device
+                and len(self.tenant_device) >= self._TENANT_DEVICE_CAP
+            ):
+                key = ("_other", lane)
+            self.tenant_device[key] += float(seconds)
+
+    @staticmethod
+    def _pivot_tenant_device(items) -> dict:
+        """(tenant, lane)->seconds pairs into the nested
+        ``{tenant: {lane: seconds}}`` export shape (caller holds the
+        lock; shared by snapshot() and tenant_device_snapshot())."""
+        out: dict = {}
+        for (tenant, lane), s in items:
+            out.setdefault(tenant, {})[lane] = s
+        return out
+
+    def tenant_device_snapshot(self) -> dict:
+        """``{tenant: {lane: device_seconds}}`` copy (the
+        ``amgx_gateway_tenant_device_seconds_total`` source)."""
+        with self._lock:
+            return self._pivot_tenant_device(
+                self.tenant_device.items()
+            )
 
     # -- counters ------------------------------------------------------
 
@@ -176,6 +217,9 @@ class ServeMetrics:
                 name: res.summary()
                 for name, res in self.lane_latency.items()
             }
+            out["tenant_device_s"] = self._pivot_tenant_device(
+                self.tenant_device.items()
+            )
         # the phase profile holds its own lock (LevelProfile.snapshot)
         # — taking it outside ours keeps the lock order trivial
         out["profile"] = self.profile.snapshot()
@@ -195,7 +239,8 @@ class ServeMetrics:
         snap = self.snapshot()
         lines = ["    serve metrics:"]
         for k in sorted(snap):
-            if k in ("buckets", "latency", "lanes", "profile"):
+            if k in ("buckets", "latency", "lanes", "profile",
+                     "tenant_device_s"):
                 continue
             lines.append(f"      {k:<28s} {snap[k]}")
         for name, summ in snap["latency"].items():

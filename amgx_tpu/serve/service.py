@@ -415,6 +415,13 @@ class _BatchResult:
             m.inc("solved", len(self.tickets))
             m.inc("padded_elems", self.Bb * pat.nb)
             m.inc("real_elems", len(self.tickets) * pat.n)
+            # per-tenant device-seconds (fleet cost accounting, first
+            # slice of ROADMAP item 2): the group's device time splits
+            # evenly across its live tickets, accumulated per
+            # (tenant, lane) — folded locally so the whole group costs
+            # ONE metrics-lock acquisition
+            share = device_s / len(self.tickets)
+            tenant_shares: dict = {}
             rec_on = telemetry_enabled()
             if rec_on:
                 # hoist everything shared or vectorizable out of the
@@ -444,6 +451,8 @@ class _BatchResult:
                 }
                 m.record_ticket(stages)
                 m.record_lane(t._lane, total)
+                tk = (t._tenant, t._lane)
+                tenant_shares[tk] = tenant_shares.get(tk, 0.0) + share
                 ctx = t._trace
                 if ctx is not None:
                     # the ticket's tail spans only materialize at the
@@ -474,6 +483,8 @@ class _BatchResult:
                             ctx.trace_id if ctx is not None else None
                         ),
                     ))
+            for (tn, ln), s in tenant_shares.items():
+                m.record_tenant_device(tn, ln, s)
             if rec_on and recs:
                 self._service._flight_record_many(recs)
             return self._host
@@ -1158,6 +1169,79 @@ class BatchedSolveService:
         self._export_entry(entry, dtype)
         return entry
 
+    def resetup_entry(self, fingerprint: str, values, dtype=None,
+                      *, b=None, x0=None):
+        """Public values-only resetup of a CACHED hierarchy entry —
+        the serve-level ``AMGX_solver_resetup``: re-embeds ``values``
+        (original ``(nnz,)`` layout) into the pattern's padded
+        template, then refreshes the cached template solver in place
+        (``replace_values`` gather maps + RAP-plan re-execution +
+        the PR 8 spectral-bound cache with its ``reestimate_eigs``
+        cadence).  Streaming sessions (:mod:`amgx_tpu.sessions`) call
+        this on their resetup cadence, and the quarantine path's
+        entry reuse is the same helper — one code path for "refresh
+        the shared hierarchy with new coefficients".
+
+        ``fingerprint`` is either the RAW sparsity fingerprint of a
+        submitted matrix or the PADDED pattern fingerprint (the
+        hierarchy-cache key); raw fingerprints resolve through the
+        pattern cache.  Raises ``KeyError`` when no entry is cached
+        for it under this service's config.
+
+        With ``b`` (padded or original length), the refreshed solver
+        also runs one isolated solve INSIDE the same critical section
+        (resetup+solve must not interleave with another caller's
+        resetup) and returns its SolveResult; otherwise returns None.
+        """
+        dtype = (
+            _resolve_dtype(np.asarray(values).dtype)[0]
+            if dtype is None else np.dtype(dtype)
+        )
+        with self._lock:
+            pat = self._patterns.get(fingerprint)
+        fp = pat.fingerprint if pat is not None else fingerprint
+        entry = self.cache.peek(fp, self.cfg_key, dtype)
+        if entry is None:
+            raise KeyError(
+                f"no cached hierarchy entry for fingerprint "
+                f"{str(fingerprint)[:16]}... under this service's "
+                "config/dtype"
+            )
+        pat = entry.pattern
+        values = np.asarray(values).reshape(-1)
+        old = entry.solver.A
+        if (
+            old is not None
+            and getattr(old, "nnz", None) == pat.nnzb
+            and np.dtype(old.values.dtype) == dtype
+        ):
+            # the true values-only path: one scatter embed + the
+            # replace_values gather maps of the EXISTING template —
+            # no host-side acceleration-structure rebuild (from_csr
+            # re-derives ELL/DIA/dense metadata, which costs more
+            # than the refreshed solve itself at streaming rates)
+            A = old.replace_values(pat.embed_values(values, dtype))
+        else:
+            A = pat.template_matrix(
+                values, dtype, accel_formats=self._accel_for(pat),
+            )
+        if b is not None:
+            bb = np.asarray(b).reshape(-1)
+            if bb.shape[0] == pat.n:
+                bb = pat.embed_vector(bb, dtype)
+            if x0 is not None:
+                x0 = np.asarray(x0).reshape(-1)
+                if x0.shape[0] == pat.n:
+                    x0 = pat.embed_vector(x0, dtype)
+        # the cached template solver is shared mutable state: the
+        # sequential fallback and concurrent quarantine retries
+        # resetup it too — one critical section per refresh(+solve)
+        with entry.solver_lock:
+            entry.solver.resetup(A)
+            res = None if b is None else entry.solver.solve(bb, x0=x0)
+        self.metrics.inc("entry_resetups")
+        return res
+
     # ------------------------------------------------------------------
     # setup-artifact store (warm-boot serving, amgx_tpu.store)
 
@@ -1524,20 +1608,21 @@ class BatchedSolveService:
                         res = None
                         if entry is not None:
                             try:
-                                A = pat.template_matrix(
-                                    vals, grp.dtype, accel_formats=accel
+                                # same helper sessions use: values-only
+                                # refresh of the cached entry + one
+                                # isolated solve under its lock
+                                res = self.resetup_entry(
+                                    pat.fingerprint, vals, grp.dtype,
+                                    b=b, x0=x0,
                                 )
-                                # the cached template solver is shared
-                                # mutable state: serialize its
-                                # resetup+solve pair
-                                with entry.solver_lock:
-                                    entry.solver.resetup(A)
-                                    res = entry.solver.solve(b, x0=x0)
                                 self.metrics.inc(
                                     "quarantine_entry_reuses"
                                 )
-                            except BaseException:  # noqa: BLE001
-                                res = None  # isolated setup decides
+                            except Exception:  # noqa: BLE001 —
+                                # isolated setup decides; Ctrl-C must
+                                # not be absorbed into the SLOWEST
+                                # recovery path
+                                res = None
                         if res is None:
                             A = pat.template_matrix(
                                 vals, grp.dtype, accel_formats=accel

@@ -1,0 +1,41 @@
+"""Streaming solve sessions (transient-PDE serve workload).
+
+A session registers a sparsity fingerprint once and then streams
+``(values, b)`` pairs — the serve-level generalization of
+``AMGX_matrix_replace_coefficients`` + ``AMGX_solver_resetup`` for
+time-stepping workloads: values-only resetup through the hierarchy
+cache, resetup of step k+1 pipelined against the in-flight solve of
+step k, masked warm starts (previous x as x0), lockstep batching of
+concurrent sessions sharing a fingerprint, and drain/warm-boot
+persistence of the per-session streaming state.
+
+Entry points::
+
+    from amgx_tpu.serve import SolveGateway
+    gw = SolveGateway(store="/var/amgx").start()
+    sess = gw.open_session(A, tenant="cfd", lane="batch")
+    for k in range(steps):
+        t = sess.step(values_k, b_k)     # admitted as one ticket
+    x_final = t.result().x
+
+    # lockstep over B concurrent sessions sharing the fingerprint:
+    from amgx_tpu.sessions import SessionManager
+    mgr = SessionManager(service)
+    sessions = [mgr.open(A_i, session_id=f"s{i}") for i in range(B)]
+    tickets = mgr.step_all([(s, vals, b) for s ...])  # ONE vmapped
+                                                     # group, one sync
+"""
+
+from amgx_tpu.sessions.session import (
+    SESSION_KIND,
+    SessionManager,
+    SolveSession,
+    StepTicket,
+)
+
+__all__ = [
+    "SessionManager",
+    "SolveSession",
+    "StepTicket",
+    "SESSION_KIND",
+]

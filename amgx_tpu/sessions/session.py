@@ -1,0 +1,776 @@
+"""Streaming solve sessions: transient PDEs as a first-class serve
+workload.
+
+AmgX's dominant production pattern is time stepping: the same sparsity
+pattern solved every step with new coefficients (implicit CFD, heat,
+reservoir).  The one-shot serve path already amortizes setup across
+requests *of one instant*; a session amortizes it across *time* — a
+client registers a sparsity fingerprint once and then streams
+``(values, b)`` pairs:
+
+  open_session(A) ── registers (ro, ci, n, fingerprint) once
+       │
+       ▼
+  step(values_k, b_k)            per step, per session:
+       │ 1. prestage  — host-side resetup prep (value array coercion,
+       │               finite validation) runs WHILE the previous
+       │               step-group is still solving on the device —
+       │               this is the resetup/solve overlap, measured by
+       │               ``resetup_overlap_s``;
+       │ 2. resolve   — the previous step's result arrives through the
+       │               group's ONE shared host sync; its x becomes the
+       │               warm start (masked: a non-converged step's x is
+       │               never reused — zeros instead);
+       │ 3. submit    — the values-only fast path into the serve layer
+       │               (``_host`` tuple: no per-step pattern hashing),
+       │               x0 = warm start, dispatched without a fetch.
+       ▼
+  SessionManager.step_all(...)   B sessions sharing a fingerprint step
+                                 in lockstep: their steps form ONE
+                                 bucketed vmapped group — one hierarchy,
+                                 one compiled program, one host sync per
+                                 flushed step-group.
+
+The hierarchy itself rides the existing serve machinery: one setup per
+(fingerprint, config) in the hierarchy cache, per-step coefficients
+flowing through the traced batch-params rebuild (RAP-plan re-execution
++ ``replace_values`` gather maps inside the compiled program).  Every
+``resetup_every`` steps the session additionally refreshes the CACHED
+template solver through :meth:`BatchedSolveService.resetup_entry` so
+quarantine retries, store exports, and the PR 8 spectral-bound cache
+(``reestimate_eigs`` cadence) track the streamed values instead of the
+step-0 coefficients.
+
+Persistence: :meth:`SolveSession.save` writes a small manifest (step
+counter, warm-start x, status, the registered pattern) into the
+:class:`~amgx_tpu.store.store.ArtifactStore`; the hierarchy is the
+serve layer's existing warm-boot export.  A drained worker's sessions
+therefore survive a restart: ``warm_boot()`` + :meth:`SessionManager
+.restore` resume the stream at the saved step with ZERO coarsening
+calls and a bitwise-identical hierarchy (tests/test_sessions.py).
+
+Observability: the manager registers a ``sessions`` telemetry source
+(``amgx_session_*`` families), every sampled step records a
+``session_step`` root span with ``resetup`` → ``pad`` → ``dispatch``
+→ ``device`` → ``fetch`` children in the shared trace ring, and every
+resolved step lands a ``path="session_step"`` flight record.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from amgx_tpu.core.errors import StoreError
+from amgx_tpu.serve.service import (
+    BatchedSolveService,
+    _host_csr,
+    _resolve_dtype,
+)
+from amgx_tpu.telemetry import get_registry, telemetry_enabled, tracing
+
+SESSION_KIND = "solve_session"
+# sessions are keyed in the store without a dtype axis (the real dtype
+# lives in the manifest); this constant fills entry_key's dtype slot
+_SESSION_KEY_DTYPE = "session"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class StepTicket:
+    """Handle for one streamed step.  ``result()`` resolves through
+    the owning session so warm-start state updates exactly once no
+    matter who asks first (the session's next ``step`` or the
+    client)."""
+
+    __slots__ = ("session", "step", "ticket", "resetup_s", "_trace",
+                 "_t0", "_res", "_err")
+
+    def __init__(self, session: "SolveSession", step: int, ticket,
+                 resetup_s: float, trace, t0: float):
+        self.session = session
+        self.step = step
+        self.ticket = ticket
+        self.resetup_s = resetup_s
+        self._trace = trace
+        self._t0 = t0
+        self._res = None
+        self._err = None
+
+    def done(self) -> bool:
+        return (
+            self._res is not None
+            or self._err is not None
+            or self.ticket.done()
+        )
+
+    def result(self):
+        self.session._resolve_ticket(self)
+        if self._err is not None:
+            raise self._err
+        return self._res
+
+    def _service_ticket(self):
+        """The underlying serve SolveTicket (unwraps a gateway
+        ticket), for the overlap probe."""
+        return getattr(self.ticket, "_ticket", self.ticket)
+
+
+class SolveSession:
+    """One streamed transient-PDE solve: a registered sparsity pattern
+    plus per-step warm-start state.  Created by
+    :meth:`SessionManager.open` / :meth:`SessionManager.restore` (or
+    ``gateway.open_session``), never directly."""
+
+    def __init__(self, manager: "SessionManager", session_id: str,
+                 host: tuple, dtype, tenant: str, lane: str,
+                 deadline_s: Optional[float] = None):
+        self.manager = manager
+        self.session_id = session_id
+        # (row_offsets, col_indices, n, raw fingerprint): the one-time
+        # registration that makes every step a values-only submit
+        ro, ci, n, raw_fp = host
+        self._ro = np.asarray(ro)
+        self._ci = np.asarray(ci)
+        self.n = int(n)
+        self.nnz = int(self._ci.shape[0])
+        self.fingerprint = raw_fp
+        self.dtype, self._dtype_s = _resolve_dtype(dtype)
+        self.tenant = tenant
+        self.lane = lane
+        self.deadline_s = deadline_s
+        self.step_idx = 0  # steps RESOLVED so far
+        self.closed = False
+        self._last_x: Optional[np.ndarray] = None
+        self._last_status: Optional[int] = None
+        self._last_iters: Optional[int] = None
+        self._pending: Optional[StepTicket] = None
+        self._staged = None  # (values, b, t0, resetup_s, ctx)
+        # padded fingerprint memo (the hierarchy-cache key); resolved
+        # on first use through the service's pattern cache
+        self._padded_fp: Optional[str] = None
+
+    # -- warm-start state ----------------------------------------------
+
+    def _x0_for_next(self):
+        """(x0, warm): the previous step's solution when it CONVERGED,
+        else zeros — a diverged step's x must never poison the next
+        step's initial guess."""
+        if self._last_x is not None and self._last_status == 0:
+            return self._last_x, True
+        return None, False
+
+    @property
+    def last_x(self) -> Optional[np.ndarray]:
+        """The last resolved step's solution (converged or not) —
+        the implicit-Euler client's state vector.  Warm-start MASKING
+        is separate: ``_x0_for_next`` only reuses a CONVERGED x."""
+        return self._last_x
+
+    @property
+    def last_status(self) -> Optional[int]:
+        return self._last_status
+
+    @property
+    def last_iterations(self) -> Optional[int]:
+        return self._last_iters
+
+    # -- the three step phases -----------------------------------------
+
+    def _coerce_b(self, b) -> np.ndarray:
+        b = np.ascontiguousarray(
+            np.asarray(b, dtype=self.dtype).reshape(-1)
+        )
+        if b.shape[0] != self.n:
+            raise ValueError(
+                f"session {self.session_id}: expected length-{self.n} "
+                f"rhs, got {b.shape[0]}"
+            )
+        return b
+
+    def prestage(self, values, b=None):
+        """Phase 1 — host-side resetup prep for the NEXT step, safe to
+        run (and designed to run) while the previous step-group is
+        still solving on the device.  Coerces the coefficient/rhs
+        arrays and pre-validates them; the time spent here while the
+        previous group is dispatched-but-unfetched is the measured
+        resetup/solve overlap.
+
+        ``b`` may be deferred to :meth:`commit` — or passed as a
+        CALLABLE of the session, evaluated at commit time AFTER the
+        previous step resolves.  That is the implicit-Euler shape:
+        ``b_k`` depends on ``x_{k-1}``, but the coefficient resetup
+        does not, so the values prep still overlaps the in-flight
+        solve (``sess.prestage(vals, lambda s: s.last_x)``)."""
+        if self.closed:
+            raise RuntimeError(f"session {self.session_id} is closed")
+        if self._staged is not None:
+            raise RuntimeError(
+                "prestage called twice without a commit; a session "
+                "pipelines at depth one (x0 depends on the previous x)"
+            )
+        t0 = time.perf_counter()
+        ctx = tracing.new_trace()
+        overlapped = self._previous_in_flight()
+        values = np.ascontiguousarray(
+            np.asarray(values, dtype=self.dtype).reshape(-1)
+        )
+        if values.shape[0] != self.nnz:
+            raise ValueError(
+                f"session {self.session_id}: expected {self.nnz} "
+                f"coefficients, got {values.shape[0]}"
+            )
+        if b is not None and not callable(b):
+            b = self._coerce_b(b)
+        resetup_s = time.perf_counter() - t0
+        if ctx is not None:
+            tracing.record_span("resetup", t0, t0 + resetup_s, ctx)
+        self.manager._account_resetup(resetup_s, overlapped)
+        self._staged = (values, b, t0, resetup_s, ctx)
+        return self
+
+    def _previous_in_flight(self) -> bool:
+        """Is the previous step dispatched but not yet fetched?  True
+        means host work done NOW overlaps device execution."""
+        p = self._pending
+        if p is None or p._res is not None or p._err is not None:
+            return False
+        t = p._service_ticket()
+        batch = getattr(t, "_batch", None)
+        return batch is not None and not batch.fetched()
+
+    def commit(self, b=None) -> StepTicket:
+        """Phases 2+3 — resolve the previous step (its group's one
+        shared host sync; updates warm-start state) and submit the
+        prestaged step with the masked warm start.  ``b`` (array or
+        callable of the session) overrides a prestaged rhs; callables
+        evaluate AFTER the previous step resolves, so ``last_x`` is
+        the just-finished step's solution."""
+        if self._staged is None:
+            raise RuntimeError("commit without a prestage")
+        # consume the staged step UP FRONT: any failure below (a
+        # previous step's deadline/drain error surfacing in the
+        # resolve, a raising rhs callable, an admission shed) must
+        # leave the session retryable with a fresh prestage, not
+        # wedged on "prestage called twice"
+        (values, b0, t0, resetup_s, ctx), self._staged = (
+            self._staged, None,
+        )
+        if b is None:
+            b = b0
+        try:
+            if self._pending is not None:
+                self._resolve_ticket(self._pending)
+            if callable(b):
+                b = b(self)
+            if b is None:
+                raise ValueError(
+                    "no rhs: pass b to prestage or commit"
+                )
+            b = self._coerce_b(b)
+            x0, warm = self._x0_for_next()
+            step_idx = self.step_idx
+            mgr = self.manager
+            ticket = mgr._submit(
+                self, values, b, x0, _trace=ctx,
+            )
+        except BaseException as e:
+            if ctx is not None:
+                # close the sampled root: the 'resetup' child (and a
+                # gateway shed's non-root 'submit' span) already
+                # parent onto this root id — without this the export
+                # would carry dangling parent_ids (the PR 7 shed-path
+                # contract, upheld for failed session steps too)
+                tracing.record_span(
+                    "session_step", t0, time.perf_counter(), ctx,
+                    args={"session": self.session_id,
+                          "step": self.step_idx,
+                          "error": type(e).__name__},
+                    root=True,
+                )
+            raise
+        mgr._count("steps_total")
+        mgr._count("warm_starts_total" if warm else "cold_starts_total")
+        st = StepTicket(self, step_idx, ticket, resetup_s, ctx, t0)
+        self._pending = st
+        if ctx is not None:
+            # the step's root span: prestage through submit; children
+            # (resetup/submit/admission/pad/dispatch/device/fetch)
+            # parent onto it, so one session-labeled chain per step
+            tracing.record_span(
+                "session_step", t0, time.perf_counter(), ctx,
+                args={"session": self.session_id, "step": step_idx,
+                      "lane": self.lane, "tenant": self.tenant,
+                      "warm": warm},
+                root=True,
+            )
+        mgr._maybe_entry_resetup(self, values)
+        return st
+
+    def step(self, values, b) -> StepTicket:
+        """Stream one time step: ``prestage`` + ``commit`` in one
+        call.  For the fully pipelined lockstep form over many
+        sessions use :meth:`SessionManager.step_all`, which prestages
+        EVERY member before the group's single sync."""
+        self.prestage(values, b)
+        return self.commit()
+
+    def _abandon_stage(self, err=None):
+        """Drop a prestaged step WITHOUT submitting it (a lockstep
+        peer's failure aborts the whole group): clears the stage so
+        the session stays retryable and closes the sampled trace root
+        so the already-recorded ``resetup`` span does not dangle."""
+        if self._staged is None:
+            return
+        (_values, _b, t0, _rs, ctx), self._staged = self._staged, None
+        if ctx is not None:
+            tracing.record_span(
+                "session_step", t0, time.perf_counter(), ctx,
+                args={"session": self.session_id,
+                      "step": self.step_idx,
+                      "error": (
+                          type(err).__name__ if err is not None
+                          else "abandoned"
+                      )},
+                root=True,
+            )
+
+    def finish(self):
+        """Resolve the in-flight step, if any; returns the session's
+        last solution (``last_x``, or None before any resolved step).
+        Errors of the pending step are swallowed into the session
+        state (``last_status`` None) — ``finish`` is the drain/save
+        path, which must not raise."""
+        # a prestaged-but-uncommitted step is dropped, closing its
+        # sampled trace root (same contract as the step_all unwind)
+        self._abandon_stage()
+        p = self._pending
+        if p is not None:
+            try:
+                self._resolve_ticket(p)
+            except Exception:  # noqa: BLE001 — the failure is already
+                # captured in the session state; Ctrl-C propagates
+                pass
+        return None if self._last_x is None else self._last_x
+
+    def _resolve_ticket(self, st: StepTicket):
+        """Idempotently settle one step ticket and fold its outcome
+        into the warm-start state + telemetry."""
+        if st._res is not None or st._err is not None:
+            if st._err is not None:
+                raise st._err
+            return
+        try:
+            res = st.ticket.result()
+        except BaseException as e:
+            st._err = e
+            if self._pending is st:
+                self._pending = None
+                self._last_status = None  # never warm-start off an error
+                self.step_idx = st.step + 1
+            self.manager._count("step_failures_total")
+            raise
+        st._res = res
+        if self._pending is st:
+            self._pending = None
+            self._last_x = np.asarray(res.x)
+            self._last_status = int(res.status)
+            self._last_iters = int(res.iters)
+            self.step_idx = st.step + 1
+            self.manager._record_step(self, st, res)
+
+    # -- persistence ---------------------------------------------------
+
+    def save(self, store=None) -> bool:
+        """Persist this session's manifest (step counter, warm-start
+        x, status, registered pattern) to the store.  The hierarchy
+        itself persists through the serve layer's entry export; this
+        is only the per-session streaming state.  Returns False
+        (counted) on failure — persistence never raises into a
+        stream."""
+        return self.manager.save_session(self, store=store)
+
+    def close(self):
+        """Finish and deregister (the session stops counting as
+        open; its hierarchy stays cached for other sessions)."""
+        self.finish()
+        self.closed = True
+        self.manager._discard(self)
+
+
+class SessionManager:
+    """Owns the streaming sessions of one serve front (a
+    :class:`BatchedSolveService` or a
+    :class:`~amgx_tpu.serve.gateway.SolveGateway`).
+
+    Parameters
+    ----------
+    front: the service or gateway every step submits through.  With a
+        gateway, each streamed step is admitted as ONE ticket — lanes,
+        tenant quotas, deadline shedding and the concurrency budget
+        all apply per step.
+    store: overrides the service's artifact store for session
+        manifests (default: the service's own store).
+    resetup_every: every N streamed steps touching a fingerprint's
+        hierarchy entry, refresh the CACHED entry via
+        :meth:`BatchedSolveService.resetup_entry` so quarantine
+        retries / store exports / spectral-bound re-estimation
+        (``reestimate_eigs``) track the streamed values.  0 disables.
+        Env default: ``AMGX_TPU_SESSION_RESETUP_EVERY`` (64).
+    """
+
+    def __init__(self, front, store=None, resetup_every: Optional[int] = None):
+        from amgx_tpu.serve.gateway import SolveGateway
+
+        if isinstance(front, SolveGateway):
+            self.gateway: Optional[SolveGateway] = front
+            self.service: BatchedSolveService = front.service
+        else:
+            self.gateway = None
+            self.service = front
+        self.store = store if store is not None else self.service.store
+        if isinstance(self.store, str):
+            from amgx_tpu.store.store import ArtifactStore
+
+            self.store = ArtifactStore(self.store)
+        self.resetup_every = (
+            _env_int("AMGX_TPU_SESSION_RESETUP_EVERY", 64)
+            if resetup_every is None
+            else int(resetup_every)
+        )
+        self._lock = threading.Lock()
+        self._sessions: dict = {}
+        self._counters: dict = {}
+        self._times: dict = {"resetup_seconds_total": 0.0,
+                             "resetup_overlap_seconds_total": 0.0}
+        # per-fingerprint step counter driving the entry-refresh
+        # cadence: B lockstep sessions share ONE hierarchy entry, so
+        # the refresh rate must follow entry traffic, not per-session
+        # step counts (B sessions on per-session cadence N would
+        # refresh the same entry B/N times per step-group)
+        self._fp_steps: dict = {}
+        self.telemetry_name = get_registry().register("sessions", self)
+
+    # -- counters / telemetry ------------------------------------------
+
+    def _count(self, name: str, by: int = 1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def _account_resetup(self, seconds: float, overlapped: bool):
+        with self._lock:
+            self._times["resetup_seconds_total"] += seconds
+            if overlapped:
+                self._times["resetup_overlap_seconds_total"] += seconds
+
+    def telemetry_snapshot(self) -> dict:
+        """Registry source (kind="sessions"): the ``amgx_session_*``
+        families."""
+        with self._lock:
+            out = dict(self._counters)
+            out.update(self._times)
+            out["open"] = len(self._sessions)
+        return out
+
+    @property
+    def resetup_overlap_s(self) -> float:
+        with self._lock:
+            return self._times["resetup_overlap_seconds_total"]
+
+    @property
+    def resetup_s(self) -> float:
+        with self._lock:
+            return self._times["resetup_seconds_total"]
+
+    def _record_step(self, sess: SolveSession, st: StepTicket, res):
+        """Flight-record one resolved step (path="session_step") —
+        same degrade contract as every telemetry hook."""
+        if not telemetry_enabled():
+            return
+        self.service._flight_record(
+            fingerprint=sess._padded_fp or sess.fingerprint,
+            config=self.service.cfg_key,
+            lane=sess.lane,
+            tenant=sess.tenant,
+            iterations=int(res.iters),
+            final_residual=float(np.max(np.asarray(res.final_norm))),
+            status=int(res.status),
+            stages={"resetup": st.resetup_s,
+                    "step": max(time.perf_counter() - st._t0, 0.0)},
+            path="session_step",
+            trace_id=(
+                st._trace.trace_id if st._trace is not None else None
+            ),
+        )
+
+    # -- lifecycle -----------------------------------------------------
+
+    def open(self, A, *, session_id: Optional[str] = None,
+             tenant: str = "default", lane: str = "interactive",
+             dtype=None, deadline_s: Optional[float] = None,
+             x0=None) -> SolveSession:
+        """Register a sparsity fingerprint and return its streaming
+        session.  ``A`` (SparseMatrix or scipy CSR) contributes ONLY
+        structure + dtype default; per-step coefficients arrive via
+        ``step``.  ``x0`` seeds the first step's warm start."""
+        ro, ci, vals, n, raw_fp = _host_csr(A)
+        if session_id is None:
+            session_id = f"sess-{uuid.uuid4().hex[:12]}"
+        sess = SolveSession(
+            self, session_id,
+            (ro, ci, n, raw_fp),
+            dtype if dtype is not None else vals.dtype,
+            tenant, lane, deadline_s=deadline_s,
+        )
+        if x0 is not None:
+            sess._last_x = np.asarray(x0, dtype=sess.dtype).reshape(-1)
+            sess._last_status = 0
+        with self._lock:
+            self._sessions[session_id] = sess
+        self._count("opens_total")
+        return sess
+
+    def _discard(self, sess: SolveSession):
+        with self._lock:
+            if self._sessions.get(sess.session_id) is sess:
+                del self._sessions[sess.session_id]
+
+    def sessions(self) -> list:
+        with self._lock:
+            return list(self._sessions.values())
+
+    def get(self, session_id: str) -> Optional[SolveSession]:
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    # -- stepping ------------------------------------------------------
+
+    def _submit(self, sess: SolveSession, values, b, x0, _trace):
+        """One step into the serve layer via the values-only fast
+        path: the registered (ro, ci, n, fingerprint) tuple goes in as
+        ``_host`` so no per-step pattern extraction or hashing runs."""
+        host = (sess._ro, sess._ci, values, sess.n, sess.fingerprint)
+        front = self.gateway if self.gateway is not None else self.service
+        ticket = front.submit(
+            None, b, x0,
+            tenant=sess.tenant, lane=sess.lane,
+            deadline_s=sess.deadline_s,
+            _host=host, _trace=_trace,
+        )
+        if sess._padded_fp is None:
+            pat = self.service._patterns.get(sess.fingerprint)
+            if pat is not None:
+                sess._padded_fp = pat.fingerprint
+        return ticket
+
+    def step_all(self, steps) -> list:
+        """Lockstep pipelined step over many sessions: ``steps`` is a
+        list of ``(session, values, b)``.  Prestages EVERY member
+        first (all of that host resetup work overlaps the in-flight
+        previous group), then commits (ONE shared host sync resolves
+        every previous ticket, then all submits land in one batch
+        group), then flushes — the step-group dispatches with exactly
+        one host sync outstanding for its eventual fetch.  Returns the
+        StepTickets in order."""
+        staged = []
+        try:
+            for sess, values, b in steps:
+                sess.prestage(values, b)
+                staged.append(sess)
+            tickets = [sess.commit() for sess, _v, _b in steps]
+        except BaseException as e:
+            # one member's failure — bad input at prestage, or a
+            # typed admission shed at commit — must not wedge its
+            # lockstep peers: unwind every stage still pending so a
+            # retry of the whole group prestages cleanly.  (Members
+            # that already committed keep their in-flight tickets;
+            # their results resolve on the next step or finish().)
+            for sess in staged:
+                sess._abandon_stage(e)
+            raise
+        self.flush()
+        self._count("step_groups_total")
+        return tickets
+
+    def flush(self):
+        (self.gateway or self.service).flush()
+
+    def _maybe_entry_resetup(self, sess: SolveSession, values):
+        """The ``resetup_every`` cadence: refresh the cached template
+        hierarchy through the public values-only resetup API so the
+        entry (quarantine retries, exports, spectral bounds /
+        ``reestimate_eigs``) tracks the stream instead of the step-0
+        coefficients.  Counted per FINGERPRINT — every N submitted
+        steps touching the entry, whichever session lands on the
+        boundary — and best-effort: a missing entry (nothing built
+        yet) or a resetup failure never fails the step."""
+        n = self.resetup_every
+        if n <= 0:
+            return
+        with self._lock:
+            c = self._fp_steps.get(sess.fingerprint, 0) + 1
+            self._fp_steps[sess.fingerprint] = c
+        if c % n:
+            return
+        fp = sess._padded_fp or sess.fingerprint
+        try:
+            self.service.resetup_entry(fp, values, sess.dtype)
+            self._count("entry_resetups_total")
+        except KeyError:
+            pass  # no entry yet (first group still building)
+        except Exception:  # noqa: BLE001 — cadence refresh is an
+            # optimization; the batched path re-derives per step anyway
+            self._count("entry_resetup_failures_total")
+
+    # -- persistence ---------------------------------------------------
+
+    def _session_key(self, session_id: str, store=None):
+        """The ONE place session store keys derive (save and restore
+        must never diverge)."""
+        st = store if store is not None else self.store
+        if st is None:
+            raise StoreError("SessionManager has no artifact store")
+        return st.entry_key(
+            session_id, self.service.cfg_key, _SESSION_KEY_DTYPE,
+            kind=SESSION_KIND,
+        )
+
+    def save_session(self, sess: SolveSession, store=None) -> bool:
+        """Persist one session's streaming state (manifest + arrays).
+        Returns False (counted) instead of raising on any failure."""
+        st = store if store is not None else self.store
+        if isinstance(st, str):
+            from amgx_tpu.store.store import ArtifactStore
+
+            st = ArtifactStore(st)
+        if st is None:
+            self._count("save_failures_total")
+            return False
+        try:
+            arrays = {
+                "row_offsets": np.asarray(sess._ro),
+                "col_indices": np.asarray(sess._ci),
+            }
+            if sess._last_x is not None:
+                arrays["x"] = np.asarray(sess._last_x)
+            manifest = {
+                "kind": SESSION_KIND,
+                "session_id": sess.session_id,
+                "raw_fingerprint": sess.fingerprint,
+                "padded_fingerprint": sess._padded_fp,
+                "cfg_key": self.service.cfg_key,
+                "dtype": sess._dtype_s,
+                "n": sess.n,
+                "nnz": sess.nnz,
+                "step": sess.step_idx,
+                "last_status": sess._last_status,
+                "last_iterations": sess._last_iters,
+                "tenant": sess.tenant,
+                "lane": sess.lane,
+                "deadline_s": sess.deadline_s,
+            }
+            key = self._session_key(sess.session_id, store=st)
+            ok = st.put(key, arrays, manifest)
+        except Exception:  # noqa: BLE001 — persistence never raises
+            ok = False
+        self._count("saves_total" if ok else "save_failures_total")
+        return ok
+
+    def save_all(self) -> int:
+        """Finish and persist every open session (the drain
+        protocol); returns the number persisted."""
+        saved = 0
+        for sess in self.sessions():
+            sess.finish()
+            if self.save_session(sess):
+                saved += 1
+        return saved
+
+    def restore(self, session_id: str, *, tenant: Optional[str] = None,
+                lane: Optional[str] = None,
+                deadline_s: Optional[float] = None) -> SolveSession:
+        """Resume a persisted session: the manifest restores the step
+        counter, warm-start x and registered pattern; the hierarchy is
+        expected in the hierarchy cache already (``warm_boot()`` the
+        service first) so the resumed stream runs with zero coarsening
+        calls.  Raises :class:`StoreError` when the manifest is
+        missing/corrupt or was written under another config."""
+        if self.store is None:
+            raise StoreError("SessionManager has no artifact store")
+        got = self.store.get(self._session_key(session_id))
+        if got is None:
+            self._count("restore_failures_total")
+            raise StoreError(
+                f"no persisted session {session_id!r} for this "
+                "service's config"
+            )
+        manifest, arrays = got
+        try:
+            if manifest.get("kind") != SESSION_KIND:
+                raise StoreError(
+                    f"payload kind {manifest.get('kind')!r} is not a "
+                    "solve session"
+                )
+            if manifest.get("cfg_key") != self.service.cfg_key:
+                raise StoreError(
+                    "session was streamed under a different solver "
+                    "configuration"
+                )
+            host = (
+                np.asarray(arrays["row_offsets"]),
+                np.asarray(arrays["col_indices"]),
+                int(manifest["n"]),
+                str(manifest["raw_fingerprint"]),
+            )
+            if deadline_s is None:
+                dl = manifest.get("deadline_s")
+                deadline_s = None if dl is None else float(dl)
+            sess = SolveSession(
+                self, session_id, host, manifest.get("dtype"),
+                tenant if tenant is not None
+                else str(manifest.get("tenant", "default")),
+                lane if lane is not None
+                else str(manifest.get("lane", "interactive")),
+                deadline_s=deadline_s,
+            )
+            sess.step_idx = int(manifest.get("step", 0))
+            sess._padded_fp = manifest.get("padded_fingerprint")
+            if "x" in arrays:
+                sess._last_x = np.array(arrays["x"])
+                ls = manifest.get("last_status")
+                sess._last_status = None if ls is None else int(ls)
+            li = manifest.get("last_iterations")
+            sess._last_iters = None if li is None else int(li)
+        except StoreError:
+            self._count("restore_failures_total")
+            raise
+        except Exception as e:
+            self._count("restore_failures_total")
+            raise StoreError(
+                f"malformed session manifest for {session_id!r}: {e}"
+            ) from e
+        with self._lock:
+            self._sessions[session_id] = sess
+        self._count("restores_total")
+        return sess
+
+    def drain(self) -> dict:
+        """Session-level graceful handoff over a bare service: flush,
+        finish every stream, persist manifests AND the hierarchy
+        cache.  (Gateway-fronted managers normally go through
+        ``gateway.drain()``, which calls :meth:`save_all` as part of
+        its protocol.)"""
+        self.flush()
+        saved = self.save_all()
+        exported = self.service.export_all_entries()
+        return {"sessions_saved": saved, "entries_exported": exported}

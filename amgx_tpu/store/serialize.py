@@ -630,15 +630,36 @@ def build_solver(manifest: dict, tree, cfg=None):
     )
     t0 = time.perf_counter()
     solver._import_setup(tree)
+    # memo-parity audit (PR 9): payloads written before the
+    # meta-before-flatten ordering (or through _export_setup callers
+    # that never fingerprinted) restore WITHOUT the structure memo,
+    # so the first replace_values/serve submit on the restored
+    # operator would rehash the pattern a cold-built one already
+    # carries — reattach it from the manifest, which recorded the
+    # same matrix's fingerprint at save time
+    A = solver.A
+    if (
+        A is not None
+        and getattr(A, "_fingerprint_cache", None) is None
+        and manifest.get("fingerprint")
+    ):
+        object.__setattr__(
+            A, "_fingerprint_cache", str(manifest["fingerprint"])
+        )
     solver.restore_time = time.perf_counter() - t0
     return solver
 
 
 def save_setup(solver, path) -> dict:
     """Persist a set-up solver to ``path``; returns the manifest."""
+    # meta BEFORE flatten (same order as the serve-entry exporter):
+    # solver_meta's setup_key() memoizes the finest operator's
+    # fingerprint, so _smat_spec persists it and the restored matrix
+    # serves replace_values/serve submits without rehashing — the
+    # restore path propagates memos exactly like a cold-built solver
+    manifest = solver_meta(solver)
     tree = solver._export_setup()
     spec, arrays = flatten(tree)
-    manifest = solver_meta(solver)
     manifest["spec"] = spec
     write_payload(path, arrays, manifest)
     return manifest

@@ -129,7 +129,7 @@ _CACHE_RENAME = {
 # rendered as plain counters
 _SERVE_SKIP = {
     "buckets", "latency", "lanes", "profile",
-    "ticket_p50_s", "ticket_p99_s",
+    "ticket_p50_s", "ticket_p99_s", "tenant_device_s",
 }
 
 
@@ -253,6 +253,14 @@ def gateway_families(fams: FamilyTable, comp: str, snap: dict) -> None:
             fams.add("amgx_admission_tenant_tokens", "gauge",
                      "remaining token-bucket quota per tenant", tl,
                      counts["tokens"])
+    for tenant, lanes in (snap.get("tenant_device_s") or {}).items():
+        for lane, secs in lanes.items():
+            fams.add("amgx_gateway_tenant_device_seconds_total",
+                     "counter",
+                     "device-execution seconds attributed per "
+                     "tenant/lane (each ticket's even share of its "
+                     "group's device time — fleet cost accounting)",
+                     {**labels, "tenant": tenant, "lane": lane}, secs)
     rec = snap.get("recorder") or {}
     fams.add("amgx_flight_records_total", "counter",
              "per-solve flight-recorder records", labels,
@@ -352,6 +360,30 @@ def recorder_families(fams: FamilyTable, comp: str, snap: dict) -> None:
                  {**labels, "kind": kind}, n)
 
 
+def session_families(fams: FamilyTable, comp: str, snap: dict) -> None:
+    """SessionManager.telemetry_snapshot() -> amgx_session_* families
+    (the streaming transient-PDE workload: step/warm-start counts,
+    resetup-under-solve overlap seconds, persistence outcomes)."""
+    labels = {"component": comp}
+    for k, v in snap.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if k == "open":
+            fams.add("amgx_session_open", "gauge",
+                     "streaming sessions currently open", labels, v)
+        elif isinstance(v, float):
+            # float accumulators are seconds totals (resetup /
+            # resetup-overlap)
+            name = k if k.endswith("_seconds_total") \
+                else f"{k}_seconds_total"
+            fams.add(f"amgx_session_{name}", "counter",
+                     f"session seconds accumulator {k}", labels, v)
+        else:
+            name = k if k.endswith("_total") else f"{k}_total"
+            fams.add(f"amgx_session_{name}", "counter",
+                     f"session counter {k}", labels, v)
+
+
 def tracing_families(fams: FamilyTable, comp: str, snap: dict) -> None:
     labels = {"component": comp}
     fams.add("amgx_trace_spans_total", "counter",
@@ -381,6 +413,7 @@ _RENDERERS = {
     "gateway": gateway_families,
     "store": store_families,
     "solvers": solver_families,
+    "sessions": session_families,
     "tracing": tracing_families,
     "recorder": recorder_families,
 }

@@ -445,6 +445,42 @@ def _sstep_record():
         return {"error": str(e)}
 
 
+def _session_record():
+    """Streaming solve sessions (PR 9): steps/s on the implicit-Euler
+    sequence vs the naive per-step resubmit baseline and hand-rolled
+    lockstep batching (ci/session_bench.py, reduced steps).  Guarded —
+    must never take the headline bench down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.session_bench import run as session_run
+
+        rec, problems = session_run(steps=8, reps=2)
+        out = {
+            k: rec[k]
+            for k in (
+                "value",
+                "unit",
+                "sessions_steps_per_s",
+                "naive_steps_per_s",
+                "lockstep_nowarm_steps_per_s",
+                "speedup_vs_lockstep",
+                "resetup_overlap_s",
+                "host_syncs_per_window",
+                "ok",
+            )
+            if k in rec
+        }
+        if problems:
+            out["problems"] = problems
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: session record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _telemetry_record():
     """Telemetry overhead A/B (armed sample=0 vs disarmed, one warmed
     service; ci/telemetry_check.py, reduced reps) plus exposition /
@@ -600,6 +636,10 @@ def main():
     sstep_rec = _sstep_record()
     print(f"bench: sstep {sstep_rec}", file=sys.stderr)
 
+    # ---- streaming solve sessions ----------------------------------
+    session_rec = _session_record()
+    print(f"bench: session {session_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -623,6 +663,7 @@ def main():
                 "setup": setup_rec,
                 "telemetry": telemetry_rec,
                 "sstep": sstep_rec,
+                "session": session_rec,
             }
         )
     )

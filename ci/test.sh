@@ -52,14 +52,26 @@ JAX_PLATFORMS=cpu python ci/setup_bench.py
 # reductions per s steps (monitored PCG: 3 per step).
 JAX_PLATFORMS=cpu python ci/smoother_bench.py
 
+# ---- streaming solve sessions: steps/s + pipelining floors -----------
+# One JSON line; non-zero exit when the session subsystem drops below
+# 1.5x steps/s over the naive per-step one-shot resubmit baseline on
+# the B=8 32^2 implicit-Euler sequence (or below hand-rolled lockstep
+# batching), when a measured window performs more than one host sync
+# per flushed step-group, or when no resetup work overlapped an
+# in-flight solve (pipelining regression).
+JAX_PLATFORMS=cpu python ci/session_bench.py
+
 # ---- unified telemetry: exposition + tracing + overhead --------------
 # One JSON line; non-zero exit when the Prometheus exposition fails to
-# parse or exports fewer than 25 metric names across the serve /
-# admission / store / cache / setup-phase sources, when a sampled
-# gateway request does not produce a connected
+# parse or exports fewer than 30 metric names across the serve /
+# admission / store / cache / setup-phase / solver / session sources,
+# when a sampled gateway request does not produce a connected
 # submit->admission->pad->dispatch->device->fetch span chain in the
-# Chrome trace JSON, or when armed telemetry (sample=0) costs more
-# than 3% of serve throughput vs disarmed.
+# Chrome trace JSON, when a sampled streaming-session step does not
+# produce its session-labeled chain, or when armed telemetry
+# (sample=0) costs more than 3% of serve throughput vs disarmed
+# (noise-hardened: min of floor/pair statistics, time-diversified
+# retries).
 JAX_PLATFORMS=cpu python ci/telemetry_check.py
 
 # ---- native C ABI (VERDICT r4 #9) -----------------------------------

@@ -1,0 +1,650 @@
+"""Streaming solve sessions (amgx_tpu.sessions): values-only
+streaming, masked warm starts, pipelined resetup/solve overlap,
+one-sync-per-step-group, drain→warm-boot persistence, gateway
+admission integration, and the public resetup_entry API."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+from amgx_tpu.io.poisson import poisson_scipy
+from amgx_tpu.serve import BatchedSolveService, SolveGateway
+from amgx_tpu.sessions import SessionManager
+
+pytestmark = pytest.mark.serve
+
+# time-stepping config: ABSOLUTE convergence at the truncation scale
+# (RELATIVE_INI would move the goalpost with the warm start)
+STEP_CFG = (
+    '{"config_version": 2, "solver": {"scope": "main", "solver": "PCG",'
+    ' "max_iters": 300, "tolerance": 1e-6,'
+    ' "monitor_residual": 1, "convergence": "ABSOLUTE",'
+    ' "preconditioner": {"scope": "jac", "solver": "BLOCK_JACOBI",'
+    ' "relaxation_factor": 0.9, "max_iters": 2,'
+    ' "monitor_residual": 0}}}'
+)
+
+AMG_CFG = (
+    '{"config_version": 2, "solver": {"scope": "main", "solver": "PCG",'
+    ' "max_iters": 100, "tolerance": 1e-8, "monitor_residual": 1,'
+    ' "convergence": "RELATIVE_INI",'
+    ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+    ' "algorithm": "AGGREGATION", "selector": "SIZE_8",'
+    ' "smoother": {"scope": "j", "solver": "BLOCK_JACOBI",'
+    ' "relaxation_factor": 0.8, "monitor_residual": 0},'
+    ' "presweeps": 1, "postsweeps": 1, "max_iters": 1,'
+    ' "min_coarse_rows": 16, "max_levels": 10,'
+    ' "structure_reuse_levels": -1,'
+    ' "coarse_solver": "DENSE_LU_SOLVER", "cycle": "V",'
+    ' "monitor_residual": 0}}}'
+)
+
+
+def _heat_workload(nx=12, dt=2.0, seed=0):
+    """Implicit-Euler heat sequence on an nx² grid: returns
+    (A0 csr, values(k), u0, f)."""
+    base = poisson_scipy((nx, nx)).tocsr()
+    base.sort_indices()
+    n = base.shape[0]
+    rid = np.repeat(np.arange(n), np.diff(base.indptr))
+    dpos = np.flatnonzero(rid == base.indices)
+
+    def values(k):
+        v = dt * (1.0 + 0.02 * np.sin(0.4 * k)) * base.data.copy()
+        v[dpos] += 1.0 + dt * 0.5
+        return v
+
+    A0 = sps.csr_matrix(
+        (values(0), base.indices, base.indptr), shape=base.shape
+    )
+    A0.sort_indices()
+    rng = np.random.default_rng(seed)
+    u0 = rng.standard_normal(n)
+    xx, yy = np.meshgrid(np.linspace(0, 1, nx), np.linspace(0, 1, nx))
+    f = (np.sin(np.pi * xx) * np.sin(np.pi * yy)).ravel()
+    return A0, values, u0, f, n
+
+
+def _rhs(u0, f, dt=2.0):
+    return lambda sess: (
+        (u0 if sess.last_x is None else sess.last_x) + dt * f
+    )
+
+
+# ---------------------------------------------------------------------------
+# streaming correctness
+
+
+def test_session_stream_matches_reference():
+    """A streamed sequence reproduces the per-step direct-solver
+    trajectory (warm starts change the iteration path, not the
+    answer)."""
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.solvers.registry import create_solver, make_nested
+
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    mgr = SessionManager(svc)
+    sess = mgr.open(A0, session_id="ref")
+
+    solver = make_nested(
+        create_solver(AMGConfig.from_string(STEP_CFG), "default")
+    )
+    x_ref = u0
+    t = None
+    for k in range(4):
+        t = sess.step(values(k), _rhs(u0, f))
+        mgr.flush()
+        A = SparseMatrix.from_csr(A0.indptr, A0.indices, values(k))
+        if k == 0:
+            solver.setup(A)
+        else:
+            solver.resetup(A)
+        r = solver.solve(x_ref + 2.0 * f)
+        x_ref = np.asarray(r.x)
+    res = t.result()
+    assert int(res.status) == 0
+    assert sess.step_idx == 4
+    # both trajectories solved to ABSOLUTE 1e-6 — they agree to the
+    # propagated solver error, far below the solution scale
+    assert np.max(np.abs(sess.last_x - x_ref)) < 1e-4
+
+
+def test_warm_start_strictly_fewer_iterations():
+    """The streamed sequence converges in strictly fewer TOTAL inner
+    iterations with the x0 warm start than with zero guesses."""
+    A0, values, u0, f, n = _heat_workload()
+
+    def run(warm: bool):
+        svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+        mgr = SessionManager(svc)
+        sess = mgr.open(A0, session_id="w")
+        total = 0
+        x = u0
+        for k in range(6):
+            b = x + 2.0 * f
+            if warm:
+                t = sess.step(values(k), b)
+            else:
+                # same stream, warm start suppressed
+                sess.prestage(values(k), b)
+                sess._last_status = None
+                t = sess.commit()
+            mgr.flush()
+            res = t.result()
+            assert int(res.status) == 0
+            total += int(res.iters)
+            x = np.asarray(res.x)
+        return total
+
+    warm_total = run(True)
+    cold_total = run(False)
+    assert warm_total < cold_total
+
+
+def test_diverged_step_not_reused_as_x0():
+    """A non-converged step's x is never the next x0 — the warm start
+    is MASKED to converged members."""
+    # a config that cannot converge: 1 iteration, absurd tolerance
+    cfg = STEP_CFG.replace('"max_iters": 300', '"max_iters": 1') \
+                  .replace('"tolerance": 1e-6', '"tolerance": 1e-30')
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=cfg, max_batch=4)
+    mgr = SessionManager(svc)
+    sess = mgr.open(A0, session_id="div")
+    for k in range(3):
+        t = sess.step(values(k), u0)
+        mgr.flush()
+        res = t.result()
+        assert int(res.status) != 0  # never converges
+    snap = mgr.telemetry_snapshot()
+    # first step is always cold; the two later steps must ALSO be
+    # cold because the previous steps did not converge
+    assert snap["cold_starts_total"] == 3
+    assert snap.get("warm_starts_total", 0) == 0
+    assert sess.last_x is not None  # state kept, just not reused
+
+
+def test_deferred_rhs_callable_sees_previous_x():
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    mgr = SessionManager(svc)
+    sess = mgr.open(A0, session_id="cb")
+    seen = []
+
+    def rhs(s):
+        seen.append(None if s.last_x is None else np.array(s.last_x))
+        return (u0 if s.last_x is None else s.last_x) + 2.0 * f
+
+    for k in range(2):
+        sess.prestage(values(k), rhs)
+        t = sess.commit()
+        mgr.flush()
+    t.result()
+    assert seen[0] is None
+    # the second step's rhs saw the FIRST step's solution
+    assert seen[1] is not None and np.linalg.norm(seen[1]) > 0
+
+
+def test_failed_resolve_does_not_wedge_stream():
+    """A previous step failing at its resolve (deadline expiry, drain
+    force-fail) surfaces in the NEXT step() — which must leave the
+    session retryable (fresh prestage), cold-starting past the failed
+    step, never wedged on 'prestage called twice'."""
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    mgr = SessionManager(svc)
+    sess = mgr.open(A0, session_id="boom")
+    sess.step(values(0), u0)
+    mgr.flush()
+
+    class _Boom:
+        def result(self):
+            raise RuntimeError("boom")
+
+        def done(self):
+            return True
+
+    sess._pending.ticket = _Boom()
+    with pytest.raises(RuntimeError, match="boom"):
+        sess.step(values(1), u0)
+    # retry works, and the failed step's x is NOT warm-started from
+    t = sess.step(values(2), u0)
+    mgr.flush()
+    assert int(t.result().status) == 0
+    snap = mgr.telemetry_snapshot()
+    assert snap["step_failures_total"] == 1
+    assert snap["cold_starts_total"] >= 2  # first step + post-failure
+
+
+def test_step_all_unwinds_on_member_prestage_failure():
+    """A lockstep member with bad input must not wedge its peers:
+    step_all unwinds the stages already made, and a corrected retry
+    of the whole group succeeds."""
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    mgr = SessionManager(svc)
+    sessions = [mgr.open(A0, session_id=f"u{i}") for i in range(3)]
+    bad = [(s, values(0), u0) for s in sessions[:2]]
+    bad.append((sessions[2], values(0)[:-5], u0))  # wrong nnz
+    with pytest.raises(ValueError, match="coefficients"):
+        mgr.step_all(bad)
+    assert all(s._staged is None for s in sessions)
+    tickets = mgr.step_all([(s, values(0), u0) for s in sessions])
+    assert all(int(t.result().status) == 0 for t in tickets)
+
+
+def test_step_all_unwinds_on_commit_shed(monkeypatch):
+    """A typed admission shed mid-commit must not leave the later
+    lockstep members staged: the whole group retries cleanly."""
+    from amgx_tpu.core.errors import AdmissionRejected
+
+    A0, values, u0, f, n = _heat_workload()
+    gw = SolveGateway(config=STEP_CFG, max_batch=4)
+    mgr = gw.sessions
+    sessions = [mgr.open(A0, session_id=f"c{i}") for i in range(3)]
+    orig, calls = gw.submit, {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:  # second member of the first wave sheds
+            raise AdmissionRejected(
+                "injected shed", retry_after_s=0.01,
+                reason="overloaded",
+            )
+        return orig(*a, **k)
+
+    monkeypatch.setattr(gw, "submit", flaky)
+    with pytest.raises(AdmissionRejected):
+        mgr.step_all([(s, values(0), u0) for s in sessions])
+    # the shed member consumed its stage; the NOT-yet-committed peer
+    # was unwound — nobody is left staged
+    assert all(s._staged is None for s in sessions)
+    tickets = mgr.step_all([(s, values(0), u0) for s in sessions])
+    results = [t.result() for t in tickets]
+    assert all(int(r.status) == 0 for r in results)
+
+
+def test_prestage_twice_raises_and_step_recovers():
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    mgr = SessionManager(svc)
+    sess = mgr.open(A0, session_id="pp")
+    sess.prestage(values(0), u0)
+    with pytest.raises(RuntimeError, match="prestage called twice"):
+        sess.prestage(values(0), u0)
+    t = sess.commit()
+    mgr.flush()
+    assert int(t.result().status) == 0
+
+
+# ---------------------------------------------------------------------------
+# pipelining contracts
+
+
+def test_one_host_sync_per_step_group():
+    """B lockstep sessions × K steps cost exactly K host syncs — one
+    shared fetch per flushed step-group."""
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    mgr = SessionManager(svc)
+    sessions = [mgr.open(A0, session_id=f"s{i}") for i in range(4)]
+    h0 = svc.metrics.get("host_syncs")
+    for k in range(5):
+        mgr.step_all([
+            (s, values(k), _rhs(u0, f)) for s in sessions
+        ])
+    for s in sessions:
+        s.finish()
+    assert svc.metrics.get("host_syncs") - h0 == 5
+    assert svc.metrics.get("solved") == 20
+
+
+def test_resetup_overlap_recorded():
+    """Prestage of step k+1 runs while the step-k group is dispatched
+    but unfetched: the manager's overlap accumulator must see it."""
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    mgr = SessionManager(svc)
+    sessions = [mgr.open(A0, session_id=f"o{i}") for i in range(2)]
+    for k in range(4):
+        mgr.step_all([
+            (s, values(k), _rhs(u0, f)) for s in sessions
+        ])
+    for s in sessions:
+        s.finish()
+    assert mgr.resetup_overlap_s > 0.0
+    assert mgr.resetup_s >= mgr.resetup_overlap_s
+
+
+# ---------------------------------------------------------------------------
+# public resetup_entry API (satellite: quarantine dedupe)
+
+
+def test_resetup_entry_refreshes_cached_hierarchy():
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    res = svc.solve_many([(A0, u0)])
+    assert int(res[0].status) == 0
+    raw_fp = getattr(A0, "_amgx_tpu_fp")
+    v1 = values(3)
+    assert svc.resetup_entry(raw_fp, v1) is None  # no b -> no solve
+    assert svc.metrics.get("entry_resetups") == 1
+    # the cached template solver's finest operator now carries v1
+    pat = svc._patterns[raw_fp]
+    entry = svc.cache.peek(pat.fingerprint, svc.cfg_key,
+                           np.dtype(np.float64))
+    got = pat.extract_values(np.asarray(entry.solver.A.values))
+    assert np.array_equal(got, v1)
+    # with b, the refreshed solver solves inside the same lock
+    res2 = svc.resetup_entry(raw_fp, v1, b=u0)
+    assert int(res2.status) == 0
+    A1 = sps.csr_matrix((v1, A0.indices, A0.indptr), shape=A0.shape)
+    x_ref = np.asarray(svc.solve_many([(A1, u0)])[0].x)
+    assert np.allclose(np.asarray(res2.x)[:n], x_ref, atol=1e-5)
+
+
+def test_resetup_entry_unknown_fingerprint_raises():
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    with pytest.raises(KeyError):
+        svc.resetup_entry("no-such-fp", np.ones(5))
+
+
+# ---------------------------------------------------------------------------
+# persistence: drain -> warm boot -> restore
+
+
+def test_session_drain_warmboot_restore_bitwise(tmp_path):
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(
+        config=AMG_CFG, max_batch=4, store=str(tmp_path)
+    )
+    mgr = SessionManager(svc)
+    sess = mgr.open(A0, session_id="restore-me", deadline_s=30.0)
+    for k in range(3):
+        sess.step(values(k), _rhs(u0, f))
+        mgr.flush()
+    report = mgr.drain()
+    assert report["sessions_saved"] == 1
+    assert report["entries_exported"] >= 1
+    saved_x = np.array(sess.last_x)
+    pat_fp = sess._padded_fp
+    entry1 = svc.cache.peek(pat_fp, svc.cfg_key, np.dtype(np.float64))
+
+    # "new process": fresh service + manager over the same store
+    svc2 = BatchedSolveService(
+        config=AMG_CFG, max_batch=4, store=str(tmp_path)
+    )
+    assert svc2.warm_boot() >= 1
+    mgr2 = SessionManager(svc2)
+    sess2 = mgr2.restore("restore-me")
+    assert sess2.step_idx == 3
+    assert sess2.deadline_s == 30.0  # per-step deadline survives
+    assert np.array_equal(np.asarray(sess2.last_x), saved_x)
+
+    # the restored hierarchy is bitwise-identical and was NOT re-coarsened
+    entry2 = svc2.cache.peek(pat_fp, svc2.cfg_key, np.dtype(np.float64))
+    assert entry2 is not None
+    amg2 = entry2.solver.precond
+    assert amg2.setup_stats["coarsen_calls"] == 0
+    assert amg2.setup_stats["restored"] is True
+    amg1 = entry1.solver.precond
+    assert len(amg1.levels) == len(amg2.levels)
+    for l1, l2 in zip(amg1.levels, amg2.levels):
+        assert np.array_equal(np.asarray(l1.A.values),
+                              np.asarray(l2.A.values))
+        assert np.array_equal(np.asarray(l1.A.col_indices),
+                              np.asarray(l2.A.col_indices))
+
+    # the resumed stream continues as a cache HIT (no setup)
+    t = sess2.step(values(3), _rhs(u0, f))
+    mgr2.flush()
+    assert int(t.result().status) == 0
+    assert sess2.step_idx == 4
+    m = svc2.metrics.snapshot()
+    assert m.get("cache_hits", 0) >= 1
+    assert m.get("setups", 0) == 0
+    assert amg2.setup_stats["coarsen_calls"] == 0
+
+
+def test_restore_missing_session_raises(tmp_path):
+    from amgx_tpu.core.errors import StoreError
+
+    svc = BatchedSolveService(
+        config=STEP_CFG, max_batch=4, store=str(tmp_path)
+    )
+    mgr = SessionManager(svc)
+    with pytest.raises(StoreError):
+        mgr.restore("never-saved")
+    assert (
+        mgr.telemetry_snapshot().get("restore_failures_total", 0) == 1
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite: store-restored solver replace_values parity
+
+
+def test_restored_replace_values_bitwise_and_memoized(tmp_path):
+    """restore → replace_values → solve is BITWISE identical to
+    cold-built → replace_values → solve, and the restored operator
+    carries the fingerprint memo a cold-built one has (no per-swap
+    rehash)."""
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.core.matrix import SparseMatrix
+    from amgx_tpu.solvers.base import Solver
+    from amgx_tpu.solvers.registry import create_solver, make_nested
+
+    A0, values, u0, f, n = _heat_workload()
+    A = SparseMatrix.from_csr(A0.indptr, A0.indices, values(0))
+    cold = make_nested(
+        create_solver(AMGConfig.from_string(AMG_CFG), "default")
+    )
+    cold.setup(A)
+    path = tmp_path / "s.npz"
+    cold.save_setup(path)
+
+    restored = Solver.load_setup(path)
+    # memo parity: the restored finest operator serves its
+    # fingerprint without rehashing, exactly like the cold-built one
+    assert getattr(restored.A, "_fingerprint_cache", None) is not None
+    assert restored.A.fingerprint() == A.fingerprint()
+
+    v1 = values(2)
+    A_cold = cold.A.replace_values(v1)
+    A_rest = restored.A.replace_values(v1)
+    # the structure memo rides replace_values on BOTH paths
+    assert getattr(A_rest, "_fingerprint_cache", None) \
+        == getattr(A_cold, "_fingerprint_cache", None)
+    cold.resetup(A_cold)
+    restored.resetup(A_rest)
+    rc = cold.solve(u0)
+    rr = restored.solve(u0)
+    assert int(rr.iters) == int(rc.iters)
+    assert int(rr.status) == int(rc.status)
+    assert np.array_equal(np.asarray(rr.x), np.asarray(rc.x))
+
+
+# ---------------------------------------------------------------------------
+# gateway integration: admission per step, tenant device-seconds
+
+
+def test_gateway_session_steps_admitted_as_tickets(tmp_path):
+    A0, values, u0, f, n = _heat_workload()
+    gw = SolveGateway(config=STEP_CFG, max_batch=4,
+                      store=str(tmp_path))
+    sess = gw.open_session(A0, session_id="gs", tenant="cfd",
+                           lane="batch")
+    for k in range(3):
+        t = sess.step(values(k), _rhs(u0, f))
+        gw.flush()
+    assert int(t.result().status) == 0
+    assert gw.metrics.get("gateway_admitted") == 3
+    # per-tenant/lane device seconds metered (counter only)
+    td = gw.telemetry_snapshot()["tenant_device_s"]
+    assert td.get("cfd", {}).get("batch", 0.0) > 0.0
+    # drain persists the session next to the hierarchy export
+    report = gw.drain(timeout_s=10.0)
+    assert report["sessions_saved"] == 1
+    assert report["exported"] >= 1
+
+
+def test_gateway_session_step_shed_by_quota():
+    from amgx_tpu.core.errors import AdmissionRejected
+    from amgx_tpu.serve.admission import TenantQuota
+
+    A0, values, u0, f, n = _heat_workload()
+    gw = SolveGateway(
+        config=STEP_CFG, max_batch=4,
+        default_quota=TenantQuota(rate=0.0, burst=1.0),
+    )
+    sess = gw.open_session(A0, session_id="q")
+    t = sess.step(values(0), u0)  # burst token
+    gw.flush()
+    assert int(t.result().status) == 0
+    with pytest.raises(AdmissionRejected):
+        sess.step(values(1), u0)
+    # the failed step left no staged residue: the stream can retry
+    assert sess._staged is None
+    assert gw.metrics.get("gateway_sheds") == 1
+
+
+def test_tenant_device_seconds_prometheus():
+    from amgx_tpu.telemetry import get_registry
+
+    A0, values, u0, f, n = _heat_workload()
+    gw = SolveGateway(config=STEP_CFG, max_batch=4)
+    for tenant in ("alpha", "beta"):
+        t = gw.submit(A0, u0, tenant=tenant)
+        gw.flush()
+        assert int(t.result().status) == 0
+    text = get_registry().render_prometheus()
+    lines = [
+        ln for ln in text.splitlines()
+        if ln.startswith("amgx_gateway_tenant_device_seconds_total{")
+    ]
+    tenants = {ln.split('tenant="')[1].split('"')[0] for ln in lines}
+    assert {"alpha", "beta"} <= tenants
+    for ln in lines:
+        if 'tenant="alpha"' in ln or 'tenant="beta"' in ln:
+            assert float(ln.rsplit(" ", 1)[1]) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# observability: amgx_session_* families, trace chains, flight records
+
+
+def test_session_prometheus_families():
+    from amgx_tpu.telemetry import get_registry
+
+    A0, values, u0, f, n = _heat_workload()
+    svc = BatchedSolveService(config=STEP_CFG, max_batch=4)
+    mgr = SessionManager(svc)
+    sess = mgr.open(A0, session_id="prom")
+    for k in range(2):
+        sess.step(values(k), _rhs(u0, f))
+        mgr.flush()
+    sess.finish()
+    text = get_registry().render_prometheus()
+    names = {
+        ln.split("{")[0].split(" ")[0]
+        for ln in text.splitlines()
+        if ln and not ln.startswith("#")
+    }
+    for required in (
+        "amgx_session_open",
+        "amgx_session_steps_total",
+        "amgx_session_warm_starts_total",
+        "amgx_session_resetup_seconds_total",
+        "amgx_session_resetup_overlap_seconds_total",
+    ):
+        assert required in names, f"{required} missing"
+
+
+def test_session_trace_chain_and_flight_records():
+    from amgx_tpu.telemetry import tracing
+
+    tracing.set_sample_rate(1.0)
+    tracing.clear()
+    try:
+        A0, values, u0, f, n = _heat_workload()
+        gw = SolveGateway(config=STEP_CFG, max_batch=4)
+        sess = gw.open_session(A0, session_id="traced")
+        t = None
+        for k in range(3):
+            t = sess.step(values(k), _rhs(u0, f))
+            gw.flush()
+        t.result()
+        ev = tracing.export_chrome()["traceEvents"]
+        by_trace = {}
+        roots = {}
+        for e in ev:
+            tid = e["args"].get("trace_id")
+            if tid:
+                by_trace.setdefault(tid, set()).add(e["name"])
+                if e["name"] == "session_step":
+                    roots[tid] = e["args"]
+        chains = [
+            tid for tid, names in by_trace.items()
+            if "session_step" in names
+            and {"submit", "resetup", "pad", "dispatch", "device",
+                 "fetch"} <= names
+        ]
+        assert chains, "no connected session-labeled span chain"
+        args = roots[chains[0]]
+        assert args.get("session") == "traced"
+        assert "step" in args
+        # per-step flight records with the session path label
+        recs = [
+            r for r in gw.recorder.records()
+            if r.path == "session_step"
+        ]
+        assert len(recs) >= 2
+        assert all(r.trace_id is not None for r in recs)
+        assert all("resetup" in r.stages for r in recs)
+    finally:
+        tracing.set_sample_rate(None)
+        tracing.clear()
+
+
+# ---------------------------------------------------------------------------
+# C API
+
+
+def test_capi_session_roundtrip(tmp_path):
+    from amgx_tpu.api import capi
+
+    capi.initialize()
+    A0, values, u0, f, n = _heat_workload()
+    cfg = capi.config_create(STEP_CFG)
+    res_h = capi.resources_create_simple(cfg)
+    mtx = capi.matrix_create(res_h, "dDDI")
+    rhs = capi.vector_create(res_h, "dDDI")
+    sol = capi.vector_create(res_h, "dDDI")
+    capi.matrix_upload_all(
+        mtx, n, A0.nnz, 1, 1, A0.indptr, A0.indices, values(0), None
+    )
+    slv = capi.solver_create(res_h, "dDDI", cfg)
+    sess_h = capi.solver_session_create(slv, mtx)
+    x = u0
+    for k in range(3):
+        capi.matrix_replace_coefficients(mtx, n, A0.nnz, values(k))
+        capi.vector_upload(rhs, n, 1, x + 2.0 * f)
+        capi.solver_session_step(sess_h, mtx, rhs, sol)
+        capi.solver_session_sync(sess_h)
+        assert capi.solver_session_get_status(sess_h) == 0
+        assert capi.solver_session_get_iterations_number(sess_h) > 0
+        x = capi.vector_download(sol)
+    # persisted session state
+    capi.solver_session_save(sess_h, str(tmp_path))
+    from amgx_tpu.store.store import ArtifactStore
+
+    st = ArtifactStore(str(tmp_path))
+    assert len(st) >= 1
+    capi.solver_session_destroy(sess_h)
+    for h, fn in (
+        (slv, capi.solver_destroy), (mtx, capi.matrix_destroy),
+        (rhs, capi.vector_destroy), (sol, capi.vector_destroy),
+    ):
+        fn(h)
