@@ -1123,6 +1123,19 @@ def _ensure_batch_front(s):
                     "AMGX_TPU_CAPI_ADMISSION must be a positive "
                     f"concurrency budget, got {budget_env!r}",
                 )
+        # AMGX_TPU_PLACEMENT: same strict set-but-malformed-fails-
+        # loudly contract as the admission budget — an unknown policy
+        # spec must not silently serve single-device.  Validated here
+        # (typed RC_BAD_CONFIGURATION) before the service constructor
+        # resolves the same variable.
+        placement_env = os.environ.get("AMGX_TPU_PLACEMENT", "")
+        if placement_env:
+            from amgx_tpu.serve.placement import parse_placement
+
+            try:
+                parse_placement(placement_env)
+            except ValueError as e:
+                raise AMGXError(RC_BAD_CONFIGURATION, str(e)) from None
         s.batch_service = BatchedSolveService(config=s.cfg.cfg)
         if budget:
             from amgx_tpu.serve import SolveGateway

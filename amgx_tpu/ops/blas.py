@@ -26,41 +26,50 @@ import jax.numpy as jnp
 _TLS = threading.local()
 
 
-def record_reduction(n: int = 1) -> None:
-    """Count ``n`` global-reduction sites into the active
-    :func:`reduction_counter`, if any (trace-time; no-op and
-    near-free otherwise)."""
-    c = getattr(_TLS, "counter", None)
-    if c is not None:
-        c.count += n
-
-
 class ReductionCount:
-    """Mutable counter yielded by :func:`reduction_counter`."""
+    """Mutable counter yielded by a site counter's context manager."""
 
     def __init__(self):
         self.count = 0
 
 
-@contextlib.contextmanager
-def reduction_counter():
-    """Count global-reduction call sites traced while active.
+def make_site_counter(slot: str):
+    """``(record, counter)`` pair for one trace-time call-site counter
+    on its own thread-local slot — ONE implementation shared by this
+    module's global-reduction accounting and serve/batched's
+    cross-chip psum accounting (distinct slots, so the two never
+    pollute each other's counts).
 
-    Thread-local (a concurrent serve-worker trace on another thread
-    does not pollute the count).  Nesting restores the outer counter.
-    Typical use::
+    ``record(n=1)`` adds into the active context's count (no-op and
+    near-free when none is active); ``counter()`` is a context manager
+    yielding a :class:`ReductionCount`, thread-local (a concurrent
+    serve-worker trace on another thread does not pollute the count)
+    and nesting-safe (the outer counter is restored on exit)."""
 
-        with blas.reduction_counter() as c:
-            jax.eval_shape(iterate, params, b, x, extra)
-        reductions_per_iteration = c.count
-    """
-    prev = getattr(_TLS, "counter", None)
-    c = ReductionCount()
-    _TLS.counter = c
-    try:
-        yield c
-    finally:
-        _TLS.counter = prev
+    def record(n: int = 1) -> None:
+        c = getattr(_TLS, slot, None)
+        if c is not None:
+            c.count += n
+
+    @contextlib.contextmanager
+    def counter():
+        prev = getattr(_TLS, slot, None)
+        c = ReductionCount()
+        setattr(_TLS, slot, c)
+        try:
+            yield c
+        finally:
+            setattr(_TLS, slot, prev)
+
+    return record, counter
+
+
+# the reduction-site counter (PR 8): count global dot/norm/Gram call
+# sites traced while active —
+#     with blas.reduction_counter() as c:
+#         jax.eval_shape(iterate, params, b, x, extra)
+#     reductions_per_iteration = c.count
+record_reduction, reduction_counter = make_site_counter("counter")
 
 
 def axpy(y, x, alpha):

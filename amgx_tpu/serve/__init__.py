@@ -49,6 +49,14 @@ from amgx_tpu.serve.admission import (
     TokenBucket,
 )
 from amgx_tpu.serve.gateway import GatewayTicket, SolveGateway
+from amgx_tpu.serve.placement import (
+    AffinityPlacement,
+    AffinityRouter,
+    MeshPlacement,
+    PlacementPolicy,
+    SingleDevicePolicy,
+    placement_from_env,
+)
 
 # serving-stack alias: the docs/issues call the frontend "the solve
 # service"; the class name keeps its descriptive form
@@ -65,6 +73,12 @@ __all__ = [
     "AdmissionController",
     "TenantQuota",
     "TokenBucket",
+    "PlacementPolicy",
+    "SingleDevicePolicy",
+    "MeshPlacement",
+    "AffinityPlacement",
+    "AffinityRouter",
+    "placement_from_env",
     "HierarchyCache",
     "ServeMetrics",
     "make_batched_solve",

@@ -43,10 +43,27 @@ from amgx_tpu.core.errors import AdmissionRejected, Overloaded
 @dataclasses.dataclass(frozen=True)
 class TenantQuota:
     """Token-bucket parameters for one tenant: sustained ``rate``
-    requests/s with bursts up to ``burst``."""
+    requests/s with bursts up to ``burst``.
+
+    ``device_seconds_rate`` (optional) adds a DEVICE-SECONDS budget on
+    top of the request quota — the enforcement half of the PR 9 cost
+    accounting (``amgx_gateway_tenant_device_seconds_total`` counted;
+    this charges).  The budget refills continuously at
+    ``device_seconds_rate`` device-seconds per wall second up to
+    ``device_seconds_burst`` (default: 10x the rate, i.e. ~10 s of
+    standing credit); every settled ticket's measured share of its
+    group's device time is charged POST-PAID, so the balance can go
+    negative (debt) and the next admit sheds — typed
+    :class:`AdmissionRejected`, ``reason="device_budget"``, with
+    ``retry_after_s`` = the refill time back to zero balance — until
+    the refill clears it.  A big-n tenant therefore pays for its
+    actual device time, not one token per request.  ``None`` (default)
+    means no device budget, the pre-PR behavior."""
 
     rate: float = 1000.0
     burst: float = 100.0
+    device_seconds_rate: Optional[float] = None
+    device_seconds_burst: Optional[float] = None
 
 
 class TokenBucket:
@@ -113,11 +130,15 @@ class AdmissionController:
 
     1. injected ``admission_quota`` fault / tenant token bucket
        (:class:`AdmissionRejected`, ``reason="quota"``);
-    2. global concurrency budget; the batch lane sheds at
+    2. tenant device-seconds budget, when its quota carries one —
+       post-paid balance, debited by :meth:`charge_device_seconds` at
+       each ticket's settle (:class:`AdmissionRejected`,
+       ``reason="device_budget"``);
+    3. global concurrency budget; the batch lane sheds at
        ``(1 - interactive_reserve_frac) * max_inflight`` so
        interactive admission always has headroom
        (:class:`Overloaded`, ``reason="overloaded"``);
-    3. deadline-shed predictor (:class:`AdmissionRejected`,
+    4. deadline-shed predictor (:class:`AdmissionRejected`,
        ``reason="deadline_unmeetable"``) — *after* the budget check so
        an overloaded service answers with the backoff hint, not a
        misleading deadline verdict.
@@ -142,6 +163,9 @@ class AdmissionController:
         self._clock = clock
         self._lock = threading.Lock()
         self._buckets: dict = {}
+        # device-seconds budgets (tokens denominated in device time);
+        # charged post-paid by charge_device_seconds, gated in admit()
+        self._device_buckets: dict = {}
         self.inflight = 0
 
     # -- quota ---------------------------------------------------------
@@ -158,6 +182,42 @@ class AdmissionController:
         b = TokenBucket(spec.rate, spec.burst, clock=self._clock)
         self._buckets[tenant] = b
         return b
+
+    def _device_bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        """Tenant's device-seconds budget bucket, created lazily
+        (caller holds the lock); None when its quota spec carries no
+        device budget."""
+        b = self._device_buckets.get(tenant)
+        if b is not None:
+            return b
+        spec = self.quota_spec.get(tenant, self.default_quota)
+        if spec is None or spec.device_seconds_rate is None:
+            return None
+        burst = (
+            spec.device_seconds_burst
+            if spec.device_seconds_burst is not None
+            else 10.0 * spec.device_seconds_rate
+        )
+        b = TokenBucket(
+            spec.device_seconds_rate, burst, clock=self._clock
+        )
+        self._device_buckets[tenant] = b
+        return b
+
+    def charge_device_seconds(self, tenant: str, seconds: float,
+                              lane: str = None) -> None:
+        """Post-paid device-time charge: a settled ticket's measured
+        share of its group's device time debits the tenant's budget
+        (wired by the gateway through
+        ``ServeMetrics.on_tenant_device``).  The balance may go
+        negative — debt — which :meth:`admit` sheds on until the
+        continuous refill clears it."""
+        with self._lock:
+            b = self._device_bucket_for(tenant)
+            if b is None:
+                return
+            b.try_take(0.0)  # refill to now before debiting
+            b.tokens -= float(seconds)
 
     def _cap(self, retry_after: float) -> float:
         return min(retry_after, self.retry_after_cap_s)
@@ -232,6 +292,23 @@ class AdmissionController:
                         bucket.burst, bucket.tokens + 1.0
                     )
 
+            dbucket = self._device_bucket_for(tenant)
+            if dbucket is not None:
+                # device-seconds ENFORCEMENT: post-paid, so the gate
+                # admits while the balance is non-negative;
+                # try_take(0) refills to now and, when the tenant is
+                # in debt, returns the seconds until the balance is
+                # back at zero — exactly the retry hint
+                wait = dbucket.try_take(0.0)
+                if wait > 0.0:
+                    refund()
+                    raise AdmissionRejected(
+                        f"tenant {tenant!r} device-seconds budget "
+                        f"exhausted ({dbucket.rate:g} dev-s/s refill, "
+                        f"balance {dbucket.tokens:g}s)",
+                        retry_after_s=self._cap(wait),
+                        reason="device_budget",
+                    )
             limit = (
                 self.max_inflight
                 if lane == "interactive"
@@ -284,5 +361,17 @@ class AdmissionController:
                 "batch_budget": self.batch_budget,
                 "tenant_tokens": {
                     t: b.tokens for t, b in self._buckets.items()
+                },
+                # refill-to-now view (read-only): an indebted tenant
+                # that stopped sending never calls try_take again, so
+                # exporting the raw balance would show cleared debt
+                # forever
+                "tenant_device_tokens": {
+                    t: min(
+                        b.burst,
+                        b.tokens
+                        + max(self._clock() - b._t_last, 0.0) * b.rate,
+                    )
+                    for t, b in self._device_buckets.items()
                 },
             }

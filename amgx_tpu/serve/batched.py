@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from amgx_tpu.core.profiling import named_scope
+from amgx_tpu.ops import blas as blas_mod
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.base import (
     FAILED,
@@ -40,6 +41,24 @@ from amgx_tpu.solvers.base import (
     SUCCESS,
     DIVERGED,
     SolveResult,
+)
+
+
+# ----------------------------------------------------------------------
+# cross-chip collective accounting (mesh placement, serve/placement)
+#
+# When the batch axis is sharded over a jax.sharding.Mesh the group
+# loop's convergence check becomes the ONE cross-chip sync point per
+# iteration: every shard must agree whether any instance anywhere is
+# still active, or their while_loops would diverge around the psums a
+# sharded solver would run inside the body.  The counter reuses
+# ops/blas.make_site_counter (the PR 8 reduction-site machinery) on
+# its own slot: it counts psum SITES at trace time, so the mesh bench
+# can assert the compiled group loop carries exactly one collective
+# per iteration (ci/mesh_bench.py).
+
+_record_psum, psum_site_counter = blas_mod.make_site_counter(
+    "psum_sites"
 )
 
 
@@ -136,12 +155,20 @@ def _value_dependent_flags(params_of, template, values_spec):
         return [True] * len(leaves), treedef
 
 
-def make_batched_solve(solver):
+def make_batched_solve(solver, axis_name=None):
     """Pure ``fn(template, values_B, b_B, x0_B) -> SolveResult`` with
     batched leaves (x (B, n), iters/status (B,), norms (B, ncomp),
     history (B, max_iters+1, ncomp)), or None when the solver supports
     neither a traced values-only params rebuild nor an iteration
     protocol.  Jit the result once per shape bucket.
+
+    ``axis_name`` (mesh placement): the function will run under a
+    ``shard_map`` whose batch axis carries this name — the group
+    loop's convergence check then psums the shard-local active mask
+    over the axis so every shard runs the SAME trip count as the
+    unsharded loop (per-instance results stay bitwise: converged
+    instances freeze under the commit mask either way).  ``None``
+    (default) emits the plain single-device loop, unchanged.
     """
     bp = solver.make_batch_params()
     if bp is None:
@@ -254,7 +281,18 @@ def make_batched_solve(solver):
 
         def cond(c):
             it, status = c[0], c[7]
-            return jnp.any(status == NOT_CONVERGED) & (it < max_iters)
+            active = jnp.any(status == NOT_CONVERGED)
+            if axis_name is not None:
+                # shared convergence mask: THE cross-chip collective of
+                # a batch-sharded group (everything else in the body is
+                # instance-local, hence shard-local) — one psum per
+                # group iteration, counted at trace time
+                _record_psum()
+                active = (
+                    jax.lax.psum(active.astype(jnp.int32), axis_name)
+                    > 0
+                )
+            return active & (it < max_iters)
 
         def body(c):
             it, x, extra, nrm, ini, mx, hist, status, iters = c

@@ -54,6 +54,13 @@ class HierarchyEntry:
     solver_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
+    # placement-resident template forms (serve/placement): the template
+    # materialized on a routed device or replicated over a mesh, built
+    # once per placement key by the active PlacementPolicy (which also
+    # guards access with its own lock) and dropped on eviction
+    placed: dict = dataclasses.field(
+        default_factory=dict, repr=False, compare=False
+    )
 
 
 def template_signature(template) -> tuple:

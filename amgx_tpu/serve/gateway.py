@@ -246,6 +246,15 @@ class SolveGateway:
         # the service's flight recorder is the gateway's too: sheds
         # and drains land in the same incident log as quarantines
         self.recorder = self.service.recorder
+        # device-seconds ENFORCEMENT (PR 10, ROADMAP item 2): every
+        # share the fetch loop records per (tenant, lane) is also
+        # CHARGED against the tenant's device budget, so quotas with
+        # device_seconds_rate shed big-n tenants typed
+        # (reason="device_budget") once their measured device time
+        # outruns the refill.  Last gateway wired to a shared service
+        # wins the hook — same single-owner contract as telemetry
+        # registration.
+        self.metrics.on_tenant_device = self._charge_device_seconds
         # streaming-session manager (amgx_tpu.sessions), built lazily
         # by the first open_session(); drain() persists its manifests
         self._session_mgr = None
@@ -253,6 +262,12 @@ class SolveGateway:
 
     # ------------------------------------------------------------------
     # telemetry
+
+    def _charge_device_seconds(self, tenant: str, lane: str,
+                               seconds: float):
+        """ServeMetrics.on_tenant_device hook: debit the tenant's
+        device-seconds budget with this ticket's measured share."""
+        self.admission.charge_device_seconds(tenant, seconds, lane=lane)
 
     def _tenant_inc(self, tenant: str, key: str):
         with self._tenant_lock:

@@ -84,12 +84,17 @@ class ServeMetrics:
         # stays bounded while batch degrades — so the reservoirs are
         # too
         self.lane_latency = defaultdict(LatencyReservoir)
-        # per-(tenant, lane) device-seconds: the first slice of fleet
-        # cost accounting — a big-n tenant's device time is visible
-        # next to a small-n one even though both pay one quota token
-        # per request.  Counter only (no enforcement); bounded
+        # per-(tenant, lane) device-seconds: fleet cost accounting — a
+        # big-n tenant's device time is visible next to a small-n one
+        # even though both pay one quota token per request.  Bounded
         # cardinality like the gateway's tenant counters.
         self.tenant_device: dict = defaultdict(float)
+        # enforcement hook (PR 10): a gateway wires this to its
+        # AdmissionController's device-seconds budget so every
+        # recorded share is CHARGED, not just counted — called outside
+        # the metrics lock with (tenant, lane, seconds); failures
+        # degrade to telemetry_errors
+        self.on_tenant_device = None
 
     # bound on distinct (tenant, lane) device-seconds keys; overflow
     # traffic aggregates under the "_other" tenant
@@ -98,7 +103,8 @@ class ServeMetrics:
     def record_tenant_device(self, tenant: str, lane: str,
                              seconds: float):
         """Accumulate one ticket's share of its group's device time
-        against its tenant/lane."""
+        against its tenant/lane, then run the enforcement hook (the
+        gateway's device-seconds budget charge) outside the lock."""
         with self._lock:
             key = (tenant, lane)
             if (
@@ -107,6 +113,14 @@ class ServeMetrics:
             ):
                 key = ("_other", lane)
             self.tenant_device[key] += float(seconds)
+        hook = self.on_tenant_device
+        if hook is not None:
+            try:
+                hook(tenant, lane, seconds)
+            except Exception:  # noqa: BLE001 — accounting must never
+                # fail the fetch that recorded it
+                with self._lock:
+                    self.counters["telemetry_errors"] += 1
 
     @staticmethod
     def _pivot_tenant_device(items) -> dict:

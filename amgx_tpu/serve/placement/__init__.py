@@ -1,0 +1,50 @@
+"""Policy-driven device placement for the serve tier.
+
+Splits the batched service's host-side queueing from the decision of
+WHERE a flushed group executes (ROADMAP item 1):
+
+* :class:`SingleDevicePolicy` — the default; bitwise the pre-placement
+  behavior (everything on the process-default device).
+* :class:`MeshPlacement` — shard the batch axis of each group across a
+  ``jax.sharding.Mesh`` via ``shard_map``: each chip solves its slice,
+  hierarchies replicate through partition-rule pytree specs, the only
+  cross-chip collective is the psum'd shared convergence mask.
+* :class:`AffinityPlacement` — route each whole group to the device
+  whose hierarchy/compile caches are already warm for its fingerprint
+  (:class:`AffinityRouter`), falling back to least-loaded.
+
+Select with the service's ``placement=`` argument or
+``AMGX_TPU_PLACEMENT=single|mesh[:N]|affinity`` (see doc/MESH.md).
+"""
+
+from amgx_tpu.serve.placement.policy import (
+    ENV_VAR,
+    GroupPlan,
+    PlacementPolicy,
+    SingleDevicePolicy,
+    parse_placement,
+    placement_from_env,
+    resolve_placement,
+)
+from amgx_tpu.serve.placement.mesh import (
+    MeshPlacement,
+    template_partition_specs,
+)
+from amgx_tpu.serve.placement.router import (
+    AffinityPlacement,
+    AffinityRouter,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "GroupPlan",
+    "PlacementPolicy",
+    "SingleDevicePolicy",
+    "MeshPlacement",
+    "AffinityPlacement",
+    "AffinityRouter",
+    "template_partition_specs",
+    "parse_placement",
+    "placement_from_env",
+    "resolve_placement",
+]

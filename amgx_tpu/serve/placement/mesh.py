@@ -1,0 +1,453 @@
+"""Mesh placement: shard the BATCH axis of a bucketed group across a
+``jax.sharding.Mesh``.
+
+One flushed group of B instances becomes D shard-local solves of B/D
+instances each, compiled as ONE ``shard_map`` program over a 1-D
+``("batch",)`` mesh:
+
+  * the batched staging arrays (values/b/x0, leading axis B) ship as
+    ``NamedSharding(mesh, P("batch"))`` — one slice per chip;
+  * the hierarchy template REPLICATES (every chip smooths/coarsens its
+    own instances against the full hierarchy) via partition-rule
+    pytree specs (:func:`template_partition_specs`, the SNIPPETS.md
+    regex-rules pattern) — all-replicate by default, with the rule
+    table as the hook for sharding large hierarchies later;
+  * the group loop's convergence mask runs in one of two modes
+    (``convergence=``): **local** (the default) lets each shard's
+    while_loop exit as soon as ITS slice converges — legal because
+    everything inside the body is instance-local, so shards share no
+    state the trip counts could skew — and **shared** psums the
+    shard-local active mask (``make_batched_solve(axis_name=...)``)
+    so every shard runs the SAME trip count as the unsharded loop.
+    Per-instance results are identical either way (converged
+    instances freeze under the commit mask); shared is the mode any
+    FUTURE body collective (partition rules sharding hierarchy
+    leaves) requires, local is free of cross-chip syncs entirely.
+
+Communication accounting: everything inside the body — SpMVs,
+V-cycles, and crucially the PR 8 fused Gram-block reductions of
+SSTEP_PCG / the opt-poly spectral intervals — reduces over
+per-instance axes, which batch sharding keeps chip-local.  This
+closes PR 8's "psum-shard the fused reductions on a mesh" remainder
+in the strongest possible way: on the batch-sharded mesh the fused
+reductions need NO psum at all; the only collective that can appear
+at all is the shared convergence mask (one psum per group-loop
+iteration, counted into ``amgx_mesh_psums_total``), and under
+SSTEP_PCG even that amortizes s-fold because the group loop checks
+convergence once per s-step outer iteration.  ci/mesh_bench.py gates
+the shared-mode loop to exactly ONE psum site per iteration.
+
+Zero per-iteration host sync is preserved: the shard_map program is
+dispatched exactly like the single-device one, and the group's single
+``block_until_ready`` + ``device_get`` fetch gathers every shard.
+
+Testable without hardware: ``XLA_FLAGS=
+--xla_force_host_platform_device_count=8`` simulates an 8-chip mesh on
+CPU (tests/conftest.py already forces it; ci/mesh_bench.py gates ≥2x
+solves/s there, conservative because simulated chips share host
+cores).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import re
+import threading
+from typing import Optional
+
+import numpy as np
+
+from amgx_tpu.serve.placement.policy import (
+    GroupPlan,
+    PlacementPolicy,
+    SingleDevicePolicy,
+)
+
+DEFAULT_AXIS = "batch"
+
+
+def _path_name(path) -> str:
+    """``tree_flatten_with_path`` key path -> a "/"-joined rule-match
+    string (the SNIPPETS.md ``match_partition_rules`` shape)."""
+    parts = []
+    for p in path:
+        for attr in ("key", "idx", "name"):
+            v = getattr(p, attr, None)
+            if v is not None:
+                parts.append(str(v))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def template_partition_specs(template, rules=(), axis_name=DEFAULT_AXIS):
+    """Partition-rule pytree specs for a batch-params template:
+    ``rules`` is ``((regex, PartitionSpec), ...)`` matched against each
+    leaf's "/"-joined key path; the first hit wins, no hit (and every
+    scalar leaf) replicates (``P()``).  The default empty rule set
+    therefore replicates the whole hierarchy — the documented contract
+    for small/medium hierarchies — while a large-hierarchy deployment
+    can shard chosen leaves by name without touching the mesh code."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    specs = []
+    for path, leaf in flat:
+        spec = P()
+        if getattr(leaf, "ndim", 0) and rules:
+            name = _path_name(path)
+            for rule, ps in rules:
+                if re.search(rule, name):
+                    spec = ps
+                    break
+        specs.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+class MeshPlacement(PlacementPolicy):
+    """Shard each group's batch axis across the mesh.
+
+    Parameters
+    ----------
+    devices: the chips to mesh over (default: every ``jax.devices()``).
+    axis_name: the mesh axis ("batch").
+    max_shards: cap on shard count (``AMGX_TPU_PLACEMENT=mesh:N``).
+    partition_rules: ``((regex, PartitionSpec), ...)`` over template
+        leaf paths — all-replicate when empty (the default).
+    convergence: ``"local"`` (default) — each shard's group loop
+        exits when its own slice converges, zero cross-chip syncs;
+        ``"shared"`` — the active mask psums over the mesh axis every
+        iteration, so every shard runs the unsharded trip count
+        (required if partition rules ever put a collective inside the
+        body; ``AMGX_TPU_PLACEMENT=mesh:shared``).  Per-instance
+        results agree either way (masked freezing); see doc/MESH.md
+        "Numerical parity".
+
+    A group's shard count is the largest power of two that divides its
+    batch bucket and does not exceed the device (or ``max_shards``)
+    count; a 1-shard group degrades to the single-device plan (same
+    bitwise path as the default policy)."""
+
+    name = "mesh"
+    telemetry_kind = "mesh"
+
+    def __init__(self, devices=None, axis_name: str = DEFAULT_AXIS,
+                 max_shards: Optional[int] = None, partition_rules=(),
+                 convergence: str = "local"):
+        import jax
+
+        if convergence not in ("local", "shared"):
+            raise ValueError(
+                f"MeshPlacement convergence must be 'local' or "
+                f"'shared', got {convergence!r}"
+            )
+        self.devices = (
+            list(devices) if devices is not None else list(jax.devices())
+        )
+        self.axis_name = axis_name
+        self.max_shards = max_shards
+        self.convergence = convergence
+        self.partition_rules = tuple(partition_rules)
+        self._single = SingleDevicePolicy()
+        self._lock = threading.Lock()
+        self._meshes: dict = {}  # nshards -> jax.sharding.Mesh
+        self._fns: dict = {}  # (signature, Bb, ns, donate) -> compiled
+        self._futures: dict = {}  # in-flight compiles (single-flight)
+        # psum sites the compiled group loop carries per iteration,
+        # measured at trace time (batched.psum_site_counter); the mesh
+        # bench gates it == 1
+        self.psum_sites: Optional[int] = None
+        # telemetry (all guarded by _lock)
+        self._groups_total = 0
+        self._sharded_groups = 0
+        self._psums_total = 0
+        self._mesh_compiles = 0
+        self._aot_fallbacks = 0
+        self._busy_s: dict = {}  # device label -> seconds
+        self._groups_dev: dict = {}  # device label -> groups
+
+    # -- mesh / sharding helpers ---------------------------------------
+
+    def n_shards(self, Bb: int) -> int:
+        """Largest power-of-two shard count that divides the batch
+        bucket and fits the device budget."""
+        cap = len(self.devices)
+        if self.max_shards:
+            cap = min(cap, self.max_shards)
+        n = 1
+        while n * 2 <= cap and Bb % (n * 2) == 0:
+            n *= 2
+        return n
+
+    def _mesh_for(self, ns: int):
+        from jax.sharding import Mesh
+
+        with self._lock:
+            mesh = self._meshes.get(ns)
+            if mesh is None:
+                mesh = Mesh(
+                    np.array(self.devices[:ns]), (self.axis_name,)
+                )
+                self._meshes[ns] = mesh
+        return mesh
+
+    def _shardings(self, ns: int):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh_for(ns)
+        return (
+            NamedSharding(mesh, P(self.axis_name)),
+            NamedSharding(mesh, P()),
+        )
+
+    def _template_on(self, entry, ns: int):
+        """The entry's template materialized on the mesh once, leaves
+        placed by the partition-rule specs (replicated by default)."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        key = ("mesh", ns)
+        with self._lock:
+            placed = entry.placed.get(key)
+        if placed is None:
+            mesh = self._mesh_for(ns)
+            specs = template_partition_specs(
+                entry.template, self.partition_rules, self.axis_name
+            )
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs
+            )
+            placed = jax.device_put(entry.template, shardings)
+            with self._lock:
+                placed = entry.placed.setdefault(key, placed)
+        return placed
+
+    # -- executable resolution (single-flight, AOT with fallback) ------
+
+    def _executable(self, service, entry, Bb: int, ns: int,
+                    donate: bool):
+        key = (entry.signature, Bb, ns, donate)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                return fn
+            fut = self._futures.get(key)
+            if fut is None:
+                fut = concurrent.futures.Future()
+                self._futures[key] = fut
+                mine = True
+            else:
+                mine = False
+        if not mine:
+            return fut.result()
+        try:
+            fn = self._compile(service, entry, Bb, ns, donate)
+        except BaseException as e:
+            with self._lock:
+                self._futures.pop(key, None)
+            fut.set_exception(e)
+            raise
+        with self._lock:
+            self._futures.pop(key, None)
+            self._fns[key] = fn
+            self._mesh_compiles += 1
+        fut.set_result(fn)
+        return fn
+
+    def _compile(self, service, entry, Bb: int, ns: int, donate: bool):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        try:
+            from jax.experimental.shard_map import shard_map
+        except ImportError:  # newer jax promoted it out of experimental
+            from jax import shard_map
+
+        from amgx_tpu.serve.batched import (
+            make_batched_solve,
+            psum_site_counter,
+        )
+
+        mesh = self._mesh_for(ns)
+        # local mode traces the plain loop (each shard's cond is its
+        # own slice); shared mode psums the mask over the axis
+        axis = self.axis_name if self.convergence == "shared" else None
+        solve = make_batched_solve(entry.solver, axis_name=axis)
+        if solve is None:  # pragma: no cover — service gates batch_fn
+            raise RuntimeError("solver lost its batched path")
+        tmpl_specs = template_partition_specs(
+            entry.template, self.partition_rules, self.axis_name
+        )
+        bspec = P(self.axis_name)
+        sharded_fn = shard_map(
+            solve,
+            mesh=mesh,
+            in_specs=(tmpl_specs, bspec, bspec, bspec),
+            out_specs=bspec,
+            check_rep=False,
+        )
+        jitted = jax.jit(
+            sharded_fn, donate_argnums=(3,) if donate else ()
+        )
+        pat = entry.pattern
+        dt = entry.solver.A.values.dtype
+        shard, _repl = self._shardings(ns)
+
+        def struct(shape, sharding):
+            return jax.ShapeDtypeStruct(shape, dt, sharding=sharding)
+
+        tmpl_structs = jax.tree_util.tree_map(
+            lambda leaf, spec: (
+                jax.ShapeDtypeStruct(
+                    leaf.shape,
+                    leaf.dtype,
+                    sharding=NamedSharding(mesh, spec),
+                )
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+                else leaf
+            ),
+            entry.template,
+            tmpl_specs,
+        )
+        with psum_site_counter() as c:
+            try:
+                fn = jitted.lower(
+                    tmpl_structs,
+                    struct((Bb, pat.nnzb), shard),
+                    struct((Bb, pat.nb), shard),
+                    struct((Bb, pat.nb), shard),
+                ).compile()
+            except Exception:
+                # AOT unavailable for this template pytree: the
+                # tracing jit compiles on first dispatch instead
+                # (same contract as CompileCache._compile)
+                with self._lock:
+                    self._aot_fallbacks += 1
+                service.metrics.inc("aot_fallbacks")
+                fn = jitted
+        if c.count:
+            with self._lock:
+                if self.psum_sites is None:
+                    self.psum_sites = c.count
+        return fn
+
+    # -- PlacementPolicy -----------------------------------------------
+
+    def plan(self, service, entry, Bb: int) -> GroupPlan:
+        import jax
+
+        ns = self.n_shards(Bb)
+        if ns <= 1:
+            # nothing to shard (tiny bucket or one device): take the
+            # single-device path — bitwise the default behavior
+            with self._lock:
+                self._groups_total += 1
+            return self._single.plan(service, entry, Bb)
+        donate = service.compile_cache._donate()
+        fn_c = self._executable(service, entry, Bb, ns, donate)
+        template = self._template_on(entry, ns)
+        shard, _repl = self._shardings(ns)
+        labels = [str(i) for i in range(ns)]
+
+        def fn(_template, vals_d, bs_d, x0_d):
+            return fn_c(template, vals_d, bs_d, x0_d)
+
+        def on_fetch(host, device_s):
+            # shared mode: the group loop evaluated its cond (= one
+            # shared-mask psum) once per trip plus the final exit
+            # check; trips = the max committed iteration across the
+            # batch.  Local mode executes zero collectives.
+            psums = 0
+            if self.convergence == "shared":
+                trips = int(np.max(np.asarray(host.iters))) + 1
+                psums = trips * (self.psum_sites or 1)
+            with self._lock:
+                self._groups_total += 1
+                self._sharded_groups += 1
+                self._psums_total += psums
+                for lab in labels:
+                    self._busy_s[lab] = (
+                        self._busy_s.get(lab, 0.0) + device_s
+                    )
+                    self._groups_dev[lab] = (
+                        self._groups_dev.get(lab, 0) + 1
+                    )
+
+        return GroupPlan(
+            fn=fn,
+            put=lambda a: jax.device_put(a, shard),
+            zeros=lambda bb, nb, dtype: jax.device_put(
+                np.zeros((bb, nb), dtype), shard
+            ),
+            zeros_key=("mesh", ns),
+            donate=donate,
+            device_label=f"mesh{ns}",
+            on_fetch=on_fetch,
+        )
+
+    def warm(self, service, entry, Bb: int) -> None:
+        """Background-compile the sharded executable for this bucket
+        (shared compile worker, like CompileCache.warm); 1-shard
+        buckets warm the single-device cache instead."""
+        ns = self.n_shards(Bb)
+        if ns <= 1 or entry.batch_fn is None:
+            self._single.warm(service, entry, Bb)
+            return
+        donate = service.compile_cache._donate()
+        key = (entry.signature, Bb, ns, donate)
+        with self._lock:
+            if key in self._fns or key in self._futures:
+                return
+        from amgx_tpu.serve.cache import _compile_pool
+
+        def job():
+            try:
+                self._executable(service, entry, Bb, ns, donate)
+                service.metrics.inc("compile_warmups")
+            except BaseException:  # noqa: BLE001 — warm-up best-effort
+                pass
+
+        _compile_pool().submit(job)
+
+    def evicted(self, entry) -> None:
+        # entry-LOCAL state only: compiled executables are keyed per
+        # signature and shared across entries with equal signatures,
+        # so they are dropped by evict_signature (which the service
+        # calls only when the LAST entry with the signature goes)
+        with self._lock:
+            entry.placed.clear()
+
+    def evict_signature(self, signature) -> None:
+        with self._lock:
+            keys = [k for k in self._fns if k[0] == signature]
+            for k in keys:
+                del self._fns[k]
+
+    def describe(self) -> dict:
+        return {
+            "policy": self.name,
+            "devices": len(self.devices),
+            "axis": self.axis_name,
+            "max_shards": self.max_shards,
+            "convergence": self.convergence,
+        }
+
+    def telemetry_snapshot(self) -> dict:
+        """Registry source (kind="mesh"): the ``amgx_mesh_*``
+        families — groups per device, psum totals, busy seconds."""
+        with self._lock:
+            return {
+                "policy": self.name,
+                "devices": len(self.devices),
+                "convergence": self.convergence,
+                "groups_total": self._groups_total,
+                "sharded_groups_total": self._sharded_groups,
+                "psums_total": self._psums_total,
+                "psum_sites_per_iteration": self.psum_sites or 0,
+                "mesh_compiles": self._mesh_compiles,
+                "aot_fallbacks": self._aot_fallbacks,
+                "groups_per_device": dict(self._groups_dev),
+                "device_busy_s": dict(self._busy_s),
+            }

@@ -1,0 +1,237 @@
+"""Placement policies: WHERE a flushed serve group executes.
+
+The batched service (:mod:`amgx_tpu.serve.service`) owns the host side
+of serving — queueing, bucketing, staging, hierarchy/compile caches,
+the one-fetch-per-group sync discipline.  Until this module existed it
+also implicitly owned device placement: every group shipped to the
+process-default device (device 0).  A :class:`PlacementPolicy` splits
+that decision out:
+
+  flusher resolves the hierarchy entry
+        │
+        ▼
+  policy.plan(service, entry, Bb) ──> GroupPlan
+        │      (which device(s); which executable; how host arrays
+        │       ship; how the fetch is accounted)
+        ▼
+  dispatch stage: plan.put(staging rows) → plan.fn(...) → one fetch
+
+Three policies ship:
+
+* :class:`SingleDevicePolicy` (the default) — behavior-identical to
+  the pre-placement service: the shared
+  :class:`~amgx_tpu.serve.cache.CompileCache` executable, plain
+  ``jnp.asarray`` transfers, the same zeros-x0 reuse key.  Bitwise
+  regression-tested by tests/test_placement.py and ci/mesh_bench.py.
+* :class:`~amgx_tpu.serve.placement.mesh.MeshPlacement` — shards the
+  BATCH axis of a bucketed group across a ``jax.sharding.Mesh`` via
+  ``shard_map``; each chip solves its slice, hierarchies replicate
+  through partition-rule pytree specs, and the only cross-chip
+  collective is the psum'd shared convergence mask.
+* :class:`~amgx_tpu.serve.placement.router.AffinityPlacement` — routes
+  each whole group to ONE device chosen by fingerprint cache affinity
+  (warm hierarchy/compile state), falling back to least-loaded.
+
+Selection: pass a policy instance (or its name) as the service's
+``placement=`` argument, or set ``AMGX_TPU_PLACEMENT`` to
+``single`` | ``mesh[:N]`` | ``affinity`` — the service default
+(``placement=None``) resolves the environment variable, so existing
+callers and the ci benches become placement-aware without code
+changes; unset means single-device, unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+ENV_VAR = "AMGX_TPU_PLACEMENT"
+
+
+class GroupPlan:
+    """One flushed group's placement decision, resolved by
+    :meth:`PlacementPolicy.plan` on the flusher's host stage.
+
+    ``fn`` is the compiled group executable
+    (``fn(template, vals_B, b_B, x0_B) -> SolveResult``), ``put`` the
+    host→device transfer for the batched staging arrays, ``zeros`` the
+    resident zero-x0 block builder (cached by the service under its
+    zeros key extended with ``zeros_key``), ``donate`` whether the x0
+    buffer is donated to the executable.  ``on_fetch(host, device_s)``
+    runs after the group's single host sync (placement telemetry:
+    per-device busy time, psum accounting); ``abandon()`` releases any
+    routing reservation when the group fails before its fetch.  Both
+    hooks are called by the service under a degrade-never-raise
+    guard."""
+
+    __slots__ = (
+        "fn", "put", "zeros", "zeros_key", "donate", "device_label",
+        "_on_fetch", "_on_abandon", "_settled",
+    )
+
+    def __init__(self, fn: Callable, put: Callable, zeros: Callable,
+                 zeros_key: tuple = (), donate: bool = False,
+                 device_label: Optional[str] = None,
+                 on_fetch: Optional[Callable] = None,
+                 on_abandon: Optional[Callable] = None):
+        self.fn = fn
+        self.put = put
+        self.zeros = zeros
+        self.zeros_key = tuple(zeros_key)
+        self.donate = bool(donate)
+        self.device_label = device_label
+        self._on_fetch = on_fetch
+        self._on_abandon = on_abandon
+        self._settled = False
+
+    def on_fetch(self, host, device_s: float) -> None:
+        """The group's one host sync completed (idempotence guarded:
+        accounting lands exactly once per group)."""
+        if self._settled:
+            return
+        self._settled = True
+        if self._on_fetch is not None:
+            self._on_fetch(host, device_s)
+
+    def abandon(self) -> None:
+        """The group failed before its fetch (quarantine path):
+        release any routing reservation without charging busy time."""
+        if self._settled:
+            return
+        self._settled = True
+        if self._on_abandon is not None:
+            self._on_abandon()
+
+
+class PlacementPolicy:
+    """Base: the host-queueing / device-placement split.  Stateless
+    policies leave ``telemetry_kind`` None; stateful ones (mesh,
+    affinity) set it to ``"mesh"`` and are registered as a telemetry
+    source by the owning service (``amgx_mesh_*`` families)."""
+
+    name = "single"
+    telemetry_kind: Optional[str] = None
+
+    def plan(self, service, entry, Bb: int) -> GroupPlan:
+        raise NotImplementedError
+
+    def warm(self, service, entry, Bb: int) -> None:
+        """Background-compile the executable a future ``plan`` for
+        this (entry, bucket) would resolve."""
+
+    def evicted(self, entry) -> None:
+        """The hierarchy cache evicted ``entry``: drop any per-device
+        resident state the policy keyed on it (entry-LOCAL state
+        only — signature-shared executables go through
+        :meth:`evict_signature`)."""
+
+    def evict_signature(self, signature) -> None:
+        """The last cached entry with this template signature is gone:
+        drop any signature-keyed compiled executables (called by the
+        service in the same branch that evicts the shared
+        CompileCache's programs; never while another live entry still
+        shares the signature)."""
+
+    def device_for(self, fingerprint) -> Optional[str]:
+        """Label of the device this policy would route ``fingerprint``
+        to because its caches are already warm there — None when the
+        policy does not route (single, mesh) or the fingerprint is
+        cold.  Streaming sessions surface this as
+        ``SolveSession.placement_device``."""
+        return None
+
+    def describe(self) -> dict:
+        return {"policy": self.name}
+
+
+class SingleDevicePolicy(PlacementPolicy):
+    """The default policy: everything on the process-default device,
+    through the exact pre-placement dispatch path — the shared
+    CompileCache executable, ``jnp.asarray`` transfers, the unchanged
+    zeros-x0 cache key (``zeros_key=()``), platform-default donation.
+    ci/mesh_bench.py regression-gates that a default-constructed
+    service is bitwise identical to one with this policy explicit."""
+
+    name = "single"
+
+    def plan(self, service, entry, Bb: int) -> GroupPlan:
+        import jax.numpy as jnp
+
+        return GroupPlan(
+            fn=service.compile_cache.get(entry, Bb),
+            put=jnp.asarray,
+            zeros=lambda bb, nb, dtype: jnp.zeros((bb, nb), dtype),
+            zeros_key=(),
+            donate=service.compile_cache._donate(),
+            device_label=None,
+        )
+
+    def warm(self, service, entry, Bb: int) -> None:
+        service.compile_cache.warm(entry, Bb)
+
+
+def parse_placement(spec: str) -> PlacementPolicy:
+    """Policy from a spec string: ``""``/``single`` →
+    :class:`SingleDevicePolicy`; ``mesh`` with optional ``:``-options
+    (an integer caps the shard count, ``shared``/``local`` picks the
+    convergence-mask mode — e.g. ``mesh:4:shared``) → MeshPlacement;
+    ``affinity`` → AffinityPlacement.  Malformed specs raise
+    ``ValueError`` loudly — a fleet config typo must not silently
+    serve single-device (the C API maps it to
+    RC_BAD_CONFIGURATION)."""
+    spec = (spec or "").strip()
+    if spec in ("", "single"):
+        return SingleDevicePolicy()
+    if spec == "mesh" or spec.startswith("mesh:"):
+        from amgx_tpu.serve.placement.mesh import MeshPlacement
+
+        max_shards = None
+        convergence = "local"
+        for arg in spec.split(":")[1:]:
+            if arg in ("local", "shared"):
+                convergence = arg
+                continue
+            try:
+                max_shards = int(arg)
+            except ValueError:
+                raise ValueError(
+                    f"{ENV_VAR}: mesh option must be a shard count or "
+                    f"local|shared, got {arg!r}"
+                ) from None
+            if max_shards <= 0:
+                raise ValueError(
+                    f"{ENV_VAR}: mesh shard count must be positive, "
+                    f"got {max_shards}"
+                )
+        return MeshPlacement(
+            max_shards=max_shards, convergence=convergence
+        )
+    if spec == "affinity":
+        from amgx_tpu.serve.placement.router import AffinityPlacement
+
+        return AffinityPlacement()
+    raise ValueError(
+        f"{ENV_VAR}: unknown placement policy {spec!r} "
+        "(expected single | mesh[:N] | affinity)"
+    )
+
+
+def placement_from_env() -> PlacementPolicy:
+    """The env-selected policy (``AMGX_TPU_PLACEMENT``); unset/empty
+    means the unchanged single-device default."""
+    return parse_placement(os.environ.get(ENV_VAR, ""))
+
+
+def resolve_placement(placement) -> PlacementPolicy:
+    """Service-constructor coercion: None → environment, str → parsed
+    spec, policy instance → itself."""
+    if placement is None:
+        return placement_from_env()
+    if isinstance(placement, str):
+        return parse_placement(placement)
+    if isinstance(placement, PlacementPolicy):
+        return placement
+    raise TypeError(
+        "placement must be None, a spec string, or a PlacementPolicy; "
+        f"got {type(placement).__name__}"
+    )

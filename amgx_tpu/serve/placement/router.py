@@ -1,0 +1,263 @@
+"""Fingerprint-affinity routing: send a group to the chip whose
+caches are already warm.
+
+A hierarchy entry's expensive state is per-DEVICE: the resident
+template pytree, the XLA executable compiled against it, and (on real
+hardware) the HBM working set.  Routing a fingerprint's group to a
+device that has never seen it pays a template transfer and possibly a
+compile; routing it back to the device that served it last is free.
+The :class:`AffinityRouter` keeps exactly that per-device view — which
+fingerprints are warm where, how loaded each device is — and the
+:class:`AffinityPlacement` policy turns it into a placement decision:
+
+  route(fingerprint):
+      warm somewhere  → that device            (affinity HIT)
+      cold everywhere → least-loaded device    (fallback; the
+                        fingerprint becomes warm there)
+
+Whole groups run on one device (contrast
+:class:`~amgx_tpu.serve.placement.mesh.MeshPlacement`, which shards
+one group across every chip): throughput scales with the number of
+CONCURRENT fingerprint groups, and a streaming session's steps — all
+one fingerprint — land on the chip that already holds its hierarchy
+(the PR 9 remainder; surfaced as ``SolveSession.placement_device``).
+
+Load is measured as in-flight routed groups with accumulated device
+busy-seconds as the tie-break, both settled at the group's single
+fetch (or released by ``abandon`` when a group quarantines before
+it)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from amgx_tpu.serve.placement.policy import GroupPlan, PlacementPolicy
+
+
+class AffinityRouter:
+    """Per-device warm-fingerprint sets + load accounting.  Pure host
+    state (thread-safe, no jax imports) so it is unit-testable without
+    devices and reusable by other frontends (a multi-worker gateway
+    routing to processes instead of chips)."""
+
+    def __init__(self, n_devices: int):
+        if n_devices < 1:
+            raise ValueError("AffinityRouter needs at least one device")
+        self.n = int(n_devices)
+        self._lock = threading.Lock()
+        self._warm = [set() for _ in range(self.n)]
+        self._outstanding = [0] * self.n
+        self._busy_s = [0.0] * self.n
+        self._groups = [0] * self.n
+        self.hits = 0
+        self.misses = 0
+
+    def peek(self, fingerprint) -> Optional[int]:
+        """Device index whose caches hold ``fingerprint`` (no routing
+        side effects), or None when it is cold everywhere."""
+        with self._lock:
+            for i in range(self.n):
+                if fingerprint in self._warm[i]:
+                    return i
+        return None
+
+    def route(self, fingerprint) -> tuple:
+        """(device index, was_warm) for one group; reserves one unit
+        of the device's load until :meth:`settle`/:meth:`release`."""
+        with self._lock:
+            for i in range(self.n):
+                if fingerprint in self._warm[i]:
+                    self.hits += 1
+                    self._outstanding[i] += 1
+                    return i, True
+            i = min(
+                range(self.n),
+                key=lambda j: (self._outstanding[j], self._busy_s[j]),
+            )
+            self.misses += 1
+            self._warm[i].add(fingerprint)
+            self._outstanding[i] += 1
+            return i, False
+
+    def settle(self, index: int, device_s: float) -> None:
+        """A routed group's fetch completed: release its load unit and
+        charge its device time."""
+        with self._lock:
+            self._outstanding[index] = max(
+                self._outstanding[index] - 1, 0
+            )
+            self._busy_s[index] += float(device_s)
+            self._groups[index] += 1
+
+    def release(self, index: int) -> None:
+        """A routed group failed before its fetch: release the load
+        unit without charging busy time."""
+        with self._lock:
+            self._outstanding[index] = max(
+                self._outstanding[index] - 1, 0
+            )
+
+    def forget(self, fingerprint) -> None:
+        """The hierarchy cache evicted the fingerprint: its device
+        state is gone, stop routing for it."""
+        with self._lock:
+            for w in self._warm:
+                w.discard(fingerprint)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "outstanding": list(self._outstanding),
+                "busy_s": list(self._busy_s),
+                "groups": list(self._groups),
+                "warm_fingerprints": [len(w) for w in self._warm],
+            }
+
+
+class AffinityPlacement(PlacementPolicy):
+    """Route each flushed group — whole, unsharded — to the device the
+    :class:`AffinityRouter` picks for its fingerprint.  The policy
+    keeps one tracing-jit wrapper per template signature (JAX's
+    dispatch cache then holds one executable per device the wrapper
+    actually runs on) and materializes the entry's template on a
+    routed device exactly once (``entry.placed``)."""
+
+    name = "affinity"
+    telemetry_kind = "mesh"
+
+    def __init__(self, devices=None):
+        import jax
+
+        self.devices = (
+            list(devices) if devices is not None else list(jax.devices())
+        )
+        self.router = AffinityRouter(len(self.devices))
+        self._lock = threading.Lock()
+        self._jits: dict = {}  # (signature, donate) -> jitted batch fn
+
+    # -- internals -----------------------------------------------------
+
+    def _jit_for(self, entry, donate: bool):
+        import jax
+
+        key = (entry.signature, donate)
+        with self._lock:
+            fn = self._jits.get(key)
+            if fn is None:
+                # equal signatures produce identical traces (the
+                # template is an argument), so one wrapper per
+                # signature; jax's dispatch cache adds the per-device
+                # executables as groups land on each device
+                fn = jax.jit(
+                    entry.batch_fn,
+                    donate_argnums=(3,) if donate else (),
+                )
+                self._jits[key] = fn
+        return fn
+
+    def _template_on(self, entry, index: int):
+        import jax
+
+        key = ("dev", index)
+        # policy lock, not entry.solver_lock: a long quarantine
+        # resetup holding the solver lock must not stall dispatch of a
+        # healthy group's template transfer
+        with self._lock:
+            placed = entry.placed.get(key)
+        if placed is None:
+            placed = jax.device_put(
+                entry.template, self.devices[index]
+            )
+            with self._lock:
+                placed = entry.placed.setdefault(key, placed)
+        return placed
+
+    # -- PlacementPolicy -----------------------------------------------
+
+    def plan(self, service, entry, Bb: int) -> GroupPlan:
+        import jax
+
+        index, _warm = self.router.route(entry.pattern.fingerprint)
+        dev = self.devices[index]
+        try:
+            donate = service.compile_cache._donate()
+            jitted = self._jit_for(entry, donate)
+            template = self._template_on(entry, index)
+        except BaseException:
+            # route() reserved one load unit; a failure before the
+            # GroupPlan exists (device_put OOM, trace error) would
+            # otherwise leak it forever and blackhole the device from
+            # least-loaded routing
+            self.router.release(index)
+            raise
+
+        def fn(_template, vals_d, bs_d, x0_d):
+            # the routed, device-resident template replaces the host
+            # entry's default-device one
+            return jitted(template, vals_d, bs_d, x0_d)
+
+        return GroupPlan(
+            fn=fn,
+            put=lambda a: jax.device_put(a, dev),
+            zeros=lambda bb, nb, dtype: jax.device_put(
+                np.zeros((bb, nb), dtype), dev
+            ),
+            zeros_key=("dev", index),
+            donate=donate,
+            device_label=str(index),
+            on_fetch=lambda host, device_s: self.router.settle(
+                index, device_s
+            ),
+            on_abandon=lambda: self.router.release(index),
+        )
+
+    def warm(self, service, entry, Bb: int) -> None:
+        """Affinity executables compile lazily on their routed device
+        (tracing jit); warm the shared AOT cache anyway so a breaker
+        bypass or policy swap stays warm too."""
+        service.compile_cache.warm(entry, Bb)
+
+    def evicted(self, entry) -> None:
+        self.router.forget(entry.pattern.fingerprint)
+        with self._lock:
+            entry.placed.clear()
+
+    def evict_signature(self, signature) -> None:
+        # the jit wrappers are signature-shared (like the compile
+        # cache's executables): dropped only with the last entry
+        with self._lock:
+            for k in [k for k in self._jits if k[0] == signature]:
+                del self._jits[k]
+
+    def device_for(self, fingerprint) -> Optional[str]:
+        index = self.router.peek(fingerprint)
+        return None if index is None else str(index)
+
+    def describe(self) -> dict:
+        return {"policy": self.name, "devices": len(self.devices)}
+
+    def telemetry_snapshot(self) -> dict:
+        """Registry source (kind="mesh"): the per-device placement
+        view — groups and busy seconds per device, affinity hit/miss
+        counts (``amgx_mesh_*`` families)."""
+        rs = self.router.snapshot()
+        return {
+            "policy": self.name,
+            "devices": len(self.devices),
+            "affinity_hits": rs["hits"],
+            "affinity_misses": rs["misses"],
+            "psums_total": 0,
+            "groups_total": sum(rs["groups"]),
+            "groups_per_device": {
+                str(i): n for i, n in enumerate(rs["groups"]) if n
+            },
+            "device_busy_s": {
+                str(i): s for i, s in enumerate(rs["busy_s"]) if s
+            },
+            "warm_fingerprints": sum(rs["warm_fingerprints"]),
+        }

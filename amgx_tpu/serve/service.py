@@ -343,11 +343,11 @@ class _BatchResult:
 
     __slots__ = (
         "_service", "res", "pattern", "tickets", "Bb",
-        "t_flush", "t_dispatch", "_lock", "_host", "_error",
+        "t_flush", "t_dispatch", "_lock", "_host", "_error", "plan",
     )
 
     def __init__(self, service, res, pattern, tickets, Bb,
-                 t_flush, t_dispatch):
+                 t_flush, t_dispatch, plan=None):
         self._service = service
         self.res = res
         self.pattern = pattern
@@ -355,6 +355,7 @@ class _BatchResult:
         self.Bb = Bb
         self.t_flush = t_flush
         self.t_dispatch = t_dispatch
+        self.plan = plan  # placement GroupPlan (fetch-time accounting)
         self._lock = threading.Lock()
         self._host = None
         self._error = None
@@ -366,6 +367,18 @@ class _BatchResult:
         convert lateness into a typed deadline failure."""
         with self._lock:
             return self._host is not None
+
+    def __del__(self):
+        # a group nobody ever fetched (every ticket deadline-expired
+        # or was abandoned) must still release its placement
+        # reservation — abandon() is idempotent, so a fetched group's
+        # finalizer is a no-op
+        plan = getattr(self, "plan", None)
+        if plan is not None:
+            try:
+                plan.abandon()
+            except Exception:  # noqa: BLE001 — finalizer must not raise
+                pass
 
     def fetch(self):
         with self._lock:
@@ -394,6 +407,12 @@ class _BatchResult:
                 self._error = err
                 self.res = None  # drop the (possibly poisoned) buffers
                 m.inc("failed_groups")
+                if self.plan is not None:
+                    try:
+                        self.plan.abandon()  # release the routing slot
+                    except Exception:  # noqa: BLE001 — placement
+                        # telemetry must not mask the group failure
+                        m.inc("telemetry_errors")
                 self._service._breaker_failure(self.pattern.fingerprint)
                 raise err
             t_fetch = time.perf_counter()
@@ -404,6 +423,13 @@ class _BatchResult:
             dispatch_s = self.t_dispatch - self.t_flush
             pat = self.pattern
             m.inc("host_syncs")
+            if self.plan is not None:
+                try:
+                    # placement accounting (per-device busy seconds,
+                    # mesh psum totals) — degrade, never fail a fetch
+                    self.plan.on_fetch(host, device_s)
+                except Exception:  # noqa: BLE001
+                    m.inc("telemetry_errors")
             m.add_time("device_busy_s", device_s)
             m.add_time("host_busy_s", fetch_s)
             m.record_batch(
@@ -544,6 +570,15 @@ class BatchedSolveService:
         would defeat the async pipeline).  True/False force it, e.g.
         for the bitwise donation-on/off A/B test in
         tests/test_serve.py.
+    placement: device-placement policy (:mod:`amgx_tpu.serve.placement`)
+        — a :class:`~amgx_tpu.serve.placement.PlacementPolicy`
+        instance, a spec string (``"single"`` / ``"mesh[:N]"`` /
+        ``"affinity"``), or None to resolve ``AMGX_TPU_PLACEMENT``
+        (unset = single-device, bitwise the pre-placement behavior).
+        ``MeshPlacement`` shards each group's batch axis over the
+        visible chips via ``shard_map``; ``AffinityPlacement`` routes
+        whole groups to the chip whose caches are warm for their
+        fingerprint.  See doc/MESH.md.
     """
 
     def __init__(
@@ -557,6 +592,7 @@ class BatchedSolveService:
         breaker_threshold: int = 3,
         donate: Optional[bool] = None,
         store=None,
+        placement=None,
     ):
         if config is None:
             config = DEFAULT_CONFIG
@@ -625,6 +661,17 @@ class BatchedSolveService:
         self.recorder = FlightRecorder(
             snapshot_fn=self.metrics.snapshot
         )
+        # device placement (serve/placement): WHERE a flushed group
+        # runs — None resolves AMGX_TPU_PLACEMENT (unset = the
+        # behavior-identical single-device default); stateful policies
+        # (mesh/affinity) register their per-device telemetry source
+        from amgx_tpu.serve.placement import resolve_placement
+
+        self.placement = resolve_placement(placement)
+        if self.placement.telemetry_kind is not None:
+            self.placement.telemetry_name = get_registry().register(
+                self.placement.telemetry_kind, self.placement
+            )
         self.telemetry_name = get_registry().register("serve", self)
 
     # ------------------------------------------------------------------
@@ -857,7 +904,7 @@ class BatchedSolveService:
                     lambda: self._build_entry(pattern, vals, dtype),
                 )
                 if entry.batch_fn is not None:
-                    self.compile_cache.warm(entry, Bb)
+                    self.placement.warm(self, entry, Bb)
                 self.metrics.inc("prewarms")
             except BaseException:  # noqa: BLE001 — warm-up best-effort
                 self.metrics.inc("prewarm_failures")
@@ -1048,7 +1095,7 @@ class BatchedSolveService:
             return
         bb = self._last_bucket.get(entry.signature)
         if bb:
-            self.compile_cache.warm(entry, bb)
+            self.placement.warm(self, entry, bb)
 
     # total bytes the batched dense copies may occupy (B x nb x nb);
     # above it a non-ELL bucket stays CSR (segment-sum SpMV)
@@ -1308,9 +1355,22 @@ class BatchedSolveService:
         shares the template signature (equal signatures share
         programs)."""
         sig = entry.signature
+        try:
+            # placement-resident ENTRY state (routed/replicated
+            # templates, router warm sets): drop unconditionally
+            self.placement.evicted(entry)
+        except Exception:  # noqa: BLE001 — eviction housekeeping
+            pass
         if sig is None or self.cache.any_with_signature(sig):
             return
         self.compile_cache.evict_signature(sig)
+        try:
+            # signature-keyed placement executables are shared across
+            # equal-signature entries (like the compile cache's), so
+            # they fall only with the signature's LAST entry
+            self.placement.evict_signature(sig)
+        except Exception:  # noqa: BLE001 — eviction housekeeping
+            pass
         with self._lock:
             self._last_bucket.pop(sig, None)
 
@@ -1436,7 +1496,11 @@ class BatchedSolveService:
                     "serve_compile)"
                 )
             Bb = bucket_batch(len(grp.requests))
-            fn = self.compile_cache.get(entry, Bb)
+            # placement: the policy resolves WHERE this group runs and
+            # with WHICH executable (single-device: the shared compile
+            # cache, unchanged; mesh: the shard_map program; affinity:
+            # the fingerprint's routed device)
+            plan = self.placement.plan(self, entry, Bb)
             with self._lock:
                 if len(self._last_bucket) >= self._PATTERN_CACHE_MAX:
                     self._last_bucket.clear()
@@ -1454,13 +1518,13 @@ class BatchedSolveService:
             # device stage inline and skip the worker hop.  The launch
             # itself is non-blocking, so padding of the NEXT group
             # still overlaps this group's device execution.
-            self._dispatch_batched(entry, fn, grp, live, t_flush)
+            self._dispatch_batched(entry, plan, grp, live, t_flush)
         else:
             # pipelined flush (poller/server mode): the device stage
             # runs on the single-worker executor; this thread returns
             # to padding immediately
             _dispatch_pool().submit(
-                self._dispatch_batched, entry, fn, grp, live, t_flush
+                self._dispatch_batched, entry, plan, grp, live, t_flush
             )
 
     def _group_failed(self, grp: _Group, fp: str):
@@ -1476,16 +1540,15 @@ class BatchedSolveService:
         )
         self._execute_quarantined(grp)
 
-    def _dispatch_batched(self, entry, fn, grp, live, t_flush):
+    def _dispatch_batched(self, entry, plan, grp, live, t_flush):
         """Device stage (single-worker executor): ship the staging
-        slot, launch the compiled batched solve, attach the lazy
-        result.  Returns at DISPATCH — the only block_until_ready in
-        steady state is inside SolveTicket.result().  Never raises:
-        failures quarantine the group right here in the worker."""
+        slot (through the placement plan's transfers), launch the
+        plan's compiled batched solve, attach the lazy result.
+        Returns at DISPATCH — the only block_until_ready in steady
+        state is inside SolveTicket.result().  Never raises: failures
+        quarantine the group right here in the worker."""
         fp = grp.pattern.fingerprint
         try:
-            import jax.numpy as jnp
-
             pat = grp.pattern
             slot = grp.slot
             nreq = len(grp.requests)
@@ -1497,26 +1560,28 @@ class BatchedSolveService:
                 slot.fill_batch_padding(nreq, Bb)
                 if live[0].row != 0:
                     slot.vals[nreq:Bb] = slot.vals[live[0].row]
-                vals_d = jnp.asarray(slot.vals[:Bb])
-                bs_d = jnp.asarray(slot.bs[:Bb])
-                if slot.x0_used or self.compile_cache._donate():
+                vals_d = plan.put(slot.vals[:Bb])
+                bs_d = plan.put(slot.bs[:Bb])
+                if slot.x0_used or plan.donate:
                     # warm starts (or a donated buffer, which the
                     # compiled call consumes) need a fresh transfer
-                    x0_d = jnp.asarray(slot.x0s[:Bb])
+                    x0_d = plan.put(slot.x0s[:Bb])
                 else:
                     # all-zero initial guesses: reuse one resident
                     # device block instead of shipping zeros per flush
-                    zk = (Bb, pat.nb, str(grp.dtype))
+                    # (keyed per placement target: a routed device's
+                    # zeros live on that device)
+                    zk = (Bb, pat.nb, str(grp.dtype)) + plan.zeros_key
                     with self._lock:
                         x0_d = self._zeros_x0.get(zk)
                     if x0_d is None:
-                        x0_d = jnp.zeros((Bb, pat.nb), grp.dtype)
+                        x0_d = plan.zeros(Bb, pat.nb, grp.dtype)
                         with self._lock:
                             if len(self._zeros_x0) >= 64:
                                 self._zeros_x0.clear()
                             self._zeros_x0[zk] = x0_d
                 self.metrics.inc("batches")
-                res = fn(entry.template, vals_d, bs_d, x0_d)
+                res = plan.fn(entry.template, vals_d, bs_d, x0_d)
                 # host buffers were copied to the device and the solve
                 # is launched: release ONLY now, so a pre-launch
                 # failure still leaves the rows intact for quarantine
@@ -1555,13 +1620,17 @@ class BatchedSolveService:
                     )
             br = _BatchResult(
                 self, res, pat, [r.ticket for r in live], Bb,
-                t_flush, t_dispatch,
+                t_flush, t_dispatch, plan=plan,
             )
             for r in live:
                 r.ticket._batch = br
                 r.ticket._done = True
             self._breaker_success(fp)
         except BaseException:  # noqa: BLE001 — worker must not die
+            try:
+                plan.abandon()  # release any routing reservation
+            except Exception:  # noqa: BLE001 — placement telemetry
+                self.metrics.inc("telemetry_errors")
             self._group_failed(grp, fp)
 
     def _execute_quarantined(self, grp: _Group):

@@ -171,6 +171,19 @@ class SolveSession:
         return None, False
 
     @property
+    def placement_device(self) -> Optional[str]:
+        """Label of the device the serve placement policy holds this
+        session's hierarchy on (cache-affinity routing, PR 10): every
+        step of the session — one fingerprint — routes there, so a
+        streamed hierarchy never migrates between chips mid-stream.
+        None before the first step lands, or under a non-routing
+        policy (single-device, mesh)."""
+        fp = self._padded_fp
+        if fp is None:
+            return None
+        return self.manager.service.placement.device_for(fp)
+
+    @property
     def last_x(self) -> Optional[np.ndarray]:
         """The last resolved step's solution (converged or not) —
         the implicit-Euler client's state vector.  Warm-start MASKING

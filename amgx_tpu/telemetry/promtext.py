@@ -261,6 +261,13 @@ def gateway_families(fams: FamilyTable, comp: str, snap: dict) -> None:
                      "tenant/lane (each ticket's even share of its "
                      "group's device time — fleet cost accounting)",
                      {**labels, "tenant": tenant, "lane": lane}, secs)
+    for tenant, tokens in (snap.get("tenant_device_tokens") or {}
+                           ).items():
+        fams.add("amgx_admission_tenant_device_seconds", "gauge",
+                 "remaining device-seconds budget per tenant "
+                 "(negative = debt being refilled; admits shed typed "
+                 "reason=device_budget while negative)",
+                 {**labels, "tenant": tenant}, tokens)
     rec = snap.get("recorder") or {}
     fams.add("amgx_flight_records_total", "counter",
              "per-solve flight-recorder records", labels,
@@ -384,6 +391,53 @@ def session_families(fams: FamilyTable, comp: str, snap: dict) -> None:
                      f"session counter {k}", labels, v)
 
 
+def mesh_families(fams: FamilyTable, comp: str, snap: dict) -> None:
+    """PlacementPolicy.telemetry_snapshot() (mesh/affinity, PR 10) ->
+    amgx_mesh_* families: groups and busy seconds per device,
+    convergence-mask psum totals, cache-affinity hit/miss counts."""
+    labels = {"component": comp, "policy": snap.get("policy", "?")}
+    fams.add("amgx_mesh_devices", "gauge",
+             "devices visible to the placement policy", labels,
+             snap.get("devices"))
+    fams.add("amgx_mesh_groups_total", "counter",
+             "groups placed by the policy", labels,
+             snap.get("groups_total"))
+    fams.add("amgx_mesh_sharded_groups_total", "counter",
+             "groups whose batch axis was sharded over the mesh",
+             labels, snap.get("sharded_groups_total"))
+    fams.add("amgx_mesh_psums_total", "counter",
+             "cross-chip convergence-mask psums executed (the ONLY "
+             "collective of a batch-sharded group; one per group-loop "
+             "iteration)", labels, snap.get("psums_total"))
+    fams.add("amgx_mesh_psum_sites_per_iteration", "gauge",
+             "psum call sites traced into the sharded group loop "
+             "(gated == 1 by ci/mesh_bench.py)", labels,
+             snap.get("psum_sites_per_iteration"))
+    fams.add("amgx_mesh_compiles_total", "counter",
+             "sharded executables compiled", labels,
+             snap.get("mesh_compiles"))
+    hits = snap.get("affinity_hits")
+    misses = snap.get("affinity_misses")
+    fams.add("amgx_mesh_affinity_hits_total", "counter",
+             "groups routed to a device whose caches were already "
+             "warm for their fingerprint", labels, hits)
+    fams.add("amgx_mesh_affinity_misses_total", "counter",
+             "groups routed cold (least-loaded fallback)", labels,
+             misses)
+    if hits is not None and misses is not None and (hits + misses):
+        fams.add("amgx_mesh_affinity_hit_ratio", "gauge",
+                 "warm-routing fraction of routed groups", labels,
+                 hits / (hits + misses))
+    for dev, n in (snap.get("groups_per_device") or {}).items():
+        fams.add("amgx_mesh_device_groups_total", "counter",
+                 "groups executed per device", {**labels, "device": dev},
+                 n)
+    for dev, secs in (snap.get("device_busy_s") or {}).items():
+        fams.add("amgx_mesh_device_busy_seconds_total", "counter",
+                 "device-execution seconds per device",
+                 {**labels, "device": dev}, secs)
+
+
 def tracing_families(fams: FamilyTable, comp: str, snap: dict) -> None:
     labels = {"component": comp}
     fams.add("amgx_trace_spans_total", "counter",
@@ -414,6 +468,7 @@ _RENDERERS = {
     "store": store_families,
     "solvers": solver_families,
     "sessions": session_families,
+    "mesh": mesh_families,
     "tracing": tracing_families,
     "recorder": recorder_families,
 }

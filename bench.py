@@ -481,6 +481,51 @@ def _session_record():
         return {"error": str(e)}
 
 
+def _mesh_record():
+    """Mesh serving (PR 10): batch-axis-sharded solves/s vs the
+    single-device policy plus affinity routing (ci/mesh_bench.py,
+    reduced sizes).  Skipped with a note when the process sees only
+    one device (the simulated mesh is a process-start XLA flag).
+    Guarded — must never take the headline bench down."""
+    try:
+        import os
+        import sys as _sys
+
+        import jax
+
+        if len(jax.devices()) < 2:
+            return {"skipped": "single device (set XLA_FLAGS="
+                               "--xla_force_host_platform_device_"
+                               "count=8 before start)"}
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.mesh_bench import run as mesh_run
+
+        rec, problems = mesh_run(shape=(56, 56), batch=16, reps=2,
+                                 waves=2)
+        out = {
+            k: rec[k]
+            for k in (
+                "value",
+                "unit",
+                "devices",
+                "shards",
+                "single_solves_per_s",
+                "mesh_solves_per_s",
+                "parity_bitwise",
+                "affinity_hit_rate",
+                "shared_psums_total",
+                "ok",
+            )
+            if k in rec
+        }
+        if problems:
+            out["problems"] = problems
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: mesh record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _telemetry_record():
     """Telemetry overhead A/B (armed sample=0 vs disarmed, one warmed
     service; ci/telemetry_check.py, reduced reps) plus exposition /
@@ -640,6 +685,10 @@ def main():
     session_rec = _session_record()
     print(f"bench: session {session_rec}", file=sys.stderr)
 
+    # ---- mesh serving (batch-axis sharding + affinity routing) -----
+    mesh_rec = _mesh_record()
+    print(f"bench: mesh {mesh_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -664,6 +713,7 @@ def main():
                 "telemetry": telemetry_rec,
                 "sstep": sstep_rec,
                 "session": session_rec,
+                "mesh": mesh_rec,
             }
         )
     )

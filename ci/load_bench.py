@@ -4,6 +4,13 @@ sustainable throughput and assert the graceful-degradation contract.
 Prints ONE JSON line (same contract as serve_bench/store_bench):
 {"metric": "fleet_overload", "value": <interactive p99 s>, ...}.
 
+Placement-policy aware (PR 10): the service resolves
+``AMGX_TPU_PLACEMENT`` (single | mesh[:N[:shared]] | affinity;
+default single-device, behavior unchanged), so the same overload,
+shed-typing and drain floors can be asserted against a sharded or
+affinity-routed mesh — the active policy is recorded in the JSON
+line.
+
 Methodology (closed-loop calibration, open-loop attack):
 
 1. **Sustainable throughput** — a closed-loop phase: K worker threads
@@ -307,6 +314,10 @@ def run(shape=(8, 8), duration_s=3.0, calib_s=1.0, drain_s=1.5,
         "metric": "fleet_overload",
         "value": round(p99_i, 6) if p99_i is not None else None,
         "unit": "interactive p99 s at 2x sustainable load",
+        # placement-policy aware (PR 10): AMGX_TPU_PLACEMENT selects
+        # the service's policy (default single-device, unchanged), so
+        # the overload/shed/drain contracts are exercisable on a mesh
+        "placement": svc.placement.name,
         "device": jax.devices()[0].platform,
         "problem": f"poisson5_{shape[0]}x{shape[1]}_2tenant",
         "sustainable_per_s": round(sustainable, 1),

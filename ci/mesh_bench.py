@@ -1,0 +1,324 @@
+"""Mesh serving CI gate: batch-axis sharding floors on the simulated
+8-device CPU mesh.
+
+Prints ONE JSON line (same contract as the other ci/ gates) and exits
+non-zero when any of the mesh-serving contracts regress:
+
+* **throughput** — MeshPlacement solves/s at B=32 on the 56x56
+  Poisson family below 2x the single-device policy on every one of
+  three time-diversified attempts (conservative: the simulated
+  devices share the host's cores; a real mesh adds chips, simulation
+  only adds parallel slack).  The 56x56 size keeps the wave
+  device-dominated with the widest margin on a 2-core host — smaller
+  sides are bound by host-side submit staging (which no placement
+  policy can improve), much larger ones let single-device XLA spread
+  each op across the same cores the shards would use.  Interleaved
+  a/b waves + best-of + retry attempts are the same noise protocol
+  as the telemetry overhead gate;
+* **parity** — sharded results diverge from unsharded beyond rtol
+  1e-12.  The psum'd shared convergence mask gives every shard the
+  unsharded trip count, so parity is BITWISE whenever each shard
+  holds >= 2 instances (the bench reports ``parity_bitwise``); the
+  tolerance exists only for the degenerate 1-instance-per-shard
+  tiling (doc/MESH.md "Numerical parity");
+* **sync discipline** — more than one host sync per batched group
+  (the zero-per-iteration-host-sync contract, sharded or not);
+* **collectives** — the default (local-mask) sharded loop traces to
+  any psum at all, or the shared-mask loop traces to more than ONE
+  psum site per iteration (the shared convergence mask must be the
+  only cross-chip collective) or mismatches the unsharded results;
+* **affinity** — the AffinityPlacement router misses a warm
+  fingerprint on the repeated-fingerprint workload (hit rate must be
+  100% after the first, cold wave);
+* **default regression** — a default-constructed service (placement
+  unset) is not bitwise identical to an explicit SingleDevicePolicy
+  service (the pre-placement dispatch path must be unchanged).
+
+Run: JAX_PLATFORMS=cpu python ci/mesh_bench.py   (forces the 8-device
+virtual mesh itself when XLA_FLAGS does not already).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+# must precede any jax import: simulated chips are a process-start knob
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def _wave(svc, systems):
+    """One submit+consume cycle of a full group (the serve_bench
+    measurement unit); returns (seconds, results)."""
+    t0 = time.perf_counter()
+    tickets = [svc.submit(sp, b) for sp, b in systems]
+    results = [t.result() for t in tickets]
+    return time.perf_counter() - t0, results
+
+
+def _timed_pair(svc_a, svc_b, systems, reps, waves):
+    """Best wave per service with the two arms INTERLEAVED (a/b/a/b
+    within every rep, order flipping per wave): host-load drift and
+    CPU-frequency excursions then hit both arms alike instead of
+    biasing whichever ran second — the same noise-hardening
+    ci/telemetry_check.py uses for its overhead A/B."""
+    best_a = best_b = float("inf")
+    res_a = res_b = None
+    for _ in range(reps):
+        for w in range(waves):
+            order = ((svc_a, "a"), (svc_b, "b"))
+            if w % 2:
+                order = order[::-1]
+            for svc, tag in order:
+                dt, res = _wave(svc, systems)
+                if tag == "a":
+                    if dt < best_a:
+                        best_a, res_a = dt, res
+                elif dt < best_b:
+                    best_b, res_b = dt, res
+    return best_a, res_a, best_b, res_b
+
+
+def run(shape=(56, 56), batch=32, reps=3, waves=4):
+    import numpy as np
+
+    import jax
+
+    from amgx_tpu.io.poisson import jittered_poisson_family, poisson_scipy
+    from amgx_tpu.serve import BatchedSolveService
+    from amgx_tpu.serve.placement import (
+        AffinityPlacement,
+        MeshPlacement,
+        SingleDevicePolicy,
+    )
+
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_enable_x64", True)
+    ndev = len(jax.devices())
+    problems: list = []
+    systems = jittered_poisson_family(shape, batch, seed=0)
+
+    # ---- single-device baseline + mesh-sharded run -----------------
+    svc_default = BatchedSolveService(max_batch=batch)
+    svc_default.solve_many(systems)  # warm: setup + compile
+    mesh_policy = MeshPlacement()
+    svc_mesh = BatchedSolveService(max_batch=batch, placement=mesh_policy)
+    svc_mesh.solve_many(systems)  # warm: shard_map compile
+    # time-diversified attempts (the ci/telemetry_check.py noise
+    # protocol): on a small shared CI host a noisy-neighbor burst or
+    # frequency excursion long enough to skew one whole interleaved
+    # measurement rarely spans three — a real sharding regression
+    # fails every attempt
+    attempts = 0
+    speedup = 0.0
+    t_single = t_mesh = float("inf")
+    r_default = r_mesh = None
+    for attempt in range(3):
+        attempts = attempt + 1
+        a_single, a_rd, a_mesh, a_rm = _timed_pair(
+            svc_default, svc_mesh, systems, reps, waves
+        )
+        if a_single / a_mesh > speedup:
+            speedup = a_single / a_mesh
+            t_single, r_default, t_mesh, r_mesh = (
+                a_single, a_rd, a_mesh, a_rm,
+            )
+        if ndev <= 1 or speedup >= 2.0:
+            break
+        time.sleep(2.0)
+
+    # ---- default-vs-explicit bitwise regression --------------------
+    svc_explicit = BatchedSolveService(
+        max_batch=batch, placement=SingleDevicePolicy()
+    )
+    r_explicit = svc_explicit.solve_many(systems)
+    default_bitwise = all(
+        np.array_equal(np.asarray(a.x), np.asarray(b.x))
+        and int(a.iters) == int(b.iters)
+        and int(a.status) == int(b.status)
+        for a, b in zip(r_default, r_explicit)
+    )
+    if not default_bitwise:
+        problems.append(
+            "default placement is not bitwise identical to the "
+            "explicit SingleDevicePolicy (pre-PR dispatch regressed)"
+        )
+    if svc_default.placement.name != "single":
+        problems.append(
+            f"default policy resolved to {svc_default.placement.name!r}"
+        )
+
+    bitwise = True
+    max_rel = 0.0
+    for a, b in zip(r_default, r_mesh):
+        xa, xb = np.asarray(a.x), np.asarray(b.x)
+        if not np.array_equal(xa, xb):
+            bitwise = False
+        denom = max(float(np.linalg.norm(xa)), 1e-300)
+        max_rel = max(
+            max_rel, float(np.linalg.norm(xa - xb)) / denom
+        )
+        if int(a.iters) != int(b.iters) or int(a.status) != int(b.status):
+            problems.append(
+                "sharded iteration counts/statuses diverged from "
+                f"unsharded (iters {int(a.iters)} vs {int(b.iters)})"
+            )
+            break
+    if max_rel > 1e-12:
+        problems.append(
+            f"sharded-vs-unsharded relative error {max_rel:.3e} above "
+            "the 1e-12 parity gate"
+        )
+
+    m = svc_mesh.metrics.snapshot()
+    syncs_per_group = m.get("host_syncs", 0) / max(m.get("batches", 1), 1)
+    if syncs_per_group > 1.0:
+        problems.append(
+            "mesh service exceeded one host sync per group "
+            f"({syncs_per_group:.3f})"
+        )
+    msnap = mesh_policy.telemetry_snapshot()
+    if ndev > 1 and msnap["sharded_groups_total"] == 0:
+        problems.append("no group was actually sharded over the mesh")
+    if ndev > 1 and msnap["psums_total"] != 0:
+        problems.append(
+            "local-mask mesh executed collectives "
+            f"({msnap['psums_total']} psums) — the local mode must be "
+            "communication-free"
+        )
+    if ndev > 1 and speedup < 2.0:
+        problems.append(
+            f"mesh speedup {speedup:.2f}x below the 2x floor on "
+            f"{ndev} simulated devices"
+        )
+
+    # ---- shared-mask mode: psum accounting + parity ----------------
+    shared_policy = MeshPlacement(convergence="shared")
+    svc_shared = BatchedSolveService(
+        max_batch=batch, placement=shared_policy
+    )
+    r_shared = svc_shared.solve_many(systems)
+    ssnap = shared_policy.telemetry_snapshot()
+    shared_rel = max(
+        (
+            float(np.linalg.norm(np.asarray(a.x) - np.asarray(b.x)))
+            / max(float(np.linalg.norm(np.asarray(a.x))), 1e-300)
+            for a, b in zip(r_default, r_shared)
+        ),
+        default=0.0,
+    )
+    if shared_rel > 1e-12:
+        problems.append(
+            f"shared-mask sharded results diverged ({shared_rel:.3e})"
+        )
+    if ndev > 1 and ssnap["psum_sites_per_iteration"] != 1:
+        problems.append(
+            "shared-mask group loop traced to "
+            f"{ssnap['psum_sites_per_iteration']} psum sites per "
+            "iteration (the shared mask must be the only collective)"
+        )
+    if ndev > 1 and ssnap["psums_total"] < 1:
+        problems.append("shared-mask group executed no psum at all")
+
+    # ---- affinity: 100% warm routing on repeated fingerprints ------
+    affinity = AffinityPlacement()
+    svc_aff = BatchedSolveService(max_batch=8, placement=affinity)
+    rng = np.random.default_rng(0)
+    fams = []
+    for side in (10, 12, 14, 16):
+        sp = poisson_scipy((side, side)).tocsr()
+        sp.sort_indices()
+        fams.append((sp, rng.standard_normal(sp.shape[0])))
+    svc_aff.solve_many(fams)  # cold wave: one miss per fingerprint
+    base = affinity.telemetry_snapshot()
+    warm_waves = 4
+    for _ in range(warm_waves):
+        for r in svc_aff.solve_many(fams):
+            assert int(r.status) == 0
+    snap = affinity.telemetry_snapshot()
+    warm_routes = snap["affinity_hits"] - base["affinity_hits"]
+    warm_misses = snap["affinity_misses"] - base["affinity_misses"]
+    hit_rate = warm_routes / max(warm_routes + warm_misses, 1)
+    if hit_rate < 1.0:
+        problems.append(
+            f"affinity hit rate {hit_rate:.3f} below 1.0 on the "
+            "repeated-fingerprint workload"
+        )
+    if ndev > 1 and len(snap["groups_per_device"]) < 2:
+        problems.append(
+            "affinity routed every fingerprint to one device "
+            f"({snap['groups_per_device']})"
+        )
+
+    dev = jax.devices()[0]
+    rec = {
+        "metric": "mesh_sharded_speedup",
+        "value": round(speedup, 2),
+        "unit": f"x vs single-device policy at B={batch}",
+        "device": f"{dev.platform} x{ndev}",
+        "problem": f"poisson5_{shape[0]}x{shape[1]}_B{batch}",
+        "devices": ndev,
+        "shards": mesh_policy.n_shards(batch),
+        "t_single_s": round(t_single, 5),
+        "t_mesh_s": round(t_mesh, 5),
+        "single_solves_per_s": round(batch / t_single, 1),
+        "mesh_solves_per_s": round(batch / t_mesh, 1),
+        "parity_bitwise": bitwise,
+        "parity_max_rel": max_rel,
+        "default_bitwise": default_bitwise,
+        "host_syncs_per_group": round(syncs_per_group, 3),
+        "convergence_mask": mesh_policy.convergence,
+        "shared_psum_sites_per_iteration":
+            ssnap["psum_sites_per_iteration"],
+        "shared_psums_total": ssnap["psums_total"],
+        "shared_parity_max_rel": shared_rel,
+        "sharded_groups": msnap["sharded_groups_total"],
+        "affinity_hit_rate": round(hit_rate, 3),
+        "affinity_devices_used": len(snap["groups_per_device"]),
+        "attempts": attempts,
+        "ok": not problems,
+    }
+    return rec, problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON record to this file")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--side", type=int, default=56,
+                    help="2D Poisson side length (56: device-dominated "
+                         "waves with the widest measured margin over "
+                         "the 2x floor on the 2-core CI host; smaller "
+                         "sides are submit-bound, much larger ones "
+                         "let single-device XLA spread intra-op "
+                         "across the same cores)")
+    args = ap.parse_args(argv)
+
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    rec, problems = run(shape=(args.side, args.side), batch=args.batch)
+    line = json.dumps(rec)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    for p in problems:
+        print(f"mesh_bench: {p}", file=sys.stderr)
+    return len(problems)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

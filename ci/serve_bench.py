@@ -127,6 +127,11 @@ def run(shape=(16, 16), batch=16, reps=3, waves=8, config=None):
         "metric": "serve_batched_speedup",
         "value": round(t_seq / t_batch, 2),
         "unit": "x vs sequential python loop",
+        # placement-policy aware (PR 10): AMGX_TPU_PLACEMENT selects
+        # the policy the service runs under (default: single-device,
+        # unchanged); the record names it so a mesh/affinity run is
+        # distinguishable
+        "placement": svc.placement.name,
         "device": f"{dev.platform}"
         f" ({getattr(dev, 'device_kind', '?')})",
         "problem": f"poisson5_{shape[0]}x{shape[1]}_B{batch}",

@@ -4,10 +4,11 @@ the overhead ceiling.
 Prints ONE JSON line (same contract as the other ci/ gates) and exits
 non-zero when:
 
-* the Prometheus exposition fails to parse, exports fewer than 30
+* the Prometheus exposition fails to parse, exports fewer than 34
   distinct metric names, misses one of the required sources
   (serve, gateway/admission, store, cache, setup-phase, solver,
-  session), or misses the PR 8 communication-observability names
+  session, mesh placement), or misses the PR 8
+  communication-observability names
   (amgx_solver_reductions_total, amgx_solver_iterations_bucket);
 * a sampled gateway request does not produce a CONNECTED
   submit -> admission -> pad -> dispatch -> device -> fetch span
@@ -29,12 +30,24 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import sys
 import tempfile
 import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+# simulated 8-chip mesh (must precede any jax import): the mesh
+# placement source (amgx_mesh_* families, PR 10) needs devices to
+# shard over
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 # an AMG-preconditioned config so the cold setup exercises the PR 5
 # phase profiler (the "setup-phase source" of the metric catalog).
@@ -147,6 +160,20 @@ def _validate_observability(problems, store_dir):
                 f"direct SSTEP_PCG solve failed: {int(sres.status)}"
             )
 
+        # mesh placement source (PR 10): a batch-sharded group over
+        # the simulated mesh feeds the amgx_mesh_* families (with one
+        # real device the policy still registers and exports its
+        # gauges, so the source gate stays meaningful)
+        from amgx_tpu.serve import BatchedSolveService
+        from amgx_tpu.serve.placement import MeshPlacement
+
+        msvc = BatchedSolveService(max_batch=8, placement=MeshPlacement())
+        mres = msvc.solve_many(
+            [(sp, rng.standard_normal(n)) for _ in range(8)]
+        )
+        if any(int(r.status) != 0 for r in mres):
+            problems.append("mesh-placed workload solves failed")
+
         # ---- prometheus ------------------------------------------
         text = telemetry.get_registry().render_prometheus()
         names = set()
@@ -158,13 +185,13 @@ def _validate_observability(problems, store_dir):
                 problems.append(f"unparseable exposition line: {line!r}")
                 break
             names.add(m.group(1))
-        if len(names) < 30:
+        if len(names) < 34:
             problems.append(
-                f"only {len(names)} metric names exported (floor 30)"
+                f"only {len(names)} metric names exported (floor 34)"
             )
         for prefix in ("amgx_serve_", "amgx_gateway_", "amgx_store_",
                        "amgx_cache_", "amgx_setup_phase_",
-                       "amgx_solver_", "amgx_session_"):
+                       "amgx_solver_", "amgx_session_", "amgx_mesh_"):
             if not any(nm.startswith(prefix) for nm in names):
                 problems.append(f"no metric from source {prefix}*")
         for required in ("amgx_solver_reductions_total",

@@ -61,10 +61,26 @@ JAX_PLATFORMS=cpu python ci/smoother_bench.py
 # in-flight solve (pipelining regression).
 JAX_PLATFORMS=cpu python ci/session_bench.py
 
+# ---- mesh serving: sharded placement floors --------------------------
+# One JSON line; non-zero exit when batch-axis sharding across the 8
+# simulated CPU devices drops below 2x single-device solves/s at B=32
+# on the 56^2 Poisson family (best of three time-diversified
+# interleaved attempts), sharded results diverge from unsharded
+# beyond 1e-12 (bitwise expected; the record reports it), the steady
+# state exceeds one host sync per group, the shared-convergence-mask
+# loop traces to more than one psum site per iteration (or the
+# default local mode executes any collective), the affinity router
+# misses a warm fingerprint on the repeated-fingerprint workload, or
+# the default single-device policy is not bitwise identical to the
+# explicit one (pre-placement dispatch regression).
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python ci/mesh_bench.py
+
 # ---- unified telemetry: exposition + tracing + overhead --------------
 # One JSON line; non-zero exit when the Prometheus exposition fails to
-# parse or exports fewer than 30 metric names across the serve /
-# admission / store / cache / setup-phase / solver / session sources,
+# parse or exports fewer than 34 metric names across the serve /
+# admission / store / cache / setup-phase / solver / session / mesh
+# placement sources,
 # when a sampled gateway request does not produce a connected
 # submit->admission->pad->dispatch->device->fetch span chain in the
 # Chrome trace JSON, when a sampled streaming-session step does not

@@ -148,6 +148,81 @@ def test_tenant_quota_isolates_tenants(sysmat):
 
 
 # ---------------------------------------------------------------------------
+# per-tenant device-seconds ENFORCEMENT (PR 10; PR 9 added the counter)
+
+
+def test_device_budget_post_paid_controller():
+    """Unit: the device-seconds budget admits while the balance is
+    non-negative, sheds typed (reason=device_budget) once post-paid
+    charges push it into debt, and re-admits after the refill —
+    retry_after_s is exactly the debt-clearing time."""
+    from amgx_tpu.serve import AdmissionController
+
+    clock = [0.0]
+    ctl = AdmissionController(
+        quotas={"big": TenantQuota(
+            rate=1e9, burst=1e9,
+            device_seconds_rate=0.5, device_seconds_burst=1.0,
+        )},
+        clock=lambda: clock[0],
+    )
+    ctl.admit(tenant="big")
+    ctl.release()
+    # charge 2 device-seconds against a 1.0 s balance: 1.0 s of debt
+    ctl.charge_device_seconds("big", 2.0)
+    with pytest.raises(AdmissionRejected) as ei:
+        ctl.admit(tenant="big")
+    assert ei.value.reason == "device_budget"
+    # debt of 1.0 s refills at 0.5 dev-s/s -> 2 s to a zero balance
+    assert ei.value.retry_after_s == pytest.approx(2.0)
+    # the shed must not leak budget
+    assert ctl.inflight == 0
+    clock[0] += 2.0  # refill clears the debt
+    ctl.admit(tenant="big")
+    ctl.release()
+    # budget-less tenants are untouched
+    ctl.charge_device_seconds("other", 100.0)
+    ctl.admit(tenant="other")
+    ctl.release()
+    snap = ctl.snapshot()
+    assert "big" in snap["tenant_device_tokens"]
+
+
+def test_device_budget_enforced_end_to_end(sysmat):
+    """A tenant with a vanishing device-seconds budget solves its
+    first group (post-paid), is charged its measured device time at
+    the fetch, and is then shed typed at the door."""
+    n = sysmat.shape[0]
+    gw = SolveGateway(
+        max_batch=4,
+        quotas={"big": TenantQuota(
+            rate=1e9, burst=1e9,
+            device_seconds_rate=1e-9, device_seconds_burst=1e-9,
+        )},
+        retry_after_cap_s=30.0,
+    )
+    tickets = [
+        gw.submit(sysmat, _rhs(n, i), tenant="big") for i in range(4)
+    ]
+    gw.flush()
+    for t in tickets:
+        assert int(t.result().status) == 0
+    # the group's device time (>> 1e-9 s budget) is now charged
+    with pytest.raises(AdmissionRejected) as ei:
+        gw.submit(sysmat, _rhs(n, 9), tenant="big")
+    assert ei.value.reason == "device_budget"
+    assert 0.0 < ei.value.retry_after_s <= 30.0
+    assert gw.metrics.get("shed_device_budget") == 1
+    # the balance (debt) is visible to telemetry
+    snap = gw.telemetry_snapshot()
+    assert snap["tenant_device_tokens"]["big"] < 0.0
+    # an unbudgeted tenant still serves
+    t = gw.submit(sysmat, _rhs(n, 10), tenant="small")
+    gw.flush()
+    assert int(t.result().status) == 0
+
+
+# ---------------------------------------------------------------------------
 # global concurrency budget + lanes
 
 

@@ -1,0 +1,369 @@
+"""Mesh serving placement (amgx_tpu.serve.placement): single-device
+bitwise regression, sharded-vs-unsharded parity on the simulated
+8-device CPU mesh, affinity routing, session-to-hierarchy-device
+routing, masked-convergence psum correctness, policy selection."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from amgx_tpu.io.poisson import jittered_poisson_family, poisson_scipy
+from amgx_tpu.serve import DEFAULT_CONFIG, BatchedSolveService
+from amgx_tpu.serve.placement import (
+    AffinityPlacement,
+    AffinityRouter,
+    MeshPlacement,
+    SingleDevicePolicy,
+    parse_placement,
+    resolve_placement,
+    template_partition_specs,
+)
+
+pytestmark = pytest.mark.serve
+
+multichip = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="needs the simulated multi-device CPU mesh (conftest)",
+)
+
+
+def _results_equal(ra, rb, bitwise=True):
+    assert len(ra) == len(rb)
+    for a, b in zip(ra, rb):
+        xa, xb = np.asarray(a.x), np.asarray(b.x)
+        if bitwise:
+            assert np.array_equal(xa, xb), (
+                f"solutions diverged: max |d|="
+                f"{np.max(np.abs(xa - xb))}"
+            )
+        else:
+            np.testing.assert_allclose(xa, xb, rtol=1e-12, atol=0)
+        assert int(a.iters) == int(b.iters)
+        assert int(a.status) == int(b.status)
+
+
+# ---------------------------------------------------------------------
+# policy selection
+
+
+def test_parse_placement_specs():
+    assert isinstance(parse_placement(""), SingleDevicePolicy)
+    assert isinstance(parse_placement("single"), SingleDevicePolicy)
+    mp = parse_placement("mesh")
+    assert isinstance(mp, MeshPlacement) and mp.convergence == "local"
+    mp = parse_placement("mesh:2")
+    assert isinstance(mp, MeshPlacement) and mp.max_shards == 2
+    mp = parse_placement("mesh:shared")
+    assert mp.convergence == "shared" and mp.max_shards is None
+    mp = parse_placement("mesh:4:shared")
+    assert mp.convergence == "shared" and mp.max_shards == 4
+    assert isinstance(parse_placement("affinity"), AffinityPlacement)
+    with pytest.raises(ValueError):
+        parse_placement("torus")
+    with pytest.raises(ValueError):
+        parse_placement("mesh:zero")
+    with pytest.raises(ValueError):
+        parse_placement("mesh:0")
+    with pytest.raises(ValueError):
+        MeshPlacement(convergence="sometimes")
+
+
+def test_resolve_placement_env(monkeypatch):
+    monkeypatch.delenv("AMGX_TPU_PLACEMENT", raising=False)
+    assert isinstance(resolve_placement(None), SingleDevicePolicy)
+    monkeypatch.setenv("AMGX_TPU_PLACEMENT", "affinity")
+    assert isinstance(resolve_placement(None), AffinityPlacement)
+    # explicit argument wins over the environment
+    assert isinstance(resolve_placement("single"), SingleDevicePolicy)
+    monkeypatch.setenv("AMGX_TPU_PLACEMENT", "bogus")
+    with pytest.raises(ValueError):
+        BatchedSolveService()
+    with pytest.raises(TypeError):
+        resolve_placement(42)
+
+
+# ---------------------------------------------------------------------
+# single-device default: bitwise regression
+
+
+def test_single_policy_bitwise_parity_with_default():
+    """A default-constructed service (placement=None, env unset) and
+    an explicit SingleDevicePolicy service produce bitwise-identical
+    results — the pre-placement dispatch path is unchanged."""
+    systems = jittered_poisson_family((10, 10), 8, seed=3)
+    svc_default = BatchedSolveService(max_batch=8)
+    assert svc_default.placement.name == "single"
+    assert svc_default.placement.telemetry_kind is None
+    svc_explicit = BatchedSolveService(
+        max_batch=8, placement=SingleDevicePolicy()
+    )
+    _results_equal(
+        svc_default.solve_many(systems),
+        svc_explicit.solve_many(systems),
+        bitwise=True,
+    )
+    # the default path still runs through the shared AOT compile cache
+    assert svc_default.metrics.get("compiles") >= 1
+    # zeros-x0 reuse key is unchanged (3-tuple + empty suffix)
+    assert all(len(k) == 3 for k in svc_default._zeros_x0)
+
+
+# ---------------------------------------------------------------------
+# mesh sharding: parity + psum accounting
+
+
+@multichip
+def test_mesh_sharded_matches_unsharded_bitwise():
+    """B=16 over the 8 simulated devices (default local mask):
+    per-instance solutions, iteration counts and statuses are BITWISE
+    those of the unsharded single-device group — converged instances
+    freeze under the commit mask, so shard-local early exit cannot
+    disturb them — and the local mode executes ZERO collectives."""
+    systems = jittered_poisson_family((12, 12), 16, seed=0)
+    svc_single = BatchedSolveService(max_batch=16)
+    svc_mesh = BatchedSolveService(
+        max_batch=16, placement=MeshPlacement()
+    )
+    assert svc_mesh.placement.convergence == "local"
+    r_single = svc_single.solve_many(systems)
+    r_mesh = svc_mesh.solve_many(systems)
+    _results_equal(r_single, r_mesh, bitwise=True)
+    snap = svc_mesh.placement.telemetry_snapshot()
+    assert snap["sharded_groups_total"] == 1
+    assert snap["psums_total"] == 0  # local mode: no collectives
+    assert len(snap["groups_per_device"]) == min(8, len(jax.devices()))
+    # one host sync per batched group, sharded or not
+    assert svc_mesh.metrics.get("host_syncs") == 1
+
+
+@multichip
+def test_mesh_shared_mask_psum_parity_and_accounting():
+    """Shared-mask mode: the psum'd convergence mask keeps every
+    shard on the unsharded trip count (bitwise parity at 2
+    instances/shard), the compiled loop carries exactly ONE psum site
+    per iteration, and the runtime psum total is trips + the final
+    exit check."""
+    systems = jittered_poisson_family((12, 12), 16, seed=0)
+    svc_single = BatchedSolveService(max_batch=16)
+    svc_mesh = BatchedSolveService(
+        max_batch=16, placement=MeshPlacement(convergence="shared")
+    )
+    r_single = svc_single.solve_many(systems)
+    r_mesh = svc_mesh.solve_many(systems)
+    _results_equal(r_single, r_mesh, bitwise=True)
+    snap = svc_mesh.placement.telemetry_snapshot()
+    assert snap["convergence"] == "shared"
+    assert snap["psum_sites_per_iteration"] == 1
+    trips = max(int(r.iters) for r in r_mesh)
+    assert snap["psums_total"] == trips + 1
+
+
+@multichip
+def test_mesh_masked_convergence_mixed_iterations():
+    """Instances engineered to converge at very different iterations
+    (well- vs ill-conditioned), deliberately laid out so shards
+    finish at different local iterations: the shared psum'd mask must
+    keep shards in lockstep without disturbing per-instance masked
+    freezing (masked-convergence psum correctness)."""
+    base = poisson_scipy((12, 12)).tocsr()
+    base.sort_indices()
+    n = base.shape[0]
+    rng = np.random.default_rng(7)
+    systems = []
+    for i in range(8):
+        sp = base.copy()
+        if i % 2:
+            # strongly diagonally dominant: converges in a few iters
+            sp.data = sp.data + 0.0
+            sp.setdiag(sp.diagonal() * 50.0)
+        sp = sp.tocsr()
+        sp.sort_indices()
+        systems.append((sp, rng.standard_normal(n)))
+    svc_single = BatchedSolveService(max_batch=8)
+    svc_mesh = BatchedSolveService(
+        max_batch=8, placement=MeshPlacement(convergence="shared")
+    )
+    r_single = svc_single.solve_many(systems)
+    r_mesh = svc_mesh.solve_many(systems)
+    iters = sorted(int(r.iters) for r in r_single)
+    assert iters[0] < iters[-1], "workload failed to mix iterations"
+    # B=8 over 8 chips degenerates to ONE instance per shard: XLA may
+    # re-tile the per-instance reductions for the rank-reduced local
+    # batch, so this is the documented within-tolerance case (ULP
+    # noise); iteration counts and statuses stay exact — the psum'd
+    # mask kept every shard on the global trip count (doc/MESH.md
+    # "Numerical parity")
+    _results_equal(r_single, r_mesh, bitwise=False)
+
+
+@multichip
+def test_mesh_shard_count_divides_batch():
+    mp = MeshPlacement()
+    cap = 1
+    while cap * 2 <= len(jax.devices()):
+        cap *= 2
+    assert mp.n_shards(32) == cap
+    assert mp.n_shards(4) == min(4, cap)
+    assert mp.n_shards(1) == 1
+    capped = MeshPlacement(max_shards=2)
+    assert capped.n_shards(32) == 2
+
+
+def test_template_partition_specs_rules():
+    from jax.sharding import PartitionSpec as P
+
+    template = {"diag": np.zeros((16,)), "meta": {"w": np.zeros((4, 4))},
+                "scalar": np.float64(3.0)}
+    # default: everything replicates
+    specs = template_partition_specs(template)
+    assert all(
+        s == P() for s in jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+    )
+    # a rule shards the matched leaf only
+    specs = template_partition_specs(
+        template, rules=((r"meta/w", P("batch")),)
+    )
+    assert specs["meta"]["w"] == P("batch")
+    assert specs["diag"] == P()
+    assert specs["scalar"] == P()
+
+
+# ---------------------------------------------------------------------
+# affinity router + policy
+
+
+def test_affinity_router_warm_routing_and_fallback():
+    r = AffinityRouter(3)
+    i0, warm0 = r.route("fpA")
+    assert not warm0
+    # warm hit goes back to the same device even if it is now loaded
+    i1, warm1 = r.route("fpA")
+    assert warm1 and i1 == i0
+    # cold fingerprint falls back to the least-loaded device
+    i2, warm2 = r.route("fpB")
+    assert not warm2 and i2 != i0
+    r.settle(i0, 0.5)
+    r.settle(i1, 0.5)
+    r.settle(i2, 0.1)
+    # all idle: least busy-seconds device wins the next cold route
+    i3, _ = r.route("fpC")
+    assert i3 not in (i0,)  # device i0 carries 1.0 busy seconds
+    # eviction stops warm routing
+    r.forget("fpA")
+    assert r.peek("fpA") is None
+    snap = r.snapshot()
+    assert snap["hits"] == 1 and snap["misses"] == 3
+
+
+def test_affinity_router_release_on_failure():
+    r = AffinityRouter(2)
+    i, _ = r.route("fp")
+    assert r.snapshot()["outstanding"][i] == 1
+    r.release(i)
+    assert r.snapshot()["outstanding"][i] == 0
+
+
+@multichip
+def test_affinity_service_routes_warm_and_spreads_cold():
+    """Two fingerprints land on two devices; repeated groups of each
+    fingerprint route warm (hit) back to their device, and results
+    match the single-device service bitwise."""
+    rng = np.random.default_rng(5)
+    fams = []
+    for shape in ((10, 10), (12, 12)):
+        sp = poisson_scipy(shape).tocsr()
+        sp.sort_indices()
+        fams.append((sp, rng.standard_normal(sp.shape[0])))
+    pol = AffinityPlacement()
+    svc = BatchedSolveService(max_batch=4, placement=pol)
+    svc_ref = BatchedSolveService(max_batch=4)
+    for _wave in range(3):
+        r = svc.solve_many(fams)
+        r_ref = svc_ref.solve_many(fams)
+        _results_equal(r, r_ref, bitwise=True)
+    snap = pol.telemetry_snapshot()
+    # wave 1: two cold routes; waves 2-3: all warm
+    assert snap["affinity_misses"] == 2
+    assert snap["affinity_hits"] == 4
+    assert len(snap["groups_per_device"]) == 2
+    assert pol.device_for(
+        svc._patterns[
+            next(iter(svc._patterns))
+        ].fingerprint
+    ) is not None
+
+
+@multichip
+def test_session_step_routes_to_hierarchy_device():
+    """A streaming session's steps — one fingerprint — all route to
+    the device that holds its hierarchy (the PR 9 remainder)."""
+    from amgx_tpu.serve import SolveGateway
+
+    pol = AffinityPlacement()
+    svc = BatchedSolveService(
+        config=DEFAULT_CONFIG, max_batch=4, placement=pol
+    )
+    gw = SolveGateway(svc)
+    sp = poisson_scipy((10, 10)).tocsr()
+    sp.sort_indices()
+    n = sp.shape[0]
+    rng = np.random.default_rng(1)
+    sess = gw.open_session(sp, session_id="route-me")
+    assert sess.placement_device is None  # nothing routed yet
+    devices = set()
+    for _k in range(3):
+        st = sess.step(sp.data, rng.standard_normal(n))
+        gw.flush()
+        assert int(st.result().status) == 0
+        devices.add(sess.placement_device)
+    assert len(devices) == 1 and None not in devices
+    snap = pol.telemetry_snapshot()
+    assert snap["affinity_misses"] == 1  # only the first step was cold
+    assert snap["affinity_hits"] >= 2
+
+
+# ---------------------------------------------------------------------
+# quarantine / eviction interplay
+
+
+@multichip
+def test_mesh_group_failure_quarantines_and_recovers(monkeypatch):
+    """A sharded group that fails at dispatch falls back to the same
+    per-request quarantine path as the single-device service."""
+    from amgx_tpu.core import faults
+
+    systems = jittered_poisson_family((10, 10), 8, seed=2)
+    svc = BatchedSolveService(
+        max_batch=8, placement=MeshPlacement(), breaker_threshold=0
+    )
+    svc.solve_many(systems)  # healthy warm-up builds the entry
+    faults.arm("serve_compile", times=1)
+    try:
+        res = svc.solve_many(systems)
+    finally:
+        faults.disarm()
+    assert all(int(r.status) == 0 for r in res)
+    assert svc.metrics.get("quarantines") == 1
+    assert svc.metrics.get("quarantined_solves") == 8
+
+
+@multichip
+def test_affinity_eviction_forgets_routing():
+    pol = AffinityPlacement()
+    svc = BatchedSolveService(
+        max_batch=4, cache_entries=1, placement=pol
+    )
+    rng = np.random.default_rng(9)
+    sp1 = poisson_scipy((10, 10)).tocsr()
+    sp1.sort_indices()
+    svc.solve_many([(sp1, rng.standard_normal(sp1.shape[0]))])
+    fp1 = next(iter(svc._patterns.values())).fingerprint
+    assert pol.device_for(fp1) is not None
+    sp2 = poisson_scipy((12, 12)).tocsr()
+    sp2.sort_indices()
+    svc.solve_many([(sp2, rng.standard_normal(sp2.shape[0]))])
+    # cache_entries=1: sp1's entry was evicted, its routing forgotten
+    assert pol.device_for(fp1) is None
