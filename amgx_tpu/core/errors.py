@@ -103,6 +103,27 @@ class ResourceError(AMGXTPUError):
     rc = RC_NO_MEMORY
 
 
+class DeviceLostError(ResourceError):
+    """A device under the serving stack failed or hung: a dispatch or
+    fetch raised a device-runtime error, or the in-flight watchdog
+    expired on a fetch that never completed.  Maps to the reference
+    RC_CUDA_FAILURE at the C API boundary (the "a GPU died" code).
+
+    Carries the placement ``device_label`` of the failed device when
+    the failure could be attributed, so failover (the
+    :mod:`amgx_tpu.serve.placement` health breakers) can quarantine
+    exactly the lost failure domain.  Recoverable by design: the serve
+    layer requeues the group once through the degrade chain before
+    this error ever reaches a ticket."""
+
+    rc = RC_CUDA_FAILURE
+
+    def __init__(self, msg: str = "", rc: int | None = None,
+                 device_label: str | None = None):
+        super().__init__(msg, rc)
+        self.device_label = device_label
+
+
 class DeadlineExceededError(ResourceError):
     """A request's ``deadline_s`` passed before it could be served —
     at submit (already expired on arrival), at flush (expired while

@@ -34,6 +34,20 @@ testable without hunting for a naturally-broken matrix:
                         failures degrade to a counted
                         ``telemetry_errors`` and never fail a solve
                         (telemetry/recorder.py, telemetry/registry.py)
+  device_lost_dispatch  the device stage loses its chip at launch: the
+                        dispatch of the next batched group raises a
+                        typed DeviceLostError, exercising the one-shot
+                        requeue through the placement degrade chain
+                        (serve/service._dispatch_batched)
+  device_lost_fetch     the chip dies after dispatch: the group's one
+                        host sync raises DeviceLostError, exercising
+                        the fetch-side failover re-dispatch from the
+                        retained host payload (_BatchResult.fetch)
+  fetch_hang            the group's host sync never returns (simulated
+                        by a bounded sleep, ``AMGX_TPU_FAULT_HANG_S``)
+                        so the in-flight watchdog must fire, settle
+                        the group typed, and requeue it
+                        (serve/service._watched_block)
   ====================  ===================================================
 
 Injection is **budgeted and consumed at trace/setup time**: arming a
@@ -69,6 +83,9 @@ SITES = (
     "admission_quota",
     "drain_timeout",
     "telemetry_export",
+    "device_lost_dispatch",
+    "device_lost_fetch",
+    "fetch_hang",
 )
 
 _lock = threading.Lock()
@@ -160,6 +177,18 @@ def inject(site: str, times: int = 1):
         yield
     finally:
         disarm(site)
+
+
+def hang_seconds() -> float:
+    """How long an armed ``fetch_hang`` sleeps (the simulated device
+    hang).  Must exceed the consumer's fetch watchdog for the site to
+    exercise the timeout path; bounded so an abandoned hang thread
+    always drains.  ``AMGX_TPU_FAULT_HANG_S`` overrides (tests use
+    sub-second hangs against sub-second watchdogs)."""
+    try:
+        return float(os.environ.get("AMGX_TPU_FAULT_HANG_S", "") or 30.0)
+    except ValueError:
+        return 30.0
 
 
 def corrupt_nan(site: str, x):

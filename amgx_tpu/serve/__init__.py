@@ -52,11 +52,14 @@ from amgx_tpu.serve.gateway import GatewayTicket, SolveGateway
 from amgx_tpu.serve.placement import (
     AffinityPlacement,
     AffinityRouter,
+    DeviceHealthBoard,
     MeshPlacement,
     PlacementPolicy,
     SingleDevicePolicy,
+    breaker_probe_every,
     placement_from_env,
 )
+from amgx_tpu.serve.retry import DEFAULT_RETRYABLE, RetryPolicy
 
 # serving-stack alias: the docs/issues call the frontend "the solve
 # service"; the class name keeps its descriptive form
@@ -78,6 +81,10 @@ __all__ = [
     "MeshPlacement",
     "AffinityPlacement",
     "AffinityRouter",
+    "DeviceHealthBoard",
+    "breaker_probe_every",
+    "RetryPolicy",
+    "DEFAULT_RETRYABLE",
     "placement_from_env",
     "HierarchyCache",
     "ServeMetrics",

@@ -15,8 +15,18 @@ WHERE a flushed group executes (ROADMAP item 1):
 
 Select with the service's ``placement=`` argument or
 ``AMGX_TPU_PLACEMENT=single|mesh[:N]|affinity`` (see doc/MESH.md).
+
+Failure domains (doc/ROBUSTNESS.md "Failure domains"): every policy
+carries a :class:`DeviceHealthBoard` of per-device breakers — a lost
+dispatch/fetch trips the device, routing forgets it, the mesh shrinks
+to the healthy prefix, and every Nth attempt is the half-open probe
+whose success re-admits the chip.
 """
 
+from amgx_tpu.serve.placement.health import (
+    DeviceHealthBoard,
+    breaker_probe_every,
+)
 from amgx_tpu.serve.placement.policy import (
     ENV_VAR,
     GroupPlan,
@@ -37,6 +47,8 @@ from amgx_tpu.serve.placement.router import (
 
 __all__ = [
     "ENV_VAR",
+    "DeviceHealthBoard",
+    "breaker_probe_every",
     "GroupPlan",
     "PlacementPolicy",
     "SingleDevicePolicy",

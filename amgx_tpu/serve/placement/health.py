@@ -1,0 +1,201 @@
+"""Per-device failure breakers: the failure-domain layer under the
+placement policies.
+
+PR 2 gave *sparsity patterns* circuit breakers (a fingerprint that
+keeps poisoning its batch groups bypasses batching); this module gives
+*devices* the same semantics.  A chip that loses a dispatch or a fetch
+(typed :class:`~amgx_tpu.core.errors.DeviceLostError`, or the in-flight
+watchdog expiring on a hung fetch) trips its breaker:
+
+  healthy ──failure×threshold──> tripped ──every Nth plan──> half-open
+     ▲                               │                          probe
+     └──────────── probe group succeeds ────────────────────────┘
+
+While tripped, a device receives NO new groups — the affinity router
+routes around it (its warm-fingerprint set is forgotten, so sessions
+re-pin elsewhere), and a mesh shrinks its shard layout to the healthy
+device prefix.  Every Nth placement attempt that WOULD have used the
+tripped device is admitted as the half-open probe; its group's
+successful fetch closes the breaker (``resilience_device_closes``) and
+the device rejoins routing.  The probe cadence is the SAME knob the
+fingerprint breaker uses (:func:`breaker_probe_every` —
+``AMGX_TPU_BREAKER_PROBE_EVERY``, default 8), so one configuration
+governs both breaker families.
+
+Pure host state, no jax imports: unit-testable without devices and
+reusable by a multi-process fleet tier (worker health instead of chip
+health).  Counters land in the owning service's shared
+:class:`~amgx_tpu.serve.metrics.ServeMetrics` under the
+``resilience_*`` prefix, exported as ``amgx_resilience_*`` families.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_PROBE_DEFAULT = 8
+ENV_PROBE = "AMGX_TPU_BREAKER_PROBE_EVERY"
+
+
+def breaker_probe_every(value: Optional[int] = None) -> int:
+    """The half-open probe cadence shared by the per-fingerprint and
+    per-device breakers: every Nth attempt against an open breaker is
+    admitted as the probe.  ``value`` (a config param) wins; else the
+    ``AMGX_TPU_BREAKER_PROBE_EVERY`` env knob; else 8.  Clamped to
+    >= 1 (a cadence of 1 probes every attempt — breakers effectively
+    log-only; 0/negative/malformed fall back to the default so a config
+    typo can never disable probing and strand a breaker open)."""
+    if value is None:
+        raw = os.environ.get(ENV_PROBE, "")
+        try:
+            value = int(raw) if raw else _PROBE_DEFAULT
+        except ValueError:
+            value = _PROBE_DEFAULT
+    value = int(value)
+    return value if value >= 1 else _PROBE_DEFAULT
+
+
+class DeviceHealthBoard:
+    """Failure breakers for ``n`` placement devices.
+
+    ``failure(i)`` counts a device-attributed failure and trips the
+    breaker at ``trip_threshold`` (default 1: device loss is severe —
+    one lost dispatch/fetch quarantines the chip).  ``ok(i)`` closes
+    the breaker (a successful fetch on the device — in particular the
+    half-open probe's).  ``probe_due(i)`` implements the cadence: for
+    a tripped device, every ``probe_every``-th call returns True and
+    the caller routes ONE group there as the probe.
+
+    Thread-safe; ``metrics`` (a ServeMetrics, attached lazily by the
+    owning policy's first ``plan``) receives the ``resilience_*``
+    counters — trips, probes, closes — and the
+    ``resilience_devices_unhealthy`` gauge."""
+
+    def __init__(self, n_devices: int, trip_threshold: int = 1,
+                 probe_every: Optional[int] = None, metrics=None):
+        if n_devices < 1:
+            raise ValueError("DeviceHealthBoard needs >= 1 device")
+        self.n = int(n_devices)
+        self.trip_threshold = max(int(trip_threshold), 1)
+        self.probe_every = breaker_probe_every(probe_every)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._fails = [0] * self.n
+        self._tripped = [False] * self.n
+        self._probe_counts = [0] * self.n
+        self.trips = 0
+        self.probes = 0
+        self.closes = 0
+
+    # -- metrics (degrade, never raise) --------------------------------
+
+    def _inc(self, name: str):
+        m = self.metrics
+        if m is not None:
+            try:
+                m.inc(name)
+            except Exception:  # noqa: BLE001 — health accounting must
+                pass  # never fail a placement decision
+
+    def _gauge_unhealthy(self):
+        m = self.metrics
+        if m is not None:
+            try:
+                m.set_gauge(
+                    "resilience_devices_unhealthy",
+                    sum(self._tripped),
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- state transitions ---------------------------------------------
+
+    def failure(self, index: int) -> bool:
+        """One device-attributed failure; True when this call TRIPPED
+        the breaker (open→open recounts toward nothing)."""
+        if not 0 <= index < self.n:
+            return False
+        with self._lock:
+            if self._tripped[index]:
+                return False
+            self._fails[index] += 1
+            if self._fails[index] < self.trip_threshold:
+                return False
+            self._tripped[index] = True
+            self._probe_counts[index] = 0
+            self.trips += 1
+            self._inc("resilience_device_trips")
+            self._gauge_unhealthy()
+            return True
+
+    def ok(self, index: int) -> None:
+        """A group's fetch succeeded on the device: reset its failure
+        count and — when tripped (the half-open probe) — close the
+        breaker."""
+        if not 0 <= index < self.n:
+            return
+        with self._lock:
+            self._fails[index] = 0
+            if self._tripped[index]:
+                self._tripped[index] = False
+                self.closes += 1
+                self._inc("resilience_device_closes")
+                self._gauge_unhealthy()
+
+    def probe_due(self, index: int) -> bool:
+        """For a TRIPPED device: consume one probe-cadence tick; True
+        on the cadence multiple (the caller routes one group there as
+        the half-open probe).  Healthy devices always return False —
+        they need no probe."""
+        if not 0 <= index < self.n:
+            return False
+        with self._lock:
+            if not self._tripped[index]:
+                return False
+            self._probe_counts[index] += 1
+            if self._probe_counts[index] % self.probe_every:
+                return False
+            self.probes += 1
+            self._inc("resilience_device_probes")
+            return True
+
+    # -- views ---------------------------------------------------------
+
+    def healthy(self, index: int) -> bool:
+        with self._lock:
+            return 0 <= index < self.n and not self._tripped[index]
+
+    def healthy_indices(self) -> list:
+        with self._lock:
+            return [i for i in range(self.n) if not self._tripped[i]]
+
+    def tripped_indices(self) -> list:
+        with self._lock:
+            return [i for i in range(self.n) if self._tripped[i]]
+
+    def healthy_prefix(self) -> int:
+        """Length of the longest all-healthy prefix of the device
+        list — the mesh degrade chain: a tripped shard device shrinks
+        the layout to the devices before it (a mesh is a device
+        PREFIX, so one bad chip caps, not punctures, the mesh)."""
+        with self._lock:
+            for i in range(self.n):
+                if self._tripped[i]:
+                    return i
+            return self.n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "devices": self.n,
+                "unhealthy": sum(self._tripped),
+                "tripped": [
+                    i for i in range(self.n) if self._tripped[i]
+                ],
+                "trips": self.trips,
+                "probes": self.probes,
+                "closes": self.closes,
+                "probe_every": self.probe_every,
+            }

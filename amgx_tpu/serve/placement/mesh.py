@@ -135,8 +135,11 @@ class MeshPlacement(PlacementPolicy):
 
     def __init__(self, devices=None, axis_name: str = DEFAULT_AXIS,
                  max_shards: Optional[int] = None, partition_rules=(),
-                 convergence: str = "local"):
+                 convergence: str = "local", trip_threshold: int = 1,
+                 probe_every=None):
         import jax
+
+        from amgx_tpu.serve.placement.health import DeviceHealthBoard
 
         if convergence not in ("local", "shared"):
             raise ValueError(
@@ -145,6 +148,16 @@ class MeshPlacement(PlacementPolicy):
             )
         self.devices = (
             list(devices) if devices is not None else list(jax.devices())
+        )
+        # failure domains: a device-loss failure of a sharded group
+        # cannot be attributed to one shard, so the degrade chain
+        # trips the LAST device of the failed layout and shrinks the
+        # mesh to the healthy device PREFIX (4 -> 2 -> 1 -> the
+        # single-device fallback plan); every Nth group while degraded
+        # re-attempts the larger layout as the half-open probe
+        self.health = DeviceHealthBoard(
+            len(self.devices), trip_threshold=trip_threshold,
+            probe_every=probe_every,
         )
         self.axis_name = axis_name
         self.max_shards = max_shards
@@ -170,16 +183,53 @@ class MeshPlacement(PlacementPolicy):
 
     # -- mesh / sharding helpers ---------------------------------------
 
-    def n_shards(self, Bb: int) -> int:
+    @staticmethod
+    def _pow2_shards(Bb: int, cap: int) -> int:
         """Largest power-of-two shard count that divides the batch
-        bucket and fits the device budget."""
-        cap = len(self.devices)
-        if self.max_shards:
-            cap = min(cap, self.max_shards)
+        bucket and does not exceed ``cap``."""
         n = 1
         while n * 2 <= cap and Bb % (n * 2) == 0:
             n *= 2
         return n
+
+    def n_shards(self, Bb: int, probe: bool = True) -> int:
+        """Largest power-of-two shard count that divides the batch
+        bucket and fits the device budget — capped by the HEALTHY
+        device prefix (a tripped shard device shrinks the layout).
+        With ``probe`` (the plan path; ``warm`` passes False so
+        background compiles never burn cadence ticks), every
+        ``probe_every``-th degraded plan re-attempts the full layout
+        as the half-open probe — and the tick is only consumed when
+        that larger layout actually REACHES the tripped device (a
+        bucket whose divisibility can't extend past the healthy
+        prefix must not count phantom probes and strand the breaker
+        open)."""
+        full_cap = len(self.devices)
+        if self.max_shards:
+            full_cap = min(full_cap, self.max_shards)
+        hp = self.health.healthy_prefix()
+        ns = self._pow2_shards(Bb, min(full_cap, hp))
+        if probe and hp < full_cap:
+            ns_ext = self._pow2_shards(Bb, full_cap)
+            # ns is the largest power of two <= hp, so ns_ext > ns
+            # implies ns_ext >= 2*ns > hp: the extended layout spans
+            # the first tripped device — a real probe
+            if ns_ext > ns and self.health.probe_due(hp):
+                return ns_ext
+        return ns
+
+    def _mesh_failed(self, ns: int) -> None:
+        """Device-loss attribution for a sharded group: the runtime
+        does not say WHICH shard died.  When the failed layout spans
+        an already-tripped device (a half-open probe layout — it may
+        overshoot the first tripped index to the next power of two),
+        that device is the prime suspect and re-charging it is a
+        no-op — an INNOCENT tail chip must not be tripped by a probe
+        failure.  Otherwise (an all-healthy layout failed) trip the
+        tail device: deterministic, and the shrink-to-prefix degrade
+        converges to single-device either way."""
+        hp = self.health.healthy_prefix()
+        self.health.failure(hp if hp < ns else ns - 1)
 
     def _mesh_for(self, ns: int):
         from jax.sharding import Mesh
@@ -338,6 +388,8 @@ class MeshPlacement(PlacementPolicy):
     def plan(self, service, entry, Bb: int) -> GroupPlan:
         import jax
 
+        if self.health.metrics is None:
+            self.health.metrics = service.metrics
         ns = self.n_shards(Bb)
         if ns <= 1:
             # nothing to shard (tiny bucket or one device): take the
@@ -355,6 +407,10 @@ class MeshPlacement(PlacementPolicy):
             return fn_c(template, vals_d, bs_d, x0_d)
 
         def on_fetch(host, device_s):
+            # the completed fetch is the health signal for EVERY chip
+            # of the layout (closes a probed breaker, resets counts)
+            for i in range(ns):
+                self.health.ok(i)
             # shared mode: the group loop evaluated its cond (= one
             # shared-mask psum) once per trip plus the final exit
             # check; trips = the max committed iteration across the
@@ -385,13 +441,16 @@ class MeshPlacement(PlacementPolicy):
             donate=donate,
             device_label=f"mesh{ns}",
             on_fetch=on_fetch,
+            on_device_failure=lambda exc: self._mesh_failed(ns),
         )
 
     def warm(self, service, entry, Bb: int) -> None:
         """Background-compile the sharded executable for this bucket
         (shared compile worker, like CompileCache.warm); 1-shard
-        buckets warm the single-device cache instead."""
-        ns = self.n_shards(Bb)
+        buckets warm the single-device cache instead.  ``probe=False``:
+        a warm-up must never consume a half-open probe tick — only a
+        plan that dispatches a real group may probe."""
+        ns = self.n_shards(Bb, probe=False)
         if ns <= 1 or entry.batch_fn is None:
             self._single.warm(service, entry, Bb)
             return
@@ -437,10 +496,15 @@ class MeshPlacement(PlacementPolicy):
     def telemetry_snapshot(self) -> dict:
         """Registry source (kind="mesh"): the ``amgx_mesh_*``
         families — groups per device, psum totals, busy seconds."""
+        hs = self.health.snapshot()
         with self._lock:
             return {
                 "policy": self.name,
                 "devices": len(self.devices),
+                "device_trips": hs["trips"],
+                "device_probes": hs["probes"],
+                "device_closes": hs["closes"],
+                "devices_unhealthy": hs["unhealthy"],
                 "convergence": self.convergence,
                 "groups_total": self._groups_total,
                 "sharded_groups_total": self._sharded_groups,

@@ -60,20 +60,25 @@ class GroupPlan:
     buffer is donated to the executable.  ``on_fetch(host, device_s)``
     runs after the group's single host sync (placement telemetry:
     per-device busy time, psum accounting); ``abandon()`` releases any
-    routing reservation when the group fails before its fetch.  Both
-    hooks are called by the service under a degrade-never-raise
-    guard."""
+    routing reservation when the group fails before its fetch;
+    ``device_failure(exc)`` attributes a device-loss failure (typed
+    ``DeviceLostError`` or a fetch-watchdog expiry) to this plan's
+    device so the policy's health breaker trips it and routing forgets
+    it.  All hooks are called by the service under a
+    degrade-never-raise guard."""
 
     __slots__ = (
         "fn", "put", "zeros", "zeros_key", "donate", "device_label",
-        "_on_fetch", "_on_abandon", "_settled",
+        "_on_fetch", "_on_abandon", "_on_device_failure", "_settled",
+        "_failed",
     )
 
     def __init__(self, fn: Callable, put: Callable, zeros: Callable,
                  zeros_key: tuple = (), donate: bool = False,
                  device_label: Optional[str] = None,
                  on_fetch: Optional[Callable] = None,
-                 on_abandon: Optional[Callable] = None):
+                 on_abandon: Optional[Callable] = None,
+                 on_device_failure: Optional[Callable] = None):
         self.fn = fn
         self.put = put
         self.zeros = zeros
@@ -82,7 +87,9 @@ class GroupPlan:
         self.device_label = device_label
         self._on_fetch = on_fetch
         self._on_abandon = on_abandon
+        self._on_device_failure = on_device_failure
         self._settled = False
+        self._failed = False
 
     def on_fetch(self, host, device_s: float) -> None:
         """The group's one host sync completed (idempotence guarded:
@@ -102,6 +109,20 @@ class GroupPlan:
         if self._on_abandon is not None:
             self._on_abandon()
 
+    def device_failure(self, exc: BaseException) -> None:
+        """A device-loss failure (typed ``DeviceLostError``, or the
+        fetch watchdog expiring) is attributed to this plan's device:
+        trip the policy's health breaker for it.  Idempotent per plan
+        (a failed dispatch followed by a failed requeue fires on each
+        plan exactly once) and independent of :meth:`abandon` — the
+        reservation release and the health trip are separate
+        concerns."""
+        if self._failed:
+            return
+        self._failed = True
+        if self._on_device_failure is not None:
+            self._on_device_failure(exc)
+
 
 class PlacementPolicy:
     """Base: the host-queueing / device-placement split.  Stateless
@@ -111,6 +132,11 @@ class PlacementPolicy:
 
     name = "single"
     telemetry_kind: Optional[str] = None
+    # per-device failure breakers (placement.health.DeviceHealthBoard)
+    # for policies that place across devices; None for the
+    # single-device default (its only degrade target is itself — the
+    # service's one-shot requeue retries the same device instead)
+    health = None
 
     def plan(self, service, entry, Bb: int) -> GroupPlan:
         raise NotImplementedError

@@ -64,23 +64,46 @@ class AffinityRouter:
                     return i
         return None
 
-    def route(self, fingerprint) -> tuple:
+    def route(self, fingerprint, allowed=None) -> tuple:
         """(device index, was_warm) for one group; reserves one unit
-        of the device's load until :meth:`settle`/:meth:`release`."""
+        of the device's load until :meth:`settle`/:meth:`release`.
+
+        ``allowed`` (an iterable of device indices, or None for all)
+        restricts the decision to healthy devices: a warm device
+        OUTSIDE the set is ignored (its caches may be gone with the
+        chip) and the least-loaded fallback picks inside the set —
+        the affinity stage of the failover degrade chain."""
         with self._lock:
+            ok = (
+                set(range(self.n)) if allowed is None else set(allowed)
+            ) or set(range(self.n))
             for i in range(self.n):
-                if fingerprint in self._warm[i]:
+                if i in ok and fingerprint in self._warm[i]:
                     self.hits += 1
                     self._outstanding[i] += 1
                     return i, True
             i = min(
-                range(self.n),
+                sorted(ok),
                 key=lambda j: (self._outstanding[j], self._busy_s[j]),
             )
             self.misses += 1
             self._warm[i].add(fingerprint)
             self._outstanding[i] += 1
             return i, False
+
+    def route_to(self, fingerprint, index: int) -> tuple:
+        """Force-route one group to ``index`` (the device breaker's
+        half-open probe): reserves a load unit and marks the
+        fingerprint warm there, same contract as :meth:`route`."""
+        with self._lock:
+            warm = fingerprint in self._warm[index]
+            if warm:
+                self.hits += 1
+            else:
+                self.misses += 1
+                self._warm[index].add(fingerprint)
+            self._outstanding[index] += 1
+            return index, warm
 
     def settle(self, index: int, device_s: float) -> None:
         """A routed group's fetch completed: release its load unit and
@@ -107,6 +130,17 @@ class AffinityRouter:
             for w in self._warm:
                 w.discard(fingerprint)
 
+    def forget_device(self, index: int) -> int:
+        """The device was LOST (health breaker tripped): every
+        fingerprint warm there must re-route — its resident templates
+        and executables are presumed gone.  Returns how many
+        fingerprints were forgotten (sessions pinned there re-pin on
+        their next step)."""
+        with self._lock:
+            n = len(self._warm[index])
+            self._warm[index].clear()
+            return n
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -130,13 +164,24 @@ class AffinityPlacement(PlacementPolicy):
     name = "affinity"
     telemetry_kind = "mesh"
 
-    def __init__(self, devices=None):
+    def __init__(self, devices=None, trip_threshold: int = 1,
+                 probe_every=None):
         import jax
+
+        from amgx_tpu.serve.placement.health import DeviceHealthBoard
 
         self.devices = (
             list(devices) if devices is not None else list(jax.devices())
         )
         self.router = AffinityRouter(len(self.devices))
+        # per-device failure breakers: a lost dispatch/fetch trips the
+        # chip out of routing until a half-open probe group succeeds
+        # (cadence shared with the fingerprint breaker —
+        # AMGX_TPU_BREAKER_PROBE_EVERY); metrics attach at first plan()
+        self.health = DeviceHealthBoard(
+            len(self.devices), trip_threshold=trip_threshold,
+            probe_every=probe_every,
+        )
         self._lock = threading.Lock()
         self._jits: dict = {}  # (signature, donate) -> jitted batch fn
 
@@ -179,10 +224,42 @@ class AffinityPlacement(PlacementPolicy):
 
     # -- PlacementPolicy -----------------------------------------------
 
+    def _route_healthy(self, fingerprint) -> tuple:
+        """The failover degrade chain, in routing form: affinity among
+        HEALTHY devices → least-loaded healthy → (all tripped) the
+        least-loaded device anyway, counted as a host-fallback — the
+        service must keep serving even with every breaker open, and
+        the group doubles as a probe.  Every
+        ``breaker_probe_every``-th group that would have avoided a
+        tripped device routes TO it instead (the half-open probe whose
+        successful fetch closes the breaker)."""
+        tripped = self.health.tripped_indices()
+        if not tripped:
+            return self.router.route(fingerprint)
+        for i in tripped:
+            if self.health.probe_due(i):
+                return self.router.route_to(fingerprint, i)
+        healthy = self.health.healthy_indices()
+        if not healthy:
+            m = getattr(self.health, "metrics", None)
+            if m is not None:
+                m.inc("resilience_host_fallbacks")
+            return self.router.route(fingerprint)
+        return self.router.route(fingerprint, allowed=healthy)
+
+    def _device_failed(self, index: int) -> None:
+        """Device-loss attribution (GroupPlan.device_failure): trip
+        the breaker and forget every fingerprint warm on the chip so
+        routing (and pinned sessions) fail over immediately."""
+        self.health.failure(index)
+        self.router.forget_device(index)
+
     def plan(self, service, entry, Bb: int) -> GroupPlan:
         import jax
 
-        index, _warm = self.router.route(entry.pattern.fingerprint)
+        if self.health.metrics is None:
+            self.health.metrics = service.metrics
+        index, _warm = self._route_healthy(entry.pattern.fingerprint)
         dev = self.devices[index]
         try:
             donate = service.compile_cache._donate()
@@ -210,10 +287,14 @@ class AffinityPlacement(PlacementPolicy):
             zeros_key=("dev", index),
             donate=donate,
             device_label=str(index),
-            on_fetch=lambda host, device_s: self.router.settle(
-                index, device_s
+            on_fetch=lambda host, device_s: (
+                self.router.settle(index, device_s),
+                # a completed fetch is the health signal that closes a
+                # half-open breaker (and resets failure counts)
+                self.health.ok(index),
             ),
             on_abandon=lambda: self.router.release(index),
+            on_device_failure=lambda exc: self._device_failed(index),
         )
 
     def warm(self, service, entry, Bb: int) -> None:
@@ -246,9 +327,14 @@ class AffinityPlacement(PlacementPolicy):
         view — groups and busy seconds per device, affinity hit/miss
         counts (``amgx_mesh_*`` families)."""
         rs = self.router.snapshot()
+        hs = self.health.snapshot()
         return {
             "policy": self.name,
             "devices": len(self.devices),
+            "device_trips": hs["trips"],
+            "device_probes": hs["probes"],
+            "device_closes": hs["closes"],
+            "devices_unhealthy": hs["unhealthy"],
             "affinity_hits": rs["hits"],
             "affinity_misses": rs["misses"],
             "psums_total": 0,

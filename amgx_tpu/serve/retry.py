@@ -1,0 +1,117 @@
+"""Client-side retry policy: jittered exponential backoff that honors
+typed shed hints.
+
+Every rejection the fleet front-end raises is TYPED
+(:class:`~amgx_tpu.core.errors.AdmissionRejected` /
+:class:`~amgx_tpu.core.errors.Overloaded`) and carries
+``retry_after_s`` — the machine-actionable backoff hint sized to the
+actual recovery event (a token-bucket refill, the breaker probe
+cadence, a drain handoff).  A well-behaved client should sleep THAT
+long, not a guessed constant; this module is the reference
+implementation the chaos soak harness (ci/chaos_soak.py) and external
+clients use:
+
+    policy = RetryPolicy(max_attempts=5, base_s=0.05)
+    res = policy.call(lambda: gw.submit(A, b, tenant="web").result())
+
+Semantics:
+
+* retryable errors are the RECOVERABLE taxonomy classes — admission
+  sheds, deadline misses, device loss (the serve layer already
+  requeued once; a client retry lands after failover settled) — plus
+  any extra classes the caller lists;
+* the backoff for attempt k is ``base_s * factor**k`` with a
+  deterministic-seedable jitter fraction, CAPPED by ``max_s`` — but a
+  typed ``retry_after_s`` hint REPLACES the exponential term (the
+  server knows when capacity returns; the jitter still applies so a
+  thundering herd of identical clients decorrelates);
+* non-retryable errors (setup errors, validation rejects — retrying
+  identical bad input cannot help) propagate immediately.
+
+Deterministic under a seed: the jitter stream is a private
+``numpy.random.Generator``, so tests and the chaos harness replay
+byte-identical schedules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from amgx_tpu.core.errors import (
+    AdmissionRejected,
+    DeadlineExceededError,
+    DeviceLostError,
+)
+
+# recoverable-by-waiting taxonomy classes: retrying later can succeed
+DEFAULT_RETRYABLE = (
+    AdmissionRejected,  # includes Overloaded
+    DeadlineExceededError,
+    DeviceLostError,
+)
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Jittered exponential backoff honoring typed shed hints.
+
+    Parameters: ``max_attempts`` total tries (the first call counts);
+    ``base_s``/``factor`` the exponential schedule; ``jitter_frac``
+    the uniform jitter applied multiplicatively in
+    ``[1 - j, 1 + j]``; ``max_s`` the per-sleep cap;
+    ``retryable`` the exception classes worth retrying; ``seed``
+    makes the jitter stream reproducible; ``sleep`` is injectable for
+    tests (defaults to ``time.sleep``)."""
+
+    max_attempts: int = 4
+    base_s: float = 0.05
+    factor: float = 2.0
+    jitter_frac: float = 0.25
+    max_s: float = 5.0
+    retryable: tuple = DEFAULT_RETRYABLE
+    seed: Optional[int] = None
+    sleep: Callable[[float], None] = time.sleep
+
+    def __post_init__(self):
+        self._rng = np.random.default_rng(self.seed)
+        self.retries = 0
+        self.giveups = 0
+
+    def backoff_s(self, attempt: int,
+                  retry_after_s: Optional[float] = None) -> float:
+        """The sleep before retry ``attempt`` (0-based): the server's
+        ``retry_after_s`` hint when present, else
+        ``base_s * factor**attempt`` — jittered, capped at
+        ``max_s``, never negative."""
+        base = (
+            float(retry_after_s)
+            if retry_after_s is not None
+            else self.base_s * self.factor ** attempt
+        )
+        if self.jitter_frac > 0:
+            base *= 1.0 + self.jitter_frac * float(
+                self._rng.uniform(-1.0, 1.0)
+            )
+        return float(min(max(base, 0.0), self.max_s))
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run ``fn`` with retries.  Returns its result; re-raises
+        the last error after ``max_attempts`` (counted in
+        ``giveups``) or immediately for non-retryable classes."""
+        attempts = max(int(self.max_attempts), 1)
+        for attempt in range(attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.retryable as e:
+                if attempt + 1 >= attempts:
+                    self.giveups += 1
+                    raise
+                self.retries += 1
+                self.sleep(self.backoff_s(
+                    attempt, getattr(e, "retry_after_s", None)
+                ))
+        raise AssertionError("unreachable")  # pragma: no cover

@@ -134,6 +134,15 @@ def _host_csr(A):
     return sp.indptr, sp.indices, sp.data, sp.shape[0], fp
 
 
+def _env_float(name: str, default: float) -> float:
+    import os
+
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 _DTYPE_MEMO: dict = {}
 
 
@@ -213,6 +222,69 @@ def _block_ready(x):
     import jax
 
     return jax.block_until_ready(x)
+
+
+class _DaemonFetchPool:
+    """Daemon-thread work pool for watchdogged fetches: the group's
+    blocking device sync runs here so the fetching caller can time out
+    (a hung chip must settle typed, not block result()/drain()
+    forever).  NOT a ThreadPoolExecutor — its workers are non-daemon
+    on Python >= 3.9 and joined at interpreter shutdown, so one truly
+    hung ``block_until_ready`` would wedge process EXIT, exactly the
+    hang the watchdog exists to eliminate.  These workers are daemon
+    threads: a stuck one is simply abandoned and the pool grows
+    around it up to the cap (tasks queued past a fully-stuck pool
+    still time out typed at the watchdog).  Workers are reused, so
+    the steady state pays a queue hop, not a thread spawn."""
+
+    def __init__(self, max_workers: int = 32):
+        import queue
+
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._workers = 0
+        self._idle = 0
+        self._max = int(max_workers)
+
+    def submit(self, fn) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._q.put((fn, fut))
+        with self._lock:
+            if self._idle == 0 and self._workers < self._max:
+                self._workers += 1
+                threading.Thread(
+                    target=self._loop,
+                    name=f"serve-fetch-{self._workers}",
+                    daemon=True,
+                ).start()
+        return fut
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._idle += 1
+            fn, fut = self._q.get()
+            with self._lock:
+                self._idle -= 1
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — delivered to
+                # the watchdogged waiter via the future
+                fut.set_exception(e)
+
+
+_FETCH_POOL: Optional[_DaemonFetchPool] = None
+_FETCH_POOL_LOCK = threading.Lock()
+
+
+def _fetch_pool() -> _DaemonFetchPool:
+    global _FETCH_POOL
+    with _FETCH_POOL_LOCK:
+        if _FETCH_POOL is None:
+            _FETCH_POOL = _DaemonFetchPool(max_workers=32)
+        return _FETCH_POOL
 
 
 def _fetch_host(tree):
@@ -344,10 +416,12 @@ class _BatchResult:
     __slots__ = (
         "_service", "res", "pattern", "tickets", "Bb",
         "t_flush", "t_dispatch", "_lock", "_host", "_error", "plan",
+        "entry", "retry", "requeued",
     )
 
     def __init__(self, service, res, pattern, tickets, Bb,
-                 t_flush, t_dispatch, plan=None):
+                 t_flush, t_dispatch, plan=None, entry=None,
+                 retry=None):
         self._service = service
         self.res = res
         self.pattern = pattern
@@ -356,6 +430,12 @@ class _BatchResult:
         self.t_flush = t_flush
         self.t_dispatch = t_dispatch
         self.plan = plan  # placement GroupPlan (fetch-time accounting)
+        # failover state: the hierarchy entry and the retained host
+        # payload (batched vals/b/x0 copies) a device-lost group
+        # re-dispatches from, one-shot (serve/service failover)
+        self.entry = entry
+        self.retry = retry
+        self.requeued = False
         self._lock = threading.Lock()
         self._host = None
         self._error = None
@@ -367,6 +447,30 @@ class _BatchResult:
         convert lateness into a typed deadline failure."""
         with self._lock:
             return self._host is not None
+
+    def _sync_once(self):
+        """One attempt at the group's blocking sync + host copy:
+        the ``device_lost_fetch`` fault site, then the watchdogged
+        ``block_until_ready``, then the device→host copy.  Returns
+        ``(host_tree, t_done)``; raises typed ``DeviceLostError`` on
+        injected loss or watchdog expiry (the caller's failover
+        hook)."""
+        from amgx_tpu.core import faults
+
+        label = (
+            self.plan.device_label if self.plan is not None else None
+        )
+        if faults.should_fire("device_lost_fetch"):
+            from amgx_tpu.core.errors import DeviceLostError
+
+            raise DeviceLostError(
+                "injected device loss at fetch (fault site "
+                "device_lost_fetch)",
+                device_label=label,
+            )
+        self._service._watched_block(self.res.x, label)
+        t_done = time.perf_counter()
+        return _fetch_host(self.res), t_done
 
     def __del__(self):
         # a group nobody ever fetched (every ticket deadline-expired
@@ -388,36 +492,97 @@ class _BatchResult:
                 raise self._error
             m = self._service.metrics
             try:
-                _block_ready(self.res.x)
-                t_done = time.perf_counter()
-                host = _fetch_host(self.res)
+                host, t_done = self._sync_once()
             except BaseException as e:  # noqa: BLE001 — async runtime
-                # failure (OOM, XLA runtime error) surfacing at the
-                # fetch, after the staging rows are gone: convert to a
-                # typed error for EVERY groupmate (the C API maps it to
-                # per-system FAILED statuses) and count it against the
-                # pattern's breaker
-                from amgx_tpu.core.errors import ResourceError
-
-                err = ResourceError(
-                    "batched group execution failed after dispatch: "
-                    f"{type(e).__name__}: {e}"
+                # failure (OOM, XLA runtime error, device loss)
+                # surfacing at the fetch, after the staging rows are
+                # gone: a DEVICE loss first attempts the one-shot
+                # failover requeue from the retained host payload —
+                # the groupmates then see a normal (late) success;
+                # anything else (or a failed requeue) converts to a
+                # typed error for EVERY groupmate (the C API maps it
+                # to per-system FAILED statuses)
+                from amgx_tpu.core.errors import (
+                    AMGXTPUError,
+                    DeviceLostError,
+                    ResourceError,
                 )
-                err.__cause__ = e
-                self._error = err
-                self.res = None  # drop the (possibly poisoned) buffers
-                m.inc("failed_groups")
-                if self.plan is not None:
+
+                host = None
+                label = (
+                    self.plan.device_label
+                    if self.plan is not None else None
+                )
+                # real hardware surfaces a lost chip as a jaxlib
+                # XlaRuntimeError, not our typed class — classify it
+                # here (fetch boundary only) so failover is not an
+                # injected-faults-only feature
+                dl = self._service._classify_device_loss(e, label)
+                if dl is not None:
+                    e = dl
                     try:
-                        self.plan.abandon()  # release the routing slot
-                    except Exception:  # noqa: BLE001 — placement
-                        # telemetry must not mask the group failure
-                        m.inc("telemetry_errors")
-                self._service._breaker_failure(self.pattern.fingerprint)
-                raise err
+                        host, t_done = (
+                            self._service._failover_refetch(self, e)
+                        )
+                    except BaseException as e2:  # noqa: BLE001
+                        if not isinstance(e2, Exception):
+                            # Ctrl-C / SystemExit mid-requeue must
+                            # propagate, never demote to a typed
+                            # settlement (the PR 9 contract)
+                            raise
+                        if isinstance(e2, AMGXTPUError):
+                            e = e2
+                        elif e.__cause__ is None:
+                            e.__cause__ = e2
+                        else:
+                            # keep the ROOT device failure as the
+                            # cause chain (the classified runtime
+                            # error is what started the incident);
+                            # the secondary requeue error rides along
+                            # for diagnostics without erasing it
+                            e.requeue_error = e2
+                if host is None:
+                    if isinstance(e, AMGXTPUError):
+                        err = e
+                    else:
+                        err = ResourceError(
+                            "batched group execution failed after "
+                            f"dispatch: {type(e).__name__}: {e}"
+                        )
+                        err.__cause__ = e
+                    self._error = err
+                    self.res = None  # drop the poisoned buffers
+                    self.retry = None  # terminal: no further requeue
+                    self.entry = None
+                    m.inc("failed_groups")
+                    if self.plan is not None:
+                        try:
+                            self.plan.abandon()  # release the slot
+                        except Exception:  # noqa: BLE001 — placement
+                            # telemetry must not mask the failure
+                            m.inc("telemetry_errors")
+                    if (
+                        not isinstance(err, DeviceLostError)
+                        or getattr(err, "inferred", False)
+                    ):
+                        # a CERTAIN chip loss (injected, watchdog) is
+                        # not the pattern's fault — only the device
+                        # breaker trips.  An INFERRED loss (classified
+                        # runtime error) charges both breakers: if the
+                        # pattern itself is the poison, its own
+                        # breaker must still open.
+                        self._service._breaker_failure(
+                            self.pattern.fingerprint
+                        )
+                    raise err
             t_fetch = time.perf_counter()
             self._host = host
             self.res = None  # host copy cached: free the device batch
+            # the group settled: the failover payload (full batched
+            # host copies) and the entry ref are dead weight — tickets
+            # keep this _BatchResult alive until they are collected
+            self.retry = None
+            self.entry = None
             device_s = max(t_done - self.t_dispatch, 0.0)
             fetch_s = t_fetch - t_done
             dispatch_s = self.t_dispatch - self.t_flush
@@ -551,6 +716,27 @@ class BatchedSolveService:
         bypassed for that pattern and its requests run in per-request
         isolation (``breaker_trips`` / ``breaker_bypasses`` counters;
         a successful batched group resets the count).
+    breaker_probe_every: half-open probe cadence shared by the
+        fingerprint breaker AND the placement device breakers — every
+        Nth attempt against an open breaker is admitted as the probe
+        whose success closes it.  None resolves
+        ``AMGX_TPU_BREAKER_PROBE_EVERY`` (default 8).
+    fetch_watchdog_s: wall-clock bound on a dispatched group's one
+        blocking fetch (failure domains, doc/ROBUSTNESS.md): past it
+        the fetch settles with a typed ``DeviceLostError`` (and the
+        group requeues through the placement degrade chain) instead
+        of blocking ``result()``/``drain()`` on a hung chip forever.
+        None resolves ``AMGX_TPU_FETCH_WATCHDOG_S`` (default 120);
+        <= 0 disables (the sync runs inline, the pre-watchdog path).
+    failover: retain a host copy of each dispatched group's batched
+        arrays so a device lost AFTER dispatch can requeue once
+        (affinity → least-loaded healthy chip → smaller mesh layout →
+        single-device retry); without it a post-dispatch device loss
+        settles every groupmate typed.  Costs one host memcpy of the
+        batched vals/b(+x0) per flush, freed at the group's fetch —
+        turn it off for huge groups where typed settlement on loss is
+        acceptable.  None resolves ``AMGX_TPU_FAILOVER`` (default
+        on).
     store: setup-artifact store for warm-boot serving (PR 4): a
         :class:`~amgx_tpu.store.store.ArtifactStore` or a directory
         path.  Every hierarchy entry this service builds is exported
@@ -590,9 +776,12 @@ class BatchedSolveService:
         cache_entries: int = 64,
         validate: bool = True,
         breaker_threshold: int = 3,
+        breaker_probe_every: Optional[int] = None,
         donate: Optional[bool] = None,
         store=None,
         placement=None,
+        fetch_watchdog_s: Optional[float] = None,
+        failover: Optional[bool] = None,
     ):
         if config is None:
             config = DEFAULT_CONFIG
@@ -647,6 +836,34 @@ class BatchedSolveService:
         self._stop = threading.Event()
         self.validate = bool(validate)
         self.breaker_threshold = int(breaker_threshold)
+        # half-open probe cadence shared by the per-fingerprint breaker
+        # and the placement device breakers: param wins, then the
+        # AMGX_TPU_BREAKER_PROBE_EVERY env knob, then the default 8
+        # (instance attribute shadows the class-constant fallback)
+        from amgx_tpu.serve.placement.health import (
+            breaker_probe_every as _probe_cadence,
+        )
+
+        self._BREAKER_PROBE_EVERY = _probe_cadence(breaker_probe_every)
+        # failure-domain resilience (doc/ROBUSTNESS.md "Failure
+        # domains"): fetch_watchdog_s bounds the wall-clock wait of a
+        # group's one host sync (a hung chip settles typed and
+        # requeues; <=0 disables and the sync runs inline, the
+        # pre-watchdog path); failover keeps a host copy of each
+        # dispatched group's batched arrays so a device lost AFTER
+        # dispatch can requeue through the placement degrade chain
+        self.fetch_watchdog_s = (
+            _env_float("AMGX_TPU_FETCH_WATCHDOG_S", 120.0)
+            if fetch_watchdog_s is None
+            else float(fetch_watchdog_s)
+        )
+        import os as _os
+
+        self.failover = (
+            _os.environ.get("AMGX_TPU_FAILOVER", "1") != "0"
+            if failover is None
+            else bool(failover)
+        )
         # circuit breaker: padded fingerprint -> consecutive group
         # failures; fingerprints in _broken bypass batching (with a
         # periodic half-open probe so transient failures don't cost a
@@ -668,6 +885,18 @@ class BatchedSolveService:
         from amgx_tpu.serve.placement import resolve_placement
 
         self.placement = resolve_placement(placement)
+        if (
+            breaker_probe_every is not None
+            and getattr(self.placement, "health", None) is not None
+        ):
+            # the documented "one cadence knob for both breaker
+            # families" contract: an EXPLICIT service param overrides
+            # the policy board's env/default resolution (a policy
+            # constructed with its own explicit probe_every and no
+            # service param keeps its setting)
+            self.placement.health.probe_every = (
+                self._BREAKER_PROBE_EVERY
+            )
         if self.placement.telemetry_kind is not None:
             self.placement.telemetry_name = get_registry().register(
                 self.placement.telemetry_kind, self.placement
@@ -1441,8 +1670,210 @@ class BatchedSolveService:
 
     # every Nth group for an open-breaker pattern retries batching
     # (half-open probe): success closes the breaker, failure keeps it
-    # open and recounts toward nothing (already open)
+    # open and recounts toward nothing (already open).  Class-constant
+    # FALLBACK only: __init__ sets the instance attribute from the
+    # breaker_probe_every param / AMGX_TPU_BREAKER_PROBE_EVERY env
+    # knob, shared with the placement device breakers.
     _BREAKER_PROBE_EVERY = 8
+
+    # ------------------------------------------------------------------
+    # failure domains: watchdog + device-loss failover
+
+    # the effective fetch watchdog never undercuts this multiple of
+    # the observed p99 device time (legitimately long groups must not
+    # be typed-failed by a fixed global bound)
+    _WATCHDOG_P99_FACTOR = 25.0
+
+    def _watched_block(self, x, device_label=None):
+        """The group's one blocking device sync, under the in-flight
+        watchdog: with ``fetch_watchdog_s > 0`` the sync runs on a
+        pooled worker and a wall-clock expiry raises a typed
+        :class:`DeviceLostError` (the hung worker is abandoned — the
+        caller's thread, and with it ``result()``/``drain()``, never
+        blocks past the watchdog).  Disabled (<= 0), the sync runs
+        inline — the exact pre-watchdog path.  The ``fetch_hang``
+        fault site simulates the hung chip with a bounded sleep
+        (:func:`amgx_tpu.core.faults.hang_seconds`)."""
+        from amgx_tpu.core import faults
+
+        hang = faults.should_fire("fetch_hang")
+        wd = self.fetch_watchdog_s
+        if not wd or wd <= 0:
+            if hang:
+                time.sleep(faults.hang_seconds())
+            return _block_ready(x)
+        # adaptive floor: a service whose groups legitimately run long
+        # (big hierarchies, saturated chip) must not have healthy
+        # fetches typed-failed by a fixed global bound — once device-
+        # time history exists, the effective watchdog is at least
+        # _WATCHDOG_P99_FACTOR x the observed p99.  (A COLD service
+        # has no history: size AMGX_TPU_FETCH_WATCHDOG_S above the
+        # largest legitimate first group.)
+        p99 = self.metrics.latency_percentile("device", 99.0)
+        if p99:
+            wd = max(wd, self._WATCHDOG_P99_FACTOR * p99)
+
+        def work():
+            if hang:
+                time.sleep(faults.hang_seconds())
+            return _block_ready(x)
+
+        fut = _fetch_pool().submit(work)
+        try:
+            return fut.result(timeout=wd)
+        except concurrent.futures.TimeoutError:
+            from amgx_tpu.core.errors import DeviceLostError
+
+            self.metrics.inc("resilience_watchdog_fires")
+            self._flight_incident(
+                "watchdog_fire",
+                detail=(
+                    f"fetch exceeded the {wd:g}s watchdog on device "
+                    f"{device_label!r}"
+                ),
+            )
+            raise DeviceLostError(
+                f"group fetch exceeded the {wd:g}s in-flight "
+                "watchdog (device presumed hung)",
+                device_label=device_label,
+            ) from None
+
+    @staticmethod
+    def _classify_device_loss(e, device_label=None):
+        """Map a post-dispatch runtime failure to a typed
+        :class:`DeviceLostError` when it plausibly means the DEVICE
+        (not the program) failed — the hook that makes failover work
+        on real hardware, where a lost chip surfaces as a jaxlib
+        ``XlaRuntimeError`` at the fetch, never as our own typed
+        class.  Classification runs at the FETCH boundary only: by
+        then the executable compiled and launched, so a runtime error
+        is device-side by construction (dispatch-time errors may be
+        compile/trace problems and are NOT classified — a program bug
+        must not trip chip breakers).  Returns the typed error, or
+        None to keep the generic typed-ResourceError conversion."""
+        from amgx_tpu.core.errors import DeviceLostError
+
+        if isinstance(e, DeviceLostError):
+            return e
+        name = type(e).__name__
+        mod = type(e).__module__ or ""
+        if (
+            name in ("XlaRuntimeError", "JaxRuntimeError")
+            or mod.startswith("jaxlib")
+        ):
+            msg = str(e)
+            # device-OOM is the one common PROGRAM-level runtime
+            # failure at this boundary: the group is too big, not the
+            # chip dead — requeuing it onto the next chip would OOM
+            # there too and serially trip every breaker in the fleet.
+            # Keep it on the generic typed path (fingerprint breaker,
+            # quarantine isolation).
+            if (
+                "RESOURCE_EXHAUSTED" in msg
+                or "Out of memory" in msg
+                or "out of memory" in msg
+            ):
+                return None
+            err = DeviceLostError(
+                f"device runtime failure at fetch: {name}: {e}",
+                device_label=device_label,
+            )
+            err.__cause__ = e
+            # inferred (not certain) device loss: the failover caller
+            # ALSO charges the fingerprint breaker, so a poisonous
+            # pattern whose every group dies at runtime still trips
+            # its own breaker instead of eating the fleet chip by chip
+            err.inferred = True
+            return err
+        return None
+
+    def _device_loss_attributed(self, plan, exc):
+        """Common device-loss bookkeeping: trip the plan's device
+        breaker (routing forgets the chip), release its reservation,
+        and log the incident.  Degrade-never-raise."""
+        if plan is not None:
+            try:
+                plan.device_failure(exc)
+            except Exception:  # noqa: BLE001 — health accounting must
+                self.metrics.inc("telemetry_errors")
+            try:
+                plan.abandon()
+            except Exception:  # noqa: BLE001
+                self.metrics.inc("telemetry_errors")
+        self._flight_incident(
+            "device_failover",
+            detail=(
+                f"device "
+                f"{getattr(plan, 'device_label', None)!r} lost: "
+                f"{type(exc).__name__}: {exc}"
+            ),
+        )
+
+    def _failover_replan(self, plan, exc, entry, Bb):
+        """Dispatch-side failover: the launch lost its device — trip
+        it and resolve a fresh plan through the placement degrade
+        chain (affinity re-routes to the least-loaded healthy chip; a
+        mesh shrinks to its healthy prefix; single-device retries in
+        place).  The caller re-ships the still-staged group through
+        the new plan exactly once."""
+        self._device_loss_attributed(plan, exc)
+        self.metrics.inc("resilience_failovers")
+        return self.placement.plan(self, entry, Bb)
+
+    def _failover_refetch(self, batch, exc):
+        """Fetch-side failover: the device died (or hung past the
+        watchdog) AFTER dispatch, with the staging slot long released
+        — re-dispatch the group from its retained host payload on a
+        fresh plan and perform the replacement fetch inline (the
+        caller is already inside the group's one blocking fetch).
+        One-shot: a second loss, or a group dispatched without a
+        retained payload (``failover=False``), re-raises typed."""
+        from amgx_tpu.core.errors import DeviceLostError
+
+        self._device_loss_attributed(batch.plan, exc)
+        retry = batch.retry
+        if retry is None or batch.requeued or batch.entry is None:
+            raise exc
+        batch.requeued = True
+        batch.res = None  # the lost device's handles are dead weight
+        self.metrics.inc("resilience_failovers")
+        entry, Bb, pat = batch.entry, batch.Bb, batch.pattern
+        nplan = None
+        try:
+            # inside the try: a failing replan (compile error on the
+            # shrunk layout, routing failure) must count as a requeue
+            # failure like every other second-failure path
+            nplan = self.placement.plan(self, entry, Bb)
+            vals_d = nplan.put(retry["vals"])
+            bs_d = nplan.put(retry["bs"])
+            x0 = retry["x0"]
+            if x0 is None:
+                x0 = np.zeros(
+                    (Bb, pat.nb), dtype=retry["bs"].dtype
+                )
+            x0_d = nplan.put(x0)
+            t_redispatch = time.perf_counter()
+            res = nplan.fn(entry.template, vals_d, bs_d, x0_d)
+            self.metrics.inc("batches")
+            self._watched_block(res.x, nplan.device_label)
+            t_done = time.perf_counter()
+            host = _fetch_host(res)
+        except BaseException as e2:  # noqa: BLE001 — the requeue is
+            # one-shot: ANY second failure settles the group typed
+            if isinstance(e2, DeviceLostError):
+                self._device_loss_attributed(nplan, e2)
+            elif nplan is not None:
+                try:
+                    nplan.abandon()
+                except Exception:  # noqa: BLE001
+                    self.metrics.inc("telemetry_errors")
+            self.metrics.inc("resilience_requeue_failures")
+            raise
+        # the replacement plan owns the group now: its on_fetch does
+        # the settle/health accounting, its timings are the real ones
+        batch.plan = nplan
+        batch.t_dispatch = t_redispatch
+        return host, t_done
 
     def _execute_group(self, grp: _Group, wait_dispatch: bool = True):
         """Host stage of the flusher: deadlines, hierarchy/compile
@@ -1527,9 +1958,14 @@ class BatchedSolveService:
                 self._dispatch_batched, entry, plan, grp, live, t_flush
             )
 
-    def _group_failed(self, grp: _Group, fp: str):
+    def _group_failed(self, grp: _Group, fp: str,
+                      device_loss: bool = False):
         self.metrics.inc("failed_groups")
-        self._breaker_failure(fp)
+        if not device_loss:
+            # a lost CHIP is not the pattern's fault: only non-device
+            # failures count toward the fingerprint breaker (the
+            # device breaker already tripped via the placement hook)
+            self._breaker_failure(fp)
         self.metrics.inc("quarantines")
         self._flight_incident(
             "quarantine",
@@ -1547,6 +1983,9 @@ class BatchedSolveService:
         Returns at DISPATCH — the only block_until_ready in steady
         state is inside SolveTicket.result().  Never raises: failures
         quarantine the group right here in the worker."""
+        from amgx_tpu.core import faults
+        from amgx_tpu.core.errors import DeviceLostError
+
         fp = grp.pattern.fingerprint
         try:
             pat = grp.pattern
@@ -1560,28 +1999,68 @@ class BatchedSolveService:
                 slot.fill_batch_padding(nreq, Bb)
                 if live[0].row != 0:
                     slot.vals[nreq:Bb] = slot.vals[live[0].row]
-                vals_d = plan.put(slot.vals[:Bb])
-                bs_d = plan.put(slot.bs[:Bb])
-                if slot.x0_used or plan.donate:
-                    # warm starts (or a donated buffer, which the
-                    # compiled call consumes) need a fresh transfer
-                    x0_d = plan.put(slot.x0s[:Bb])
-                else:
-                    # all-zero initial guesses: reuse one resident
-                    # device block instead of shipping zeros per flush
-                    # (keyed per placement target: a routed device's
-                    # zeros live on that device)
-                    zk = (Bb, pat.nb, str(grp.dtype)) + plan.zeros_key
-                    with self._lock:
-                        x0_d = self._zeros_x0.get(zk)
-                    if x0_d is None:
-                        x0_d = plan.zeros(Bb, pat.nb, grp.dtype)
+
+                def _ship(p):
+                    """Transfer + launch through one plan (run again,
+                    on a replacement plan, when the first plan's
+                    device is lost at dispatch)."""
+                    vals_d = p.put(slot.vals[:Bb])
+                    bs_d = p.put(slot.bs[:Bb])
+                    if slot.x0_used or p.donate:
+                        # warm starts (or a donated buffer, which the
+                        # compiled call consumes) need a fresh
+                        # transfer
+                        x0_d = p.put(slot.x0s[:Bb])
+                    else:
+                        # all-zero initial guesses: reuse one resident
+                        # device block instead of shipping zeros per
+                        # flush (keyed per placement target: a routed
+                        # device's zeros live on that device)
+                        zk = (
+                            (Bb, pat.nb, str(grp.dtype)) + p.zeros_key
+                        )
                         with self._lock:
-                            if len(self._zeros_x0) >= 64:
-                                self._zeros_x0.clear()
-                            self._zeros_x0[zk] = x0_d
+                            x0_d = self._zeros_x0.get(zk)
+                        if x0_d is None:
+                            x0_d = p.zeros(Bb, pat.nb, grp.dtype)
+                            with self._lock:
+                                if len(self._zeros_x0) >= 64:
+                                    self._zeros_x0.clear()
+                                self._zeros_x0[zk] = x0_d
+                    if faults.should_fire("device_lost_dispatch"):
+                        raise DeviceLostError(
+                            "injected device loss at dispatch (fault "
+                            "site device_lost_dispatch)",
+                            device_label=p.device_label,
+                        )
+                    return p.fn(entry.template, vals_d, bs_d, x0_d)
+
+                try:
+                    res = _ship(plan)
+                except DeviceLostError as e:
+                    # one-shot dispatch-side failover: trip the lost
+                    # device, resolve a replacement plan through the
+                    # degrade chain (the rows are still staged), and
+                    # re-ship; a SECOND loss escapes to the outer
+                    # handler and the group quarantines per-request
+                    plan = self._failover_replan(plan, e, entry, Bb)
+                    res = _ship(plan)
                 self.metrics.inc("batches")
-                res = plan.fn(entry.template, vals_d, bs_d, x0_d)
+                # failover payload: host copies of the batched arrays
+                # so a device lost AFTER this release can re-dispatch
+                # the group (the slot itself is reused by the next
+                # group and must not be retained)
+                retry = None
+                if self.failover:
+                    retry = {
+                        "vals": np.array(slot.vals[:Bb]),
+                        "bs": np.array(slot.bs[:Bb]),
+                        "x0": (
+                            np.array(slot.x0s[:Bb])
+                            if (slot.x0_used or plan.donate)
+                            else None
+                        ),
+                    }
                 # host buffers were copied to the device and the solve
                 # is launched: release ONLY now, so a pre-launch
                 # failure still leaves the rows intact for quarantine
@@ -1620,18 +2099,25 @@ class BatchedSolveService:
                     )
             br = _BatchResult(
                 self, res, pat, [r.ticket for r in live], Bb,
-                t_flush, t_dispatch, plan=plan,
+                t_flush, t_dispatch, plan=plan, entry=entry,
+                retry=retry,
             )
             for r in live:
                 r.ticket._batch = br
                 r.ticket._done = True
             self._breaker_success(fp)
-        except BaseException:  # noqa: BLE001 — worker must not die
-            try:
-                plan.abandon()  # release any routing reservation
-            except Exception:  # noqa: BLE001 — placement telemetry
-                self.metrics.inc("telemetry_errors")
-            self._group_failed(grp, fp)
+        except BaseException as e:  # noqa: BLE001 — worker must not die
+            device_loss = isinstance(e, DeviceLostError)
+            if device_loss:
+                # the REQUEUE's device died too: attribute the loss
+                # before quarantining (abandon rides along inside)
+                self._device_loss_attributed(plan, e)
+            else:
+                try:
+                    plan.abandon()  # release any routing reservation
+                except Exception:  # noqa: BLE001 — placement telemetry
+                    self.metrics.inc("telemetry_errors")
+            self._group_failed(grp, fp, device_loss=device_loss)
 
     def _execute_quarantined(self, grp: _Group):
         """Per-request isolation: each request re-solves on its OWN

@@ -401,6 +401,7 @@ class SolveSession:
             self._last_iters = int(res.iters)
             self.step_idx = st.step + 1
             self.manager._record_step(self, st, res)
+            self.manager._maybe_checkpoint(self)
 
     # -- persistence ---------------------------------------------------
 
@@ -440,9 +441,19 @@ class SessionManager:
         retries / store exports / spectral-bound re-estimation
         (``reestimate_eigs``) track the streamed values.  0 disables.
         Env default: ``AMGX_TPU_SESSION_RESETUP_EVERY`` (64).
+    checkpoint_every: persist each session's manifest (step counter,
+        warm-start x, status) to the artifact store every N RESOLVED
+        steps — the failure-domain contract: when the session's
+        device is lost mid-stream, :meth:`recover` resumes from the
+        last checkpoint losing at most N steps (and the replacement
+        steps re-pin through the placement router, whose warm set
+        forgot the tripped chip).  0 disables.  Env default:
+        ``AMGX_TPU_SESSION_CHECKPOINT_EVERY`` (16).
     """
 
-    def __init__(self, front, store=None, resetup_every: Optional[int] = None):
+    def __init__(self, front, store=None,
+                 resetup_every: Optional[int] = None,
+                 checkpoint_every: Optional[int] = None):
         from amgx_tpu.serve.gateway import SolveGateway
 
         if isinstance(front, SolveGateway):
@@ -460,6 +471,11 @@ class SessionManager:
             _env_int("AMGX_TPU_SESSION_RESETUP_EVERY", 64)
             if resetup_every is None
             else int(resetup_every)
+        )
+        self.checkpoint_every = (
+            _env_int("AMGX_TPU_SESSION_CHECKPOINT_EVERY", 16)
+            if checkpoint_every is None
+            else int(checkpoint_every)
         )
         self._lock = threading.Lock()
         self._sessions: dict = {}
@@ -699,6 +715,62 @@ class SessionManager:
         self._count("saves_total" if ok else "save_failures_total")
         return ok
 
+    def _maybe_checkpoint(self, sess: SolveSession):
+        """The ``checkpoint_every`` cadence: persist the session's
+        manifest after every Nth RESOLVED step so a device loss costs
+        at most N steps of stream progress.  Best-effort like every
+        persistence path — a failed checkpoint counts
+        (``checkpoint_failures_total``) and never fails the step.
+
+        Each checkpoint rewrites the FULL payload including the
+        immutable pattern arrays: the store holds ONE atomically
+        overwritten entry per session, so ``restore`` must find
+        ``row_offsets``/``col_indices`` in whatever write is current
+        — dropping them from periodic saves would require a second
+        pattern-only key and cross-key atomicity.  Size the cadence
+        accordingly for huge patterns on slow stores."""
+        n = self.checkpoint_every
+        if n <= 0 or self.store is None:
+            return
+        if sess.step_idx % n:
+            return
+        if self.save_session(sess):
+            self._count("checkpoints_total")
+            try:
+                self.service.metrics.inc("resilience_checkpoints")
+            except Exception:  # noqa: BLE001 — telemetry degrade
+                pass
+        else:
+            self._count("checkpoint_failures_total")
+
+    def recover(self, session_id: str, **kw) -> SolveSession:
+        """Device-loss recovery for one streaming session: discard
+        the live (wedged) session object — its in-flight step died
+        with its device — and resume from the last persisted
+        checkpoint via :meth:`restore`.  The resumed session's first
+        step re-pins through the placement router, whose warm set
+        forgot the tripped chip, so the stream continues on a healthy
+        device losing at most ``checkpoint_every`` steps.  Raises
+        :class:`StoreError` when no checkpoint exists — the live
+        session is then left UNTOUCHED (restore runs first), so a
+        caller can still read its state or restart the stream."""
+        live = self.get(session_id)
+        # restore FIRST: with no checkpoint (cadence disabled, store
+        # missing, loss before the first cadence multiple) this raises
+        # StoreError while the live session — the only state left —
+        # survives intact.  On success restore() already replaced the
+        # _sessions entry; the wedged live object is then retired.
+        sess = self.restore(session_id, **kw)
+        if live is not None:
+            live._abandon_stage()
+            # do NOT resolve the pending ticket: it belongs to the
+            # lost device and may already be settled typed — the
+            # checkpointed state is the authoritative resume point
+            live._pending = None
+            live.closed = True
+        self._count("recoveries_total")
+        return sess
+
     def save_all(self) -> int:
         """Finish and persist every open session (the drain
         protocol); returns the number persisted."""
@@ -775,6 +847,10 @@ class SessionManager:
         with self._lock:
             self._sessions[session_id] = sess
         self._count("restores_total")
+        try:
+            self.service.metrics.inc("resilience_restores")
+        except Exception:  # noqa: BLE001 — telemetry degrade
+            pass
         return sess
 
     def drain(self) -> dict:

@@ -108,6 +108,11 @@ _SERVE_GAUGES = {
     "gateway_draining",
 }
 
+# resilience_* keys that are levels, not totals
+_RESILIENCE_GAUGES = {
+    "resilience_devices_unhealthy",
+}
+
 # hierarchy/compile-cache counters get their own amgx_cache_* namespace
 # (the catalog's "cache source"), the rest of the int counters land in
 # amgx_serve_* / amgx_gateway_*
@@ -160,6 +165,16 @@ def serve_families(fams: FamilyTable, comp: str, snap: dict) -> None:
             elif k in _SERVE_GAUGES:
                 fams.add(f"amgx_serve_{k}", "gauge",
                          f"serve gauge {k}", labels, v)
+            elif k.startswith("resilience_"):
+                # failure-domain counters (device-loss failover,
+                # watchdog fires, session checkpoints/restores) get
+                # their own amgx_resilience_* namespace
+                if k in _RESILIENCE_GAUGES:
+                    fams.add(f"amgx_{k}", "gauge",
+                             f"resilience gauge {k}", labels, v)
+                else:
+                    fams.add(f"amgx_{k}_total", "counter",
+                             f"resilience counter {k}", labels, v)
             elif k.startswith("shed_"):
                 fams.add("amgx_gateway_sheds_by_reason_total", "counter",
                          "typed gateway sheds by reason",

@@ -526,6 +526,47 @@ def _mesh_record():
         return {"error": str(e)}
 
 
+def _resilience_record():
+    """Failure-domain chaos soak (PR 12): mixed traffic under a
+    seeded device-loss/hang/shed fault schedule, reduced op count —
+    the record carries the invariant verdict and the failover/
+    watchdog/checkpoint activity counts.  Guarded — must never take
+    the headline bench down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.chaos_soak import run as chaos_run
+
+        rec, problems = chaos_run(ops=12)
+        out = {
+            k: rec[k]
+            for k in (
+                "value",
+                "unit",
+                "outcomes",
+                "device_trips",
+                "device_probes",
+                "device_closes",
+                "failovers",
+                "watchdog_fires",
+                "checkpoints",
+                "restores",
+                "max_session_step_loss",
+                "checkpoint_every",
+                "ok",
+            )
+            if k in rec
+        }
+        if problems:
+            out["problems"] = problems
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: resilience record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _telemetry_record():
     """Telemetry overhead A/B (armed sample=0 vs disarmed, one warmed
     service; ci/telemetry_check.py, reduced reps) plus exposition /
@@ -689,6 +730,10 @@ def main():
     mesh_rec = _mesh_record()
     print(f"bench: mesh {mesh_rec}", file=sys.stderr)
 
+    # ---- failure domains (chaos soak invariants) -------------------
+    resilience_rec = _resilience_record()
+    print(f"bench: resilience {resilience_rec}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -714,6 +759,7 @@ def main():
                 "sstep": sstep_rec,
                 "session": session_rec,
                 "mesh": mesh_rec,
+                "resilience": resilience_rec,
             }
         )
     )

@@ -1,0 +1,536 @@
+"""Chaos soak: mixed serve traffic under a seeded randomized fault
+schedule, gated on the failure-domain invariants.
+
+Drives one gateway-fronted, affinity-placed service with everything
+the stack serves at once — batched one-shot tickets across several
+fingerprints/tenants/lanes, lockstep streaming sessions with
+checkpointing, a mid-soak drain and a warm-booted successor worker —
+while a deterministic (seeded) schedule arms device-level fault sites
+(``device_lost_dispatch`` / ``device_lost_fetch`` / ``fetch_hang``)
+and the pre-existing ones (``gateway_shed`` / ``admission_quota`` /
+``serve_compile``) between operations.
+
+Invariants (non-zero exit on violation — the failure-domain
+acceptance contract):
+
+  1. **zero unhandled exceptions** — every failure that reaches a
+     client is a typed ``AMGXTPUError``;
+  2. **100% typed settlement** — every ADMITTED ticket settles
+     (success or typed failure); none wedge, before or after the
+     drain;
+  3. **tripped-device quarantine** — while a device breaker is open,
+     no group is planned onto the tripped device except a counted
+     half-open probe (asserted per plan() call via an instrumented
+     policy);
+  4. **bounded session loss** — a session whose step dies with the
+     device resumes from its last checkpoint losing at most
+     ``checkpoint_every`` steps, and drained sessions resume on the
+     successor worker at their saved step;
+  5. **no leaked reservations** — after quiesce, every affinity
+     router load unit has been released on both workers;
+  6. **telemetry consistent** — the Prometheus page renders with the
+     ``amgx_resilience_*`` families present, and the gateway's
+     settlement accounting balances (admitted == completed + typed,
+     untyped == 0).
+
+Prints ONE JSON line (ci/serve_bench.py contract):
+
+    JAX_PLATFORMS=cpu python ci/chaos_soak.py [--ops 24] [--seed 7]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+# runnable from any cwd: the repo root precedes ci/ on the path
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import os  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the soak exercises cross-device failover: simulate a small chip pool
+# unless the caller already forced one
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+# store-wired services must not re-pin the process XLA cache at a
+# short-lived tempdir
+os.environ.setdefault("AMGX_TPU_XLA_CACHE", "0")
+
+import numpy as np  # noqa: E402
+
+import amgx_tpu  # noqa: E402
+
+amgx_tpu.initialize()
+
+from amgx_tpu import telemetry  # noqa: E402
+from amgx_tpu.core import faults  # noqa: E402
+from amgx_tpu.core.errors import (  # noqa: E402
+    AMGXTPUError,
+    DeviceLostError,
+    StoreError,
+)
+from amgx_tpu.io.poisson import poisson_scipy  # noqa: E402
+from amgx_tpu.serve import (  # noqa: E402
+    AffinityPlacement,
+    BatchedSolveService,
+    RetryPolicy,
+    SolveGateway,
+)
+from amgx_tpu.sessions import SessionManager  # noqa: E402
+
+# sites the schedule may arm between ops: (site, times)
+FAULT_MENU = (
+    ("device_lost_dispatch", 1),
+    ("device_lost_fetch", 1),
+    ("fetch_hang", 1),
+    ("gateway_shed", 1),
+    ("admission_quota", 1),
+    ("serve_compile", 1),
+)
+
+
+def _instrument_plans(pol):
+    """Wrap ``pol.plan`` to log every placement decision as
+    ``(device_label, tripped_devices_at_plan, probe_increment)``.
+    The log is only ANALYZED over serial windows (invariant 3): under
+    concurrent traffic a breaker legitimately flaps between the
+    snapshot and the routing decision, so inline assertions would
+    race their own subject."""
+    log = []
+    orig_plan = pol.plan
+
+    def logged_plan(service, entry, Bb):
+        tripped = tuple(pol.health.tripped_indices())
+        probes_before = pol.health.probes
+        plan = orig_plan(service, entry, Bb)
+        log.append((
+            plan.device_label, tripped,
+            pol.health.probes - probes_before,
+        ))
+        return plan
+
+    pol.plan = logged_plan
+    return log
+
+
+def _mk_worker(store_dir, watchdog_s, cadence):
+    pol = AffinityPlacement()
+    svc = BatchedSolveService(
+        max_batch=4,
+        max_wait_s=0.005,
+        store=store_dir,
+        placement=pol,
+        fetch_watchdog_s=watchdog_s,
+    )
+    gw = SolveGateway(service=svc, max_inflight=128)
+    mgr = SessionManager(gw, checkpoint_every=cadence,
+                         resetup_every=0)
+    gw._session_mgr = mgr
+    return pol, svc, gw, mgr
+
+
+def run(ops=24, seed=7, n_sessions=3, cadence=4, watchdog_s=0.3,
+        hang_s=2.5):
+    os.environ["AMGX_TPU_FAULT_HANG_S"] = str(hang_s)
+    rng = np.random.default_rng(seed)
+    rec: dict = {"metric": "chaos_soak", "unit": "invariants",
+                 "seed": seed, "ops": ops}
+    unhandled: list = []
+    tripped_violations: list = []
+    outcomes = {"success": 0, "typed": 0, "sheds": 0}
+    max_session_loss = 0
+    recoveries = 0
+
+    # two fingerprints of batched traffic + one session pattern
+    pats = [poisson_scipy((8, 8)).tocsr(),
+            poisson_scipy((10, 10)).tocsr()]
+    for sp in pats:
+        sp.sort_indices()
+    sess_pat = pats[0]
+    n_by_pat = [sp.shape[0] for sp in pats]
+    retry = RetryPolicy(max_attempts=3, base_s=0.01, max_s=0.05,
+                        seed=seed)
+
+    # seeded fault schedule: which ops arm which site (~40% of ops)
+    schedule = {}
+    for i in range(ops):
+        if rng.random() < 0.4:
+            schedule[i] = FAULT_MENU[int(rng.integers(len(FAULT_MENU)))]
+    # three FORCED events so the deep paths run at ANY seed: an early
+    # device loss (the tripped-device machinery engages), a hang on a
+    # batched group (the watchdog MUST fire — hang_s is sized above
+    # the watchdog's 25x-p99 adaptive floor for this workload's tiny
+    # groups), and a typed device loss on a session step-group once
+    # the first checkpoints exist (-> mgr.recover()).  Sessions step
+    # on ODD ops (the k-th session step happens at op 2k+1), so the
+    # forced session index must be odd and >= 2*cadence+1 (a
+    # checkpoint at step `cadence` exists by then).
+    forced_session_fault_at = (2 * cadence + 1) | 1
+    schedule[1] = ("device_lost_fetch", 1)
+    schedule[2] = ("fetch_hang", 1)
+
+    def settle(ticket):
+        """Resolve one admitted ticket; returns its outcome class and
+        records invariant-2 violations."""
+        try:
+            res = ticket.result()
+            if int(res.status) == 0:
+                outcomes["success"] += 1
+            else:
+                # non-converged but SETTLED: counts as typed-handled
+                outcomes["typed"] += 1
+            return "ok"
+        except AMGXTPUError:
+            outcomes["typed"] += 1
+            return "typed"
+        except BaseException as e:  # noqa: BLE001 — the invariant
+            unhandled.append(f"ticket: {type(e).__name__}: {e}")
+            return "unhandled"
+
+    with tempfile.TemporaryDirectory() as td:
+        pol, svc, gw, mgr = _mk_worker(td, watchdog_s, cadence)
+        _instrument_plans(pol)
+        gw.start(interval_s=0.002)
+
+        sessions = []
+        for k in range(n_sessions):
+            sessions.append(mgr.open(
+                sess_pat, session_id=f"chaos-{k}", tenant="sim",
+                lane="interactive",
+            ))
+        sess_steps_done = 0
+
+        def step_sessions(force_fault=None):
+            nonlocal sess_steps_done, max_session_loss, recoveries
+            nonlocal sessions
+            if force_fault is not None:
+                faults.arm(*force_fault)
+                # the forced loss must settle TYPED so the checkpoint-
+                # recovery path runs: with the retained payload the
+                # requeue would just succeed — drop it for this one
+                # step-group (deterministic; timing-based double-hangs
+                # are defeated by the watchdog's adaptive p99 floor)
+                svc.failover = False
+            steps = []
+            base = np.asarray(sess_pat.data)
+            for s in sessions:
+                jitter = 1.0 + 0.01 * rng.standard_normal(s.nnz)
+                steps.append((
+                    s, base * jitter,
+                    rng.standard_normal(s.n),
+                ))
+            try:
+                tickets = mgr.step_all(steps)
+            except AMGXTPUError:
+                outcomes["typed"] += 1
+                return
+            except BaseException as e:  # noqa: BLE001
+                unhandled.append(f"step_all: {type(e).__name__}: {e}")
+                return
+            finally:
+                if force_fault is not None:
+                    svc.failover = True
+            replaced = []
+            for s, t in zip(list(sessions), tickets):
+                try:
+                    t.result()
+                    outcomes["success"] += 1
+                except DeviceLostError:
+                    outcomes["typed"] += 1
+                    failed_at = s.step_idx  # already advanced past
+                    try:
+                        s2 = mgr.recover(s.session_id)
+                        loss = failed_at - s2.step_idx
+                        recoveries += 1
+                    except StoreError:
+                        # no checkpoint yet: restart the stream
+                        s2 = mgr.open(
+                            sess_pat, session_id=s.session_id,
+                            tenant="sim", lane="interactive",
+                        )
+                        loss = failed_at
+                    max_session_loss = max(max_session_loss, loss)
+                    replaced.append((s, s2))
+                except AMGXTPUError:
+                    outcomes["typed"] += 1
+                except BaseException as e:  # noqa: BLE001
+                    unhandled.append(
+                        f"session: {type(e).__name__}: {e}"
+                    )
+            for old, new in replaced:
+                sessions[sessions.index(old)] = new
+            sess_steps_done += 1
+
+        # ---- phase A: mixed traffic under the fault schedule -------
+        t0 = time.perf_counter()
+        for i in range(ops):
+            if i in schedule:
+                site, times = schedule[i]
+                if site == "fetch_hang":
+                    # the watchdog's adaptive floor rides the observed
+                    # device p99 — which this soak INFLATES (tickets
+                    # settle after whole bursts, so the device stage
+                    # counts consumer idle).  Reset the window so the
+                    # configured watchdog governs and the armed hang
+                    # provably exercises it.
+                    svc.metrics.reset_latency()
+                faults.arm(site, times)
+            # a burst of batched tickets
+            tickets = []
+            for _ in range(int(rng.integers(2, 5))):
+                j = int(rng.integers(len(pats)))
+                lane = "batch" if rng.random() < 0.3 else "interactive"
+                dl = 5.0 if rng.random() < 0.3 else None
+                try:
+                    tickets.append(retry.call(
+                        gw.submit, pats[j],
+                        rng.standard_normal(n_by_pat[j]),
+                        tenant=f"t{int(rng.integers(3))}", lane=lane,
+                        deadline_s=dl,
+                    ))
+                except AMGXTPUError:
+                    outcomes["sheds"] += 1
+                except BaseException as e:  # noqa: BLE001
+                    unhandled.append(
+                        f"submit: {type(e).__name__}: {e}"
+                    )
+            gw.flush()
+            for t in tickets:
+                settle(t)
+            # streaming sessions ride along every other op
+            if i % 2 == 1:
+                step_sessions(
+                    ("device_lost_fetch", 1)
+                    if i == forced_session_fault_at else None
+                )
+        faults.disarm()
+        rec["phase_a_s"] = round(time.perf_counter() - t0, 2)
+        rec["recoveries"] = recoveries
+
+        # ---- mid-soak drain ----------------------------------------
+        pre_drain_steps = {s.session_id: s.step_idx for s in sessions}
+        report = gw.drain(timeout_s=30.0)
+        rec["drain"] = report
+        drain_lossless = report["timed_out"] == 0
+        router_a = pol.router.snapshot()
+        health_a = pol.health.snapshot()
+        m = svc.metrics
+
+        # ---- successor worker: warm boot + session resume ----------
+        pol2, svc2, gw2, mgr2 = _mk_worker(td, watchdog_s, cadence)
+        plan_log2 = _instrument_plans(pol2)
+        svc2.warm_boot(wait=True, compile=False)
+        gw2.start(interval_s=0.002)
+        resume_ok = True
+        sessions2 = []
+        for sid, saved_step in pre_drain_steps.items():
+            try:
+                s2 = mgr2.restore(sid)
+            except StoreError as e:
+                resume_ok = False
+                unhandled.append(f"restore {sid}: {e}")
+                continue
+            if s2.step_idx != saved_step:
+                resume_ok = False
+                unhandled.append(
+                    f"session {sid} resumed at {s2.step_idx}, drained "
+                    f"at {saved_step}"
+                )
+            sessions2.append(s2)
+        sessions = sessions2
+
+        def step_sessions2():
+            base = np.asarray(sess_pat.data)
+            steps = [(
+                s, base * (1.0 + 0.01 * rng.standard_normal(s.nnz)),
+                rng.standard_normal(s.n),
+            ) for s in sessions]
+            try:
+                tickets = mgr2.step_all(steps)
+            except AMGXTPUError:
+                outcomes["typed"] += 1
+                return
+            for t in tickets:
+                settle(t)
+
+        # ---- phase B: the successor takes faults too ---------------
+        for i in range(max(ops // 4, 3)):
+            if rng.random() < 0.4:
+                faults.arm(*FAULT_MENU[int(rng.integers(3))])
+            tickets = []
+            for _ in range(2):
+                j = int(rng.integers(len(pats)))
+                try:
+                    tickets.append(retry.call(
+                        gw2.submit, pats[j],
+                        rng.standard_normal(n_by_pat[j]),
+                        tenant="t0",
+                    ))
+                except AMGXTPUError:
+                    outcomes["sheds"] += 1
+                except BaseException as e:  # noqa: BLE001
+                    unhandled.append(
+                        f"submit2: {type(e).__name__}: {e}"
+                    )
+            gw2.flush()
+            for t in tickets:
+                settle(t)
+            if sessions:
+                step_sessions2()
+        faults.disarm()
+
+        # ---- invariant 3, serial window: tripped-device quarantine -
+        # With the worker quiesced (every ticket settled, one group in
+        # flight at a time), trip a device deterministically and
+        # drive 2x the probe cadence of serial groups: every plan that
+        # lands on a tripped device must be a counted half-open probe,
+        # and one probe's success must re-admit the chip.
+        with faults.inject("device_lost_fetch", times=1):
+            t = gw2.submit(pats[0],
+                           rng.standard_normal(n_by_pat[0]))
+            gw2.flush()
+            settle(t)
+        if not pol2.health.tripped_indices():
+            tripped_violations.append(
+                "serial phase: injected device loss tripped nothing"
+            )
+        mark = len(plan_log2)
+        for _ in range(2 * pol2.health.probe_every):
+            t = gw2.submit(pats[0],
+                           rng.standard_normal(n_by_pat[0]))
+            gw2.flush()
+            settle(t)
+        for lab, tripped, dprobe in plan_log2[mark:]:
+            if (
+                lab is not None and lab.isdigit()
+                and int(lab) in tripped and not dprobe
+            ):
+                tripped_violations.append(
+                    f"group planned onto tripped device {lab} "
+                    "without a probe"
+                )
+        if pol2.health.tripped_indices():
+            tripped_violations.append(
+                "tripped device never re-admitted: no successful "
+                f"half-open probe in {2 * pol2.health.probe_every} "
+                "serial groups"
+            )
+        gw2.stop()
+        router_b = pol2.router.snapshot()
+        m2 = svc2.metrics
+
+        # ---- invariants --------------------------------------------
+        prom = telemetry.get_registry().render_prometheus()
+        problems = []
+        if unhandled:
+            problems.append(
+                f"invariant 1/2: {len(unhandled)} unhandled/"
+                f"lost: {unhandled[:4]}"
+            )
+        if tripped_violations:
+            problems.append(
+                f"invariant 3: {tripped_violations[:4]}"
+            )
+        if max_session_loss > cadence:
+            problems.append(
+                f"invariant 4: session lost {max_session_loss} steps "
+                f"(> checkpoint cadence {cadence})"
+            )
+        if not resume_ok:
+            problems.append(
+                "invariant 4: drained sessions did not resume at "
+                "their saved step"
+            )
+        if not drain_lossless:
+            problems.append(
+                f"invariant 2: drain timed out {report['timed_out']} "
+                "tickets"
+            )
+        for name, snap in (("A", router_a), ("B", router_b)):
+            if any(o != 0 for o in snap["outstanding"]):
+                problems.append(
+                    f"invariant 5: worker {name} leaked affinity "
+                    f"reservations: {snap['outstanding']}"
+                )
+        for name, mm, gg in (("A", m, gw), ("B", m2, gw2)):
+            unt = mm.get("gateway_untyped_failures")
+            adm = mm.get("gateway_admitted")
+            comp = mm.get("gateway_completed")
+            typd = mm.get("gateway_typed_failures")
+            if unt:
+                problems.append(
+                    f"invariant 6: worker {name} saw {unt} UNTYPED "
+                    "gateway failures"
+                )
+            if adm != comp + typd + unt:
+                problems.append(
+                    f"invariant 6: worker {name} settlement does not "
+                    f"balance: admitted={adm} completed={comp} "
+                    f"typed={typd} untyped={unt}"
+                )
+        if "amgx_resilience_device_trips_total" not in prom:
+            problems.append(
+                "invariant 6: amgx_resilience_* families missing "
+                "from the exposition"
+            )
+        if m.get("resilience_device_trips") < 1:
+            problems.append(
+                "soak never tripped a device breaker (schedule "
+                "ineffective — raise ops)"
+            )
+        if m.get("resilience_watchdog_fires") < 1:
+            problems.append(
+                "the forced hang never tripped the watchdog (hang_s "
+                "below the adaptive p99 floor?)"
+            )
+        if recoveries < 1:
+            problems.append(
+                "the forced session device-loss never exercised "
+                "checkpoint recovery"
+            )
+
+        rec.update({
+            "value": len(problems),
+            "outcomes": dict(outcomes),
+            "session_steps": sess_steps_done,
+            "max_session_step_loss": max_session_loss,
+            "checkpoint_every": cadence,
+            "device_trips": m.get("resilience_device_trips"),
+            "device_probes": m.get("resilience_device_probes"),
+            "device_closes": m.get("resilience_device_closes"),
+            "failovers": m.get("resilience_failovers"),
+            "watchdog_fires": m.get("resilience_watchdog_fires"),
+            "checkpoints": m.get("resilience_checkpoints"),
+            "restores": m2.get("resilience_restores"),
+            "health": health_a,
+            "ok": not problems,
+        })
+        os.environ.pop("AMGX_TPU_FAULT_HANG_S", None)
+        return rec, problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--sessions", type=int, default=3)
+    ap.add_argument("--cadence", type=int, default=4)
+    args = ap.parse_args(argv)
+    rec, problems = run(ops=args.ops, seed=args.seed,
+                        n_sessions=args.sessions,
+                        cadence=args.cadence)
+    print(json.dumps(rec), flush=True)
+    for p in problems:
+        print(f"chaos_soak: FAIL: {p}", file=sys.stderr)
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

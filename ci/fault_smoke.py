@@ -351,6 +351,112 @@ def site_telemetry_export():
     )
 
 
+def site_device_lost_dispatch():
+    """Device lost at launch: the group requeues ONCE through the
+    placement degrade chain and every ticket still succeeds — no
+    quarantine, one counted failover, the device breaker tripped."""
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(11)
+    svc = BatchedSolveService(max_batch=2)
+    with faults.inject("device_lost_dispatch", times=1):
+        t1 = svc.submit(sp, rng.standard_normal(n))
+        t2 = svc.submit(sp, rng.standard_normal(n))
+        svc.flush()
+        r1, r2 = t1.result(), t2.result()
+    ok = (
+        int(r1.status) == SUCCESS
+        and int(r2.status) == SUCCESS
+        and svc.metrics.get("resilience_failovers") == 1
+        and svc.metrics.get("quarantines") == 0
+    )
+    return ok, (
+        f"status=({int(r1.status)},{int(r2.status)}) "
+        f"failovers={svc.metrics.get('resilience_failovers')}"
+    )
+
+
+def site_device_lost_fetch():
+    """Device lost AFTER dispatch: the fetch-side failover
+    re-dispatches the group from its retained host payload; every
+    ticket succeeds with one counted failover."""
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(12)
+    svc = BatchedSolveService(max_batch=2)
+    with faults.inject("device_lost_fetch", times=1):
+        t1 = svc.submit(sp, rng.standard_normal(n))
+        t2 = svc.submit(sp, rng.standard_normal(n))
+        svc.flush()
+        r1, r2 = t1.result(), t2.result()
+    ok = (
+        int(r1.status) == SUCCESS
+        and int(r2.status) == SUCCESS
+        and svc.metrics.get("resilience_failovers") == 1
+    )
+    return ok, (
+        f"status=({int(r1.status)},{int(r2.status)}) "
+        f"failovers={svc.metrics.get('resilience_failovers')}"
+    )
+
+
+def site_fetch_hang():
+    """A hung fetch trips the in-flight watchdog (typed DeviceLost,
+    never an indefinite block) and the requeued group still
+    succeeds; with the second budget unit the requeue ALSO hangs and
+    the tickets settle typed instead of wedging."""
+    import os as _os
+
+    from amgx_tpu.core.errors import DeviceLostError
+    from amgx_tpu.serve import BatchedSolveService
+
+    sp = poisson_scipy((8, 8)).tocsr()
+    n = sp.shape[0]
+    rng = np.random.default_rng(13)
+    _os.environ["AMGX_TPU_FAULT_HANG_S"] = "1.0"
+    try:
+        svc = BatchedSolveService(max_batch=2, fetch_watchdog_s=0.2)
+        with faults.inject("fetch_hang", times=1):
+            t1 = svc.submit(sp, rng.standard_normal(n))
+            t2 = svc.submit(sp, rng.standard_normal(n))
+            svc.flush()
+            r1, r2 = t1.result(), t2.result()
+        recovered = (
+            int(r1.status) == SUCCESS
+            and int(r2.status) == SUCCESS
+            and svc.metrics.get("resilience_watchdog_fires") == 1
+        )
+        svc2 = BatchedSolveService(max_batch=2, fetch_watchdog_s=0.2)
+        with faults.inject("fetch_hang", times=2):
+            t3 = svc2.submit(sp, rng.standard_normal(n))
+            t4 = svc2.submit(sp, rng.standard_normal(n))
+            svc2.flush()
+            outcomes = []
+            for t in (t3, t4):
+                try:
+                    t.result()
+                    outcomes.append("ok")
+                except DeviceLostError:
+                    outcomes.append("typed")
+                except BaseException:  # noqa: BLE001 — fails the site
+                    outcomes.append("UNTYPED")
+        ok = (
+            recovered
+            and outcomes == ["typed", "typed"]
+            and svc2.metrics.get("resilience_watchdog_fires") == 2
+        )
+        return ok, (
+            f"recovered={recovered} double_hang={outcomes} "
+            f"fires={svc2.metrics.get('resilience_watchdog_fires')}"
+        )
+    finally:
+        _os.environ.pop("AMGX_TPU_FAULT_HANG_S", None)
+
+
 def baseline_determinism():
     """All sites disarmed: two fresh solves are bit-identical."""
     faults.disarm()
@@ -372,6 +478,9 @@ MATRIX = [
     ("admission_quota", site_admission_quota),
     ("drain_timeout", site_drain_timeout),
     ("telemetry_export", site_telemetry_export),
+    ("device_lost_dispatch", site_device_lost_dispatch),
+    ("device_lost_fetch", site_device_lost_fetch),
+    ("fetch_hang", site_fetch_hang),
     ("baseline_determinism", baseline_determinism),
 ]
 

@@ -14,6 +14,17 @@ python -m pytest tests/ -q -m slow
 # recovery-path regression fails CI here before the bench runs.
 JAX_PLATFORMS=cpu python ci/fault_smoke.py
 
+# ---- failure domains: chaos soak -------------------------------------
+# One JSON line; non-zero exit when mixed traffic (batched tickets +
+# lockstep checkpointed sessions + a mid-soak drain + a warm-booted
+# successor) under a seeded randomized device-loss/hang/shed fault
+# schedule violates an invariant: any unhandled exception, an admitted
+# ticket that never settles typed-or-success, a group planned onto a
+# tripped device without a half-open probe, a session resuming with
+# more than checkpoint-cadence step loss, a leaked affinity load
+# reservation, or unbalanced settlement accounting.
+JAX_PLATFORMS=cpu python ci/chaos_soak.py --ops 16
+
 # ---- serve pipeline: throughput + latency floors ---------------------
 # One JSON line; non-zero exit when batched speedup drops below the 3x
 # floor, the per-ticket p50/p99 latency fields are missing/incoherent,
