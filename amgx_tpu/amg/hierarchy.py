@@ -44,6 +44,20 @@ from amgx_tpu.solvers.registry import (
 # cycles
 W_MAX_BRANCH_LEVELS = 6
 
+# hierarchy_dtype spellings -> numpy dtype (SAME = keep the input
+# dtype; bf16 resolves through jax's ml_dtypes registration)
+_HIERARCHY_DTYPES = {
+    "FLOAT64": np.float64, "F64": np.float64, "DOUBLE": np.float64,
+    "FLOAT32": np.float32, "F32": np.float32, "FLOAT": np.float32,
+    "BFLOAT16": "bfloat16", "BF16": "bfloat16",
+}
+
+
+def _to_dtype(v, dt):
+    """Trace-level cast helper: no-op when already at ``dt`` (keeps
+    all-one-dtype cycles byte-identical to the pre-policy program)."""
+    return v if v.dtype == dt else v.astype(dt)
+
 
 def levels_bitwise_equal(amg_a, amg_b) -> str | None:
     """Compare two set-up AMG hierarchies for BITWISE equality of
@@ -166,6 +180,13 @@ class AMGSolver(Solver):
         # rebuilds everything; k > 0 = the top k Galerkin products
         # re-evaluate on device (amg/spgemm.py plans); < 0 = all levels
         self.structure_reuse = int(g("structure_reuse_levels"))
+        # per-level precision policy (the cheap-preconditioner mode,
+        # ROADMAP item 3 / SParSH-AMG): hierarchy values cast to
+        # hierarchy_dtype at _finalize_setup — COARSE casts levels >= 1
+        # plus every P/R, ALL also the finest — riding the batched
+        # _upload_levels transfer, so cast bytes never ship twice
+        self.hierarchy_dtype = str(g("hierarchy_dtype")).upper()
+        self.level_dtype_policy = str(g("level_dtype_policy")).upper()
         if self.intensive_smoothing:
             self.presweeps = max(self.presweeps, 4)
             self.postsweeps = max(self.postsweeps, 4)
@@ -272,7 +293,11 @@ class AMGSolver(Solver):
         sm.setup(A)
         return sm
 
-    def _make_coarse_solver(self, A: SparseMatrix):
+    def _new_coarse_solver(self, A: SparseMatrix):
+        """Un-set-up coarse-solver instance for this config and
+        coarsest operator, or None (NOSOLVER / dense size gate).  The
+        store-restore path imports state into it instead of running
+        ``setup``."""
         name, cscope = self.cfg.get_scoped("coarse_solver", self.scope)
         if name == "NOSOLVER":
             return None
@@ -282,7 +307,18 @@ class AMGSolver(Solver):
             if 0 < self.dense_lu_max_rows < A.n_rows:
                 return None
         cs = make_nested(SolverRegistry.get(name)(self.cfg, cscope))
-        cs.setup(A)
+        from amgx_tpu.solvers.inexact import InexactCoarseSolver
+
+        if isinstance(cs, InexactCoarseSolver):
+            # the inexact sweep budget is tolerance-linked through the
+            # cycle depth (solvers/inexact.py)
+            cs.cycle_depth = len(self.levels)
+        return cs
+
+    def _make_coarse_solver(self, A: SparseMatrix):
+        cs = self._new_coarse_solver(A)
+        if cs is not None:
+            cs.setup(A)
         return cs
 
     def _setup_impl(self, A: SparseMatrix):
@@ -452,6 +488,85 @@ class AMGSolver(Solver):
                 old._propagate_structure_memo(new)
             setattr(lvl, name, new)
 
+    # ------------------------------------------------------------------
+    # per-level precision policy (cheap preconditioner)
+
+    def _hierarchy_dtype(self):
+        """Target numpy dtype of the reduced-precision policy, or None
+        (hierarchy_dtype=SAME, or complex operators).  A target equal
+        to the input dtype is returned too — the casts are then
+        identity no-ops (``astype`` short-circuits)."""
+        spec = _HIERARCHY_DTYPES.get(self.hierarchy_dtype)
+        if spec is None:
+            return None
+        dt = np.dtype(spec)
+        if self.levels:
+            fine = np.dtype(self.levels[0].A.values.dtype)
+            if fine.kind == "c":
+                # complex hierarchies have no reduced-precision twin
+                # registered; keep them untouched
+                return None
+        return dt
+
+    def _cast_level_ids(self, dt):
+        """Level ids whose OPERATOR the policy casts (P/R always cast
+        when a target dtype is set — transfer bandwidth is the point)."""
+        if dt is None:
+            return set()
+        first = 0 if self.level_dtype_policy == "ALL" else 1
+        return {lvl.level_id for lvl in self.levels[first:]}
+
+    def _cast_hierarchy(self):
+        """Apply the per-level precision policy in place — called at
+        the top of ``_finalize_setup`` so host-resident cast values
+        ride the ONE batched ``_upload_levels`` transfer and smoothers
+        / the coarse solver set up on the cast operators.  Idempotent:
+        ``SparseMatrix.astype`` short-circuits on a matching dtype, so
+        resetups and store restores never churn objects."""
+        dt = self._hierarchy_dtype()
+        if dt is None:
+            return
+        cast_ids = self._cast_level_ids(dt)
+        for lvl in self.levels:
+            if lvl.level_id in cast_ids:
+                lvl.A = lvl.A.astype(dt)
+            for name in ("P", "R"):
+                m = getattr(lvl, name)
+                if m is not None:
+                    setattr(lvl, name, m.astype(dt))
+
+    def _check_restored_dtypes(self):
+        """Store-restore guardrail: a persisted hierarchy whose level
+        dtypes contradict this config's precision policy is a STALE
+        artifact (e.g. an all-f64 payload whose manifest was rewritten
+        for a mixed-precision config) — restoring it would silently
+        serve the wrong-precision hierarchy as a warm hit.  Raises
+        :class:`~amgx_tpu.core.errors.StoreError`, which every store
+        consumer counts as a miss."""
+        from amgx_tpu.core.errors import StoreError
+
+        dt = self._hierarchy_dtype()
+        if dt is None:
+            return
+        cast_ids = self._cast_level_ids(dt)
+        for lvl in self.levels:
+            got = [
+                (name, np.dtype(m.values.dtype))
+                for name, m in (
+                    ("A", lvl.A if lvl.level_id in cast_ids else None),
+                    ("P", lvl.P),
+                    ("R", lvl.R),
+                )
+                if m is not None and np.dtype(m.values.dtype) != dt
+            ]
+            if got:
+                raise StoreError(
+                    f"persisted hierarchy level {lvl.level_id} carries "
+                    f"{got[0][0]} values of dtype {got[0][1]} but this "
+                    f"config's precision policy wants {dt} — stale "
+                    "artifact, counted as a miss"
+                )
+
     def _refresh_smoother(self, lvl: AMGLevel):
         """Level-smoother refresh policy: a surviving smoother (the
         values-only resetup path keeps level objects) RESETUPS in
@@ -466,6 +581,11 @@ class AMGSolver(Solver):
             lvl.smoother.resetup(lvl.A)
 
     def _finalize_setup(self, reuse_smoothers: bool = False):
+        # precision policy BEFORE the batched upload: cast values are
+        # host-resident at cold setup, so the reduced bytes are what
+        # ships; smoothers and the coarse solver then derive their
+        # state from the cast operators
+        self._cast_hierarchy()
         self._upload_levels()
         # smoothers on all but the coarsest; coarse solver on the last.
         # reuse_smoothers (store-restore path ONLY): keep smoothers the
@@ -475,8 +595,22 @@ class AMGSolver(Solver):
             for lvl in self.levels[:-1]:
                 if not (reuse_smoothers and lvl.smoother is not None):
                     self._refresh_smoother(lvl)
-            coarsest = self.levels[-1]
-            self.coarse_solver = self._make_coarse_solver(coarsest.A)
+        coarsest = self.levels[-1]
+        # the coarse-solver build gets its own profiler phase: a
+        # DenseLU bottom's O(n^3) factorization used to hide inside
+        # "finalize", which made the coarse_solver=INEXACT win
+        # invisible in setup_profile and the
+        # amgx_setup_phase_seconds_total family
+        with setup_phase("coarse_factor"):
+            restored = getattr(self, "_restored_coarse", None)
+            self._restored_coarse = None
+            if reuse_smoothers and restored is not None:
+                self.coarse_solver = restored
+            else:
+                self.coarse_solver = self._make_coarse_solver(
+                    coarsest.A
+                )
+        with setup_phase("finalize"):
             if self.coarse_solver is None and len(self.levels) > 0:
                 # coarsest-level smoothing fallback
                 # (coarse_solver=NOSOLVER)
@@ -565,7 +699,21 @@ class AMGSolver(Solver):
                 "plan": lvl.rap_plan,
                 "smoother": sm,
             })
-        return {"levels": levels}
+        # coarse-solver state rides along like the smoothers': a
+        # DenseLU bottom restores its factors instead of re-paying the
+        # O(n^3) factorization, INEXACT restores its inner spectral
+        # bounds.  Best-effort — unexportable state re-derives at
+        # import from the bitwise-identical coarsest operator.
+        coarse = None
+        if self.coarse_solver is not None:
+            try:
+                coarse = {
+                    "name": self.coarse_solver.registry_name,
+                    "state": self.coarse_solver._export_setup(),
+                }
+            except Exception:  # noqa: BLE001 — re-derive at import
+                coarse = None
+        return {"levels": levels, "coarse": coarse}
 
     def _import_impl(self, impl):
         if not impl or not impl.get("levels"):
@@ -585,6 +733,23 @@ class AMGSolver(Solver):
                 except Exception:  # noqa: BLE001 — finalize re-derives
                     lvl.smoother = None
             self.levels.append(lvl)
+        # stale-artifact guardrail BEFORE finalize: _cast_hierarchy
+        # would silently "repair" wrong-dtype levels, turning a stale
+        # payload into a wrong-provenance warm hit
+        self._check_restored_dtypes()
+        self._restored_coarse = None
+        cs_state = impl.get("coarse")
+        if cs_state:
+            try:
+                cs = self._new_coarse_solver(self.levels[-1].A)
+                if (
+                    cs is not None
+                    and cs.registry_name == cs_state.get("name")
+                ):
+                    cs._import_setup(cs_state["state"])
+                    self._restored_coarse = cs
+            except Exception:  # noqa: BLE001 — finalize re-derives
+                self._restored_coarse = None
         self.setup_profile = {}
         self.setup_stats["restored"] = True
         self._finalize_setup(reuse_smoothers=True)
@@ -624,6 +789,12 @@ class AMGSolver(Solver):
         n_lv = len(lvls)
         sm_fns = [None if s is None else s[1] for s in sm]
         cs_fn = None if cs is None else cs[1]
+        # per-level value dtypes (mixed-precision policy): the traced
+        # rebuild must hand every level's consumers — operator swap,
+        # smoother params, coarse refactorization — values in the
+        # dtype the setup-time hierarchy carries, exactly like
+        # _resetup_impl's replace_values path casts
+        lvl_dts = tuple(lvl.A.values.dtype for lvl in lvls)
         template = dict(
             As=tuple(lvl.A for lvl in lvls),
             Ps=tuple(lvl.P for lvl in lvls[:-1]),
@@ -634,11 +805,15 @@ class AMGSolver(Solver):
         )
 
         def fn(t, v):
-            lvl_vals = [v]
+            lvl_vals = [_to_dtype(v, lvl_dts[0])]
             for i in range(n_lv - 1):
                 lvl_vals.append(
-                    t["plans"][i].apply(
-                        t["Rs"][i].values, lvl_vals[i], t["Ps"][i].values
+                    _to_dtype(
+                        t["plans"][i].apply(
+                            t["Rs"][i].values, lvl_vals[i],
+                            t["Ps"][i].values,
+                        ),
+                        lvl_dts[i + 1],
                     )
                 )
             per_level = []
@@ -698,8 +873,17 @@ class AMGSolver(Solver):
         return pre, post
 
     def make_cycle(self):
-        """Pure fn(params, b, x) -> x : one multigrid cycle."""
+        """Pure fn(params, b, x) -> x : one multigrid cycle.
+
+        Mixed-precision hierarchies (hierarchy_dtype): each level's
+        work runs in that level's value dtype — the restricted rhs
+        casts DOWN entering a cheaper level and the prolonged
+        correction casts back UP at the transfer boundary, so the
+        coarse-grid bandwidth (the bulk of a V-cycle's bytes) moves at
+        the reduced width.  All casts are no-ops for single-dtype
+        hierarchies (``_to_dtype``)."""
         n_levels = len(self.levels)
+        lvl_dts = [lvl.A.values.dtype for lvl in self.levels]
         smooth_fns = [
             lvl.smoother.make_smooth() if lvl.smoother else None
             for lvl in self.levels
@@ -744,9 +928,14 @@ class AMGSolver(Solver):
                     if coarse_apply is not None:
                         # error-correction form is exact for direct
                         # solvers and safe for nonzero x (reference
-                        # launchCoarseSolver)
-                        return x + coarse_apply(
-                            coarse_params, b - spmv(A, x)
+                        # launchCoarseSolver).  The correction casts
+                        # back to the level dtype: a sub-f32 level's
+                        # DenseLU factors solve in f32
+                        return x + _to_dtype(
+                            coarse_apply(
+                                coarse_params, b - spmv(A, x)
+                            ),
+                            x.dtype,
                         )
                     return smooth_fns[lvl_id](
                         smp, b, x, self.coarsest_sweeps
@@ -757,9 +946,9 @@ class AMGSolver(Solver):
                     x = smooth_fns[lvl_id](smp, b, x, pre)
             with named_scope(f"amg_l{lvl_id}_restrict"):
                 r = b - spmv(A, x)
-                bc = spmv(R, r)
+                bc = _to_dtype(spmv(R, r), lvl_dts[lvl_id + 1])
             xc = jnp.zeros(
-                (R.n_rows * R.block_size,), dtype=b.dtype
+                (R.n_rows * R.block_size,), dtype=lvl_dts[lvl_id + 1]
             )
             branch = lvl_id < min(
                 n_levels - 2, self._W_MAX_BRANCH_LEVELS
@@ -778,9 +967,9 @@ class AMGSolver(Solver):
                 if self.error_scaling >= 2:
                     x = _scaled_correction(
                         A, smooth_fns[lvl_id], smp, b, x, r,
-                        spmv(P, xc))
+                        _to_dtype(spmv(P, xc), x.dtype))
                 else:
-                    x = x + spmv(P, xc)
+                    x = x + _to_dtype(spmv(P, xc), x.dtype)
             if post > 0:
                 with named_scope(f"amg_l{lvl_id}_postsmooth"):
                     x = smooth_fns[lvl_id](smp, b, x, post)
@@ -826,8 +1015,11 @@ class AMGSolver(Solver):
             if lvl_id == n_levels - 1:
                 with named_scope("amg_coarse_solve"):
                     if coarse_apply is not None:
-                        return x + coarse_apply(
-                            coarse_params, b - spmv(A, x)
+                        return x + _to_dtype(
+                            coarse_apply(
+                                coarse_params, b - spmv(A, x)
+                            ),
+                            x.dtype,
                         )
                     return smooth_fns[lvl_id](
                         smp, b, x, self.coarsest_sweeps
@@ -838,16 +1030,18 @@ class AMGSolver(Solver):
                     x = smooth_fns[lvl_id](smp, b, x, pre)
             with named_scope(f"amg_l{lvl_id}_restrict"):
                 r = b - spmv(A, x)
-                bc = spmv(R, r)
-            xc = jnp.zeros((R.n_rows * R.block_size,), dtype=b.dtype)
+                bc = _to_dtype(spmv(R, r), lvl_dts[lvl_id + 1])
+            xc = jnp.zeros(
+                (R.n_rows * R.block_size,), dtype=lvl_dts[lvl_id + 1]
+            )
             xc = _v_cycle(params, bc, xc, lvl_id + 1)
             with named_scope(f"amg_l{lvl_id}_prolong"):
                 if error_scaling >= 2:
                     x = _scaled_correction(
                         A, smooth_fns[lvl_id], smp, b, x, r,
-                        spmv(P, xc))
+                        _to_dtype(spmv(P, xc), x.dtype))
                 else:
-                    x = x + spmv(P, xc)
+                    x = x + _to_dtype(spmv(P, xc), x.dtype)
             if post > 0:
                 with named_scope(f"amg_l{lvl_id}_postsmooth"):
                     x = smooth_fns[lvl_id](smp, b, x, post)
@@ -865,9 +1059,23 @@ class AMGSolver(Solver):
 
     def make_step(self):
         cycle = self.make_cycle()
+        fine_dt = self.levels[0].A.values.dtype
 
         def step(params, b, x):
-            return cycle(params, b, x)
+            # preconditioner boundary cast (level_dtype_policy=ALL
+            # under an f64 outer solver): the whole cycle — finest
+            # smoothing included — runs in the hierarchy dtype, and
+            # the correction returns at the caller's precision.  The
+            # f64 accuracy envelope is the OUTER solver's job
+            # (RefinementSolver / monitored Krylov residuals).
+            if b.dtype == fine_dt:
+                return cycle(params, b, x)
+            return _to_dtype(
+                cycle(
+                    params, _to_dtype(b, fine_dt), _to_dtype(x, fine_dt)
+                ),
+                b.dtype,
+            )
 
         return step
 

@@ -100,6 +100,13 @@ register("sstep_replace_every", I, 0,
 # --- coarse / dense ---------------------------------------------------------
 register("dense_lu_num_rows", I, 128, "densify when rows <= this")
 register("dense_lu_max_rows", I, 0, "never densify above this (0: unused)")
+register("inexact_coarse_solver", S, "OPT_POLYNOMIAL",
+         "inner method of coarse_solver=INEXACT: fixed-sweep "
+         "optimal-weight polynomial smoothing or a few unmonitored "
+         "s-step PCG steps replace the DenseLU factorization "
+         "(solvers/inexact.py)",
+         ("OPT_POLYNOMIAL", "SSTEP_PCG", "CHEBYSHEV", "KPZ_POLYNOMIAL",
+          "BLOCK_JACOBI", "JACOBI_L1"))
 register("dense_lu_zero_pivot", S, "REGULARIZE",
          "zero/tiny-pivot handling in DENSE_LU factorization: "
          "REGULARIZE refactorizes with a scaled ridge (degraded but "
@@ -114,6 +121,17 @@ register("solve_retries", I, 0,
 register("stagnation_window", I, 0,
          "report DIVERGED when the residual has not decreased over "
          "this many iterations (stagnation detection; 0: off)")
+register("precision_fallback", I, 1,
+         "ITERATIVE_REFINEMENT accuracy guardrail: when the inner "
+         "solver runs a reduced-precision hierarchy "
+         "(hierarchy_dtype != SAME) and the refined solve trips the "
+         "guardrail (non-SUCCESS status, or more outer corrections "
+         "than refine_iteration_guard), re-solve once with an "
+         "hierarchy_dtype=SAME fallback solver (0: off)")
+register("refine_iteration_guard", I, 0,
+         "outer-iteration guardrail for the precision fallback: more "
+         "than N outer refinement corrections trips the f64 re-solve "
+         "(0: only a non-SUCCESS status trips)")
 
 # --- smoother knobs ---------------------------------------------------------
 register("relaxation_factor", F, 0.9, "solver relaxation factor")
@@ -139,6 +157,21 @@ register("cf_smoothing_mode", I, 0, "CF smoothing flavour")
 # --- AMG hierarchy ----------------------------------------------------------
 register("algorithm", S, "CLASSICAL", "",
          ("CLASSICAL", "AGGREGATION", "ENERGYMIN"))
+register("hierarchy_dtype", S, "SAME",
+         "reduced-precision hierarchy values (the cheap-preconditioner "
+         "policy, amg/hierarchy.py): cast level operators, P/R, and "
+         "smoother state to this dtype at _finalize_setup.  SAME keeps "
+         "the input dtype; wrap reduced hierarchies in "
+         "ITERATIVE_REFINEMENT (f64 outer correction) to keep the "
+         "final tolerance unchanged (doc/PERFORMANCE.md)",
+         ("SAME", "FLOAT64", "F64", "DOUBLE", "FLOAT32", "F32", "FLOAT",
+          "BFLOAT16", "BF16"))
+register("level_dtype_policy", S, "COARSE",
+         "which levels hierarchy_dtype applies to: COARSE casts levels "
+         ">= 1 plus every P/R (finest operator keeps the input dtype), "
+         "ALL additionally casts the finest level so the whole cycle "
+         "runs reduced",
+         ("COARSE", "ALL"))
 register("amg_host_levels_rows", I, -1, "host levels below this (ignored)")
 register("cycle", S, "V", "", ("V", "W", "F", "CG", "CGF"))
 register("max_levels", I, 100, "maximum number of levels")
@@ -296,11 +329,13 @@ TPU_NA = frozenset({
 # code path either (verified by grep over /root/reference/src+include):
 # kept for config-file compatibility, silently accepted exactly like
 # the reference.  fine_levels is read but its value discarded
-# (agg_selector.cu:283).
+# (agg_selector.cu:283).  max_coarse_iters left this set when
+# coarse_solver=INEXACT made it the inexact coarse-sweep cap
+# (solvers/inexact.py).
 REF_UNREAD = frozenset({
     "GS_L1_variant", "coarseAgenerator_coarse", "coarse_smoother",
     "fine_smoother", "geometric_dim", "initial_color", "jacobi_iters",
-    "max_coarse_iters", "smoother_amg_list", "fine_levels",
+    "smoother_amg_list", "fine_levels",
 })
 
 _warned_na: set = set()

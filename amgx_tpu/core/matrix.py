@@ -271,6 +271,12 @@ class SparseMatrix:
         return self._propagate_structure_memo(new)
 
     def astype(self, dtype) -> "SparseMatrix":
+        if np.dtype(dtype) == np.dtype(self.values.dtype):
+            # identity cast returns SELF: memos (fingerprint, host
+            # CSR) and object identity — which the artifact store
+            # dedups on and the hierarchy cast policy re-applies
+            # idempotently — survive by construction
+            return self
         rep = dict(
             values=self.values.astype(dtype), diag=self.diag.astype(dtype)
         )

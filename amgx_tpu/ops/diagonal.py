@@ -51,8 +51,15 @@ def invert_diag(A):
     if A.block_size == 1:
         with np.errstate(divide="ignore"):
             inv = np.where(d != 0, 1.0 / d, 1.0)
-        return jnp.asarray(inv)
+        # numpy promotes extension dtypes (bfloat16) against python
+        # floats — the smoother state must stay in the LEVEL dtype or
+        # every reduced-precision sweep silently upcasts
+        return jnp.asarray(inv.astype(d.dtype, copy=False))
     b = A.block_size
+    if d.dtype.itemsize < 4:
+        # LAPACK has no sub-f32 factorizations (and numpy would hand
+        # back f64): invert in f32, return in the level dtype
+        d = d.astype(np.float32)
     eye = np.eye(b, dtype=d.dtype)
     zero = ~d.reshape(d.shape[0], -1).any(axis=1)
     safe = d.copy()
@@ -72,7 +79,9 @@ def invert_diag(A):
     )
     if bad.any():
         inv[bad] = eye
-    return jnp.asarray(inv)
+    return jnp.asarray(
+        inv.astype(np.asarray(A.diag).dtype, copy=False)
+    )
 
 
 def invert_diag_jnp(A):
@@ -81,8 +90,15 @@ def invert_diag_jnp(A):
     (serve batched params)."""
     d = A.diag
     if A.block_size == 1:
-        return jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 1.0)
+        return jnp.where(
+            d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 1.0
+        ).astype(d.dtype)
     b = A.block_size
+    out_dt = d.dtype
+    if jnp.dtype(d.dtype).itemsize < 4:
+        # jnp.linalg.inv has no sub-f32 kernel: invert in f32, return
+        # in the level dtype (mirrors the host builder)
+        d = d.astype(jnp.float32)
     eye = jnp.eye(b, dtype=d.dtype)
     zero = ~jnp.any(
         d.reshape(d.shape[0], -1) != 0, axis=1
@@ -92,7 +108,7 @@ def invert_diag_jnp(A):
     bad = ~jnp.all(
         jnp.isfinite(inv.reshape(inv.shape[0], -1)), axis=1
     )
-    return jnp.where(bad[:, None, None], eye, inv)
+    return jnp.where(bad[:, None, None], eye, inv).astype(out_dt)
 
 
 def apply_dinv(dinv, r, block_size):

@@ -30,6 +30,40 @@ _SPLITTER = 4097.0  # 2^12 + 1 for f32 (Veltkamp)
 # the EFT identities are computed as written; it moves no data.
 
 
+def _register_barrier_batch_rule():
+    """This jax version ships no vmap batching rule for
+    ``optimization_barrier`` (added upstream later), which breaks the
+    vmapped serve path of refinement-wrapped solvers (the per-instance
+    iteration runs these EFTs under ``jax.vmap``).  The barrier is an
+    operand-wise identity, so the rule binds it over the batched
+    operands with the batch dims unchanged.  Guarded: if jax moves the
+    primitive, vmapping simply keeps raising NotImplementedError and
+    the serve layer falls back to sequential solves."""
+    try:
+        from jax.interpreters import batching
+        import jax._src.lax.lax as _lax_src
+
+        p = getattr(_lax_src, "optimization_barrier_p", None)
+        if p is None or p in batching.primitive_batchers:
+            return
+
+        def rule(args, dims, **kw):
+            outs = p.bind(*args, **kw)
+            if not isinstance(outs, (list, tuple)):
+                outs = (outs,)
+            out_dims = (
+                dims if isinstance(dims, (list, tuple)) else (dims,)
+            )
+            return outs, out_dims
+
+        batching.primitive_batchers[p] = rule
+    except Exception:  # noqa: BLE001 — jax internals moved
+        pass
+
+
+_register_barrier_batch_rule()
+
+
 def two_sum(a, b):
     """s + e == a + b exactly (Knuth)."""
     s = lax.optimization_barrier(a + b)

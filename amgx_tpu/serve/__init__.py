@@ -38,6 +38,7 @@ from amgx_tpu.serve.batched import make_batched_solve
 from amgx_tpu.serve.cache import HierarchyCache, config_hash
 from amgx_tpu.serve.metrics import ServeMetrics
 from amgx_tpu.serve.service import (
+    CHEAP_PRECONDITIONER_CONFIG,
     COMM_AVOIDING_CONFIG,
     DEFAULT_CONFIG,
     BatchedSolveService,
@@ -70,6 +71,7 @@ __all__ = [
     "SolveService",
     "DEFAULT_CONFIG",
     "COMM_AVOIDING_CONFIG",
+    "CHEAP_PRECONDITIONER_CONFIG",
     "SolveTicket",
     "SolveGateway",
     "GatewayTicket",

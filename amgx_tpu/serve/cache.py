@@ -176,6 +176,36 @@ class HierarchyCache:
         self._notify_evict(evicted)
         return entry
 
+    def bytes_by_dtype(self) -> dict:
+        """Resident bytes of every cached hierarchy entry, summed per
+        array dtype (``{"float32": n, "float64": m, "int32": k, ...}``)
+        — the observability surface of the mixed-precision policy: a
+        ``hierarchy_dtype=FLOAT32`` hierarchy's halved value bytes show
+        up as mass moving from the float64 to the float32 family
+        (``amgx_cache_hierarchy_bytes{dtype=...}``).  Leaves shared
+        between the template solver's params and the batch template
+        (object-identity aliasing, exactly what the store dedups on)
+        count once."""
+        import jax
+        import numpy as np
+
+        with self._lock:
+            entries = list(self._entries.values())
+        out: dict = {}
+        seen: set = set()
+        for e in entries:
+            roots = [getattr(e.solver, "_params", None), e.template]
+            for leaf in jax.tree_util.tree_leaves(roots):
+                if not hasattr(leaf, "nbytes") or id(leaf) in seen:
+                    continue
+                seen.add(id(leaf))
+                try:
+                    key = str(np.dtype(leaf.dtype))
+                except Exception:  # noqa: BLE001 — exotic leaf
+                    key = "other"
+                out[key] = out.get(key, 0) + int(leaf.nbytes)
+        return out
+
     def clear(self):
         with self._lock:
             self._entries.clear()

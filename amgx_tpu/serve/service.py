@@ -196,6 +196,37 @@ COMM_AVOIDING_CONFIG = (
     ' "monitor_residual": 0}}}'
 )
 
+# the cheap-preconditioner configuration (doc/PERFORMANCE.md "Run the
+# preconditioner cheap"): the whole AMG hierarchy runs in f32
+# (hierarchy_dtype=FLOAT32, level_dtype_policy=ALL — half the
+# bandwidth-bound HBM bytes per cycle) and bottoms out in an INEXACT
+# iterative coarse solve (no O(n^3) DenseLU factorization, no dense
+# factors in the store), wrapped in ITERATIVE_REFINEMENT's f64 outer
+# residual correction so the FINAL tolerance is unchanged.  The
+# precision_fallback guardrail re-solves once at full precision if
+# the cheap path fails to converge.  ci/precision_bench.py gates
+# retired-iteration parity (+10% inner-step equivalents) against the
+# f64/DenseLU baseline.
+CHEAP_PRECONDITIONER_CONFIG = (
+    '{"config_version": 2, "solver": {"scope": "main",'
+    ' "solver": "ITERATIVE_REFINEMENT", "max_iters": 40,'
+    ' "tolerance": 1e-8, "monitor_residual": 1,'
+    ' "convergence": "RELATIVE_INI", "precision_fallback": 1,'
+    ' "preconditioner": {"scope": "inner", "solver": "PCG",'
+    ' "max_iters": 8, "monitor_residual": 0,'
+    ' "preconditioner": {"scope": "amg", "solver": "AMG",'
+    ' "algorithm": "AGGREGATION", "selector": "SIZE_8",'
+    ' "hierarchy_dtype": "FLOAT32", "level_dtype_policy": "ALL",'
+    ' "smoother": {"scope": "sm", "solver": "OPT_POLYNOMIAL",'
+    ' "chebyshev_polynomial_order": 2, "monitor_residual": 0},'
+    ' "presweeps": 1, "postsweeps": 1, "max_iters": 1,'
+    ' "min_coarse_rows": 32, "max_levels": 10,'
+    ' "structure_reuse_levels": -1,'
+    ' "coarse_solver": "INEXACT",'
+    ' "inexact_coarse_solver": "OPT_POLYNOMIAL", "cycle": "V",'
+    ' "monitor_residual": 0}}}}'
+)
+
 
 # process-wide single-worker device-dispatch stage: ship-and-launch of
 # batched groups serializes here (device_put + async XLA dispatch, no
@@ -908,8 +939,15 @@ class BatchedSolveService:
 
     def telemetry_snapshot(self) -> dict:
         """Registry source (kind="serve"): the full metrics snapshot —
-        counters, caches, latency/lane reservoirs, phase profile."""
-        return self.metrics.snapshot()
+        counters, caches, latency/lane reservoirs, phase profile —
+        plus the hierarchy cache's resident bytes by dtype (the
+        mixed-precision halved-bytes observability)."""
+        snap = self.metrics.snapshot()
+        try:
+            snap["hierarchy_bytes"] = self.cache.bytes_by_dtype()
+        except Exception:  # noqa: BLE001 — telemetry never fails
+            pass
+        return snap
 
     def _flight_record(self, **fields):
         """Record one solve into the flight recorder, degrading any

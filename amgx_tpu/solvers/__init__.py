@@ -29,6 +29,7 @@ from amgx_tpu.solvers import (  # noqa: F401
     gmres,
     gs,
     idr,
+    inexact,
     jacobi,
     kaczmarz,
     krylov,

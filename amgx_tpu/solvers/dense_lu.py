@@ -64,6 +64,11 @@ class DenseLUSolver(Solver):
             dense = dense.copy()
             dense[-1, :] = 0.0
             dense[:, -1] = 0.0
+        if dense.dtype.itemsize < 4:
+            # sub-f32 hierarchies (hierarchy_dtype=BFLOAT16): LAPACK
+            # has no bf16/f16 factorization — factor in f32; the cycle
+            # casts the correction back to the level dtype
+            dense = dense.astype(np.float32)
         self._pinv_mode = False
         lu, piv = jax.scipy.linalg.lu_factor(jnp.asarray(dense))
         if _bad_pivots(lu):
@@ -93,6 +98,22 @@ class DenseLUSolver(Solver):
             return
         self._params = (A, lu, piv)
 
+    # ------------------------------------------------------------------
+    # setup persistence (amgx_tpu.store): the factors ARE this solver's
+    # setup — persisting them makes restore skip the O(n^3)
+    # refactorization (and makes the dense-factor store bytes the
+    # coarse_solver=INEXACT comparison measures explicit).
+
+    def _export_impl(self):
+        _, fac, piv = self._params
+        return {"fac": fac, "piv": piv, "pinv": bool(self._pinv_mode)}
+
+    def _import_impl(self, impl):
+        if not impl or impl.get("fac") is None:
+            return self._setup_impl(self.A)
+        self._pinv_mode = bool(impl.get("pinv"))
+        self._params = (self.A, impl["fac"], impl["piv"])
+
     def make_batch_params(self):
         if self._pinv_mode:
             # the traced rebuild refactorizes with plain LU, which is
@@ -112,6 +133,9 @@ class DenseLUSolver(Solver):
                     .at[A.row_ids, A.col_indices]
                     .add(A.values)
                 )
+            if dense.dtype.itemsize < 4:
+                # same sub-f32 upcast as _setup_impl (no bf16 LAPACK)
+                dense = dense.astype(jnp.float32)
             lu, piv = jax.scipy.linalg.lu_factor(dense)
             return A, lu, piv
 

@@ -54,10 +54,35 @@ class IterativeRefinementSolver(Solver):
                 "ITERATIVE_REFINEMENT needs an inner solver under "
                 "'preconditioner' (NOSOLVER is not one)"
             )
+        # cheap-preconditioner accuracy envelope (ROADMAP items 3/4):
+        # when the inner solver runs a reduced-precision hierarchy
+        # (hierarchy_dtype != SAME anywhere in the config), a tripped
+        # guardrail — non-SUCCESS status, or more outer corrections
+        # than refine_iteration_guard — re-solves once at full
+        # precision.  Counted in precision_fallbacks; ci gates the
+        # trip-and-recover path (ci/precision_bench.py).
+        self.precision_fallback = bool(
+            cfg.get("precision_fallback", scope)
+        )
+        self.iteration_guard = int(
+            cfg.get("refine_iteration_guard", scope)
+        )
+        self.precision_fallbacks = 0
+        self._fallback_solver = None
+        # retired inner iterations (inner-step equivalents) of the
+        # LAST solve() — the parity currency of ci/precision_bench.py
+        self.last_inner_iters = 0
 
     def _setup_impl(self, A):
         self.inner.setup(A)
         self._params = (A, self.inner.apply_params())
+
+    def _resetup_impl(self, A) -> bool:
+        """Values-only refresh: delegate to the inner solver (which
+        falls back to its own full setup when it has no fast path)."""
+        self.inner.resetup(A)
+        self._params = (A, self.inner.apply_params())
+        return True
 
     def _export_impl(self):
         # persistence (amgx_tpu.store): recurse into the inner solver
@@ -77,10 +102,41 @@ class IterativeRefinementSolver(Solver):
         pair = self._make_solve_pair()
 
         def solve(params, b, x0):
-            res, xl = pair(params, b, x0)
+            res, xl, _inner = pair(params, b, x0)
             return dataclasses.replace(res, x=res.x + xl)
 
         return solve
+
+    # -- iteration protocol (serve batching) ----------------------------
+    # One "iteration" = one outer correction: float-float residual,
+    # inner solve of the correction, error-free accumulate.  Exposing
+    # it lets the vmapped serve loop (serve/batched._instance_protocol)
+    # batch refinement-wrapped cheap configs like any Krylov solver —
+    # extra = (residual estimate, low part of x).
+
+    def _make_init(self):
+        def init(params, b, x0):
+            A, _ip = params
+            xl = jnp.zeros_like(x0)
+            rh, rl = ffm.ff_residual(A, ffm.ff(b), (x0, xl))
+            return (rh + rl, xl)
+
+        return init
+
+    def _make_iter(self):
+        inner_solve = self.inner.make_solve()
+
+        def iterate(params, b, x, extra):
+            A, ip = params
+            _r, xl = extra
+            b_ff = ffm.ff(b)
+            rh, _rl = ffm.ff_residual(A, b_ff, (x, xl))
+            d = inner_solve(ip, rh, jnp.zeros_like(rh))
+            xh, xl = ffm.ff_add((x, xl), ffm.ff(d.x))
+            r2h, r2l = ffm.ff_residual(A, b_ff, (xh, xl))
+            return xh, (r2h + r2l, xl)
+
+        return iterate
 
     def _make_solve_pair(self):
         inner_solve = self.inner.make_solve()
@@ -104,7 +160,7 @@ class IterativeRefinementSolver(Solver):
             done0 = conv_check(nrm0, nrm0, nrm0) | jnp.all(nrm0 == 0)
 
             def body(c):
-                it, xh, xl, nrm, mx, hist, done = c
+                it, xh, xl, nrm, mx, hist, done, inner_tot = c
                 # NOTE: the residual is recomputed here rather than
                 # carried from the previous iteration's norm pass —
                 # carrying the pair through the while_loop carry lets
@@ -119,7 +175,12 @@ class IterativeRefinementSolver(Solver):
                 mx = jnp.maximum(mx, nrm)
                 hist = hist.at[it + 1, 0].set(nrm[0])
                 done = conv_check(nrm, nrm0, mx) | jnp.all(nrm == 0)
-                return (it + 1, xh, xl, nrm, mx, hist, done)
+                # retired-iteration accounting: the sum of the inner
+                # solver's iteration counts is the parity currency the
+                # cheap-preconditioner CI gate compares against the
+                # f64 baseline's monitored iterations
+                inner_tot = inner_tot + res.iters
+                return (it + 1, xh, xl, nrm, mx, hist, done, inner_tot)
 
             def cond(c):
                 it, done = c[0], c[6]
@@ -127,11 +188,11 @@ class IterativeRefinementSolver(Solver):
 
             c0 = (
                 jnp.int32(0), x0h, jnp.zeros_like(x0h), nrm0, nrm0,
-                hist, done0,
+                hist, done0, jnp.int32(0),
             )
-            it, xh, xl, nrm, _mx, hist, done = jax.lax.while_loop(
-                cond, body, c0
-            )
+            (
+                it, xh, xl, nrm, _mx, hist, done, inner_tot
+            ) = jax.lax.while_loop(cond, body, c0)
             return (
                 SolveResult(
                     x=xh,
@@ -144,6 +205,7 @@ class IterativeRefinementSolver(Solver):
                     history=hist,
                 ),
                 xl,
+                inner_tot,
             )
 
         return solve
@@ -154,9 +216,16 @@ class IterativeRefinementSolver(Solver):
         when the device works in f32.  Mirrors the base solve's
         scaling/stats handling (base.py Solver.solve).  ``block`` is
         accepted for interface parity with the base async mode but
-        ignored: the host-side hi/lo combine forces a sync anyway."""
+        ignored: the host-side hi/lo combine forces a sync anyway.
+
+        Precision guardrail (cheap-preconditioner envelope): with a
+        reduced-precision inner hierarchy, a non-SUCCESS status — or
+        more outer corrections than ``refine_iteration_guard`` —
+        re-solves once on an ``hierarchy_dtype=SAME`` fallback solver
+        (``precision_fallbacks`` counts the trips)."""
         if self.A is None:
             raise RuntimeError("solve() before setup()")
+        raw_b, raw_x0 = b, x0
         b = jnp.asarray(b)
         x0 = (
             jnp.zeros_like(b)
@@ -173,11 +242,95 @@ class IterativeRefinementSolver(Solver):
             fn = jax.jit(self._make_solve_pair())
             self._jit_cache[key] = fn
         t0 = time.perf_counter()
-        res, xl = fn(self.apply_params(), b, x0)
+        res, xl, inner_tot = fn(self.apply_params(), b, x0)
+        scale = getattr(self.inner, "iterations_scale", 1)
+        self.last_inner_iters = int(inner_tot) * int(scale)
+        if self._guardrail_tripped(res):
+            return self._solve_f64_fallback(
+                raw_b, raw_x0, zero_initial_guess, t0
+            )
         x64 = np.asarray(res.x, np.float64) + np.asarray(xl, np.float64)
         if self._scale_vecs is not None:
             x64 = x64 * np.asarray(self._scale_vecs[1], np.float64)
         res = dataclasses.replace(res, x=x64)
+        self.solve_time = time.perf_counter() - t0
+        if self.print_solve_stats:
+            self._print_stats(res)
+        return res
+
+    # ------------------------------------------------------------------
+    # precision-fallback guardrail
+
+    def _reduced_precision_config(self) -> bool:
+        """Does the SET-UP inner solver actually hold hierarchy values
+        at a different (reduced) dtype than the operator?  Checked
+        against the built levels, not the config spelling — an
+        explicit ``hierarchy_dtype=FLOAT64`` on an f64 operator (or
+        F32 on an f32-native one) is a no-op cast, and a fallback
+        re-solve on a bitwise-equivalent twin would just double setup
+        time and memory.  The guardrail is inert in those cases."""
+        if self.A is None:
+            return False
+        base = np.dtype(self.A.values.dtype)
+        stack, seen = [self.inner], set()
+        while stack:
+            s = stack.pop()
+            if s is None or id(s) in seen:
+                continue
+            seen.add(id(s))
+            stack.append(getattr(s, "precond", None))
+            stack.append(getattr(s, "inner", None))
+            for lvl in getattr(s, "levels", ()):
+                for m in (lvl.A, lvl.P, lvl.R):
+                    if m is not None and np.dtype(
+                        m.values.dtype
+                    ) != base:
+                        return True
+        return False
+
+    def _guardrail_tripped(self, res) -> bool:
+        if not self.precision_fallback:
+            return False
+        if not self._reduced_precision_config():
+            return False
+        if int(res.status) != SUCCESS:
+            return True
+        return (
+            self.iteration_guard > 0
+            and int(res.iters) > self.iteration_guard
+        )
+
+    def _make_fallback_solver(self):
+        """Same config, hierarchy_dtype forced to SAME in every scope
+        that sets it — the full-precision twin the guardrail re-solves
+        on.  Set up ONCE on this solver's (already scaled/reordered)
+        operator; the solve-boundary vectors are shared so b/x0 take
+        the same path they took here."""
+        from amgx_tpu.config.amg_config import AMGConfig
+
+        cfg2 = AMGConfig.from_state(self.cfg.to_state())
+        for (scope, name) in list(cfg2.items()):
+            if name == "hierarchy_dtype":
+                cfg2.set("hierarchy_dtype", "SAME", scope)
+            if name == "precision_fallback":
+                cfg2.set("precision_fallback", 0, scope)
+        cfg2.set("precision_fallback", 0)
+        fb = type(self)(cfg2, self.scope)
+        fb.scaling = "NONE"
+        fb.reordering = "NONE"
+        fb.setup(self.A)
+        fb._scale_vecs = self._scale_vecs
+        fb._reorder = self._reorder
+        return fb
+
+    def _solve_f64_fallback(self, raw_b, raw_x0, zero_guess, t0):
+        self.precision_fallbacks += 1
+        if self._fallback_solver is None:
+            self._fallback_solver = self._make_fallback_solver()
+        res = self._fallback_solver.solve(
+            raw_b, x0=raw_x0, zero_initial_guess=zero_guess
+        )
+        self.last_inner_iters = self._fallback_solver.last_inner_iters
         self.solve_time = time.perf_counter() - t0
         if self.print_solve_stats:
             self._print_stats(res)
@@ -190,3 +343,23 @@ class IterativeRefinementSolver(Solver):
             return solve(params, r, jnp.zeros_like(r)).x
 
         return apply
+
+    def make_batch_params(self):
+        """Traced values-only rebuild: the operator swaps values and
+        the inner solver rebuilds through its own batch params — so a
+        refinement-wrapped cheap config rides the vmapped serve path
+        (with the iteration protocol above) instead of the sequential
+        fallback."""
+        if self.A is None or self.A.block_size != 1:
+            return None
+        sub = self.inner.make_batch_params()
+        if sub is None:
+            return None
+        itmpl, ifn = sub
+        A0 = self._params[0]
+
+        def fn(t, v):
+            At, it = t
+            return At.replace_values(v), ifn(it, v)
+
+        return (A0, itmpl), fn

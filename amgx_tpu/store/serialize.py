@@ -100,14 +100,23 @@ def flatten(tree):
             idx = len(seen)
             seen[id(obj)] = idx
             keepalive.append(obj)
-            if isinstance(obj, np.ndarray):
+            if isinstance(obj, (np.ndarray, jax.Array)):
                 key = f"a{len(arrays)}"
                 arrays[key] = obj
-                node = {"t": "arr", "k": key, "host": True}
-            elif isinstance(obj, jax.Array):
-                key = f"a{len(arrays)}"
-                arrays[key] = obj
-                node = {"t": "arr", "k": key, "host": False}
+                node = {
+                    "t": "arr", "k": key,
+                    "host": isinstance(obj, np.ndarray),
+                }
+                dt = np.dtype(obj.dtype)
+                if dt.kind == "V":
+                    # extension dtypes (ml_dtypes bfloat16 — mixed-
+                    # precision hierarchies): npz round-trips them as
+                    # raw void bytes, losing the dtype, so the spec
+                    # records it and materialize/readers reinterpret
+                    # through a same-width uint view.  Optional key —
+                    # pre-policy payloads never carry it, so schema v1
+                    # stays valid
+                    node["dt"] = str(dt)
             elif isinstance(obj, SparseMatrix):
                 node = _smat_spec(obj, rec)
             elif isinstance(obj, RAPPlan):
@@ -227,13 +236,23 @@ def unflatten(spec, arrays):
     from amgx_tpu.core.matrix import SparseMatrix
     from amgx_tpu.core.types import ViewType
 
-    def get_array(key):
+    def get_array(key, dt=None):
         try:
-            return np.asarray(arrays[key])
+            a = np.asarray(arrays[key])
         except KeyError:
             raise StoreError(
                 f"payload is missing array {key!r}"
             ) from None
+        if dt:
+            # extension-dtype reinterpretation (see flatten's "dt" tag)
+            try:
+                a = a.view(np.dtype(dt))
+            except (TypeError, ValueError) as e:
+                raise StoreError(
+                    f"payload array {key!r} does not reinterpret as "
+                    f"{dt!r}: {e}"
+                ) from e
+        return a
 
     # ---- pass 0: index def nodes so refs resolve anywhere ------------
     def_nodes: dict = {}
@@ -268,7 +287,7 @@ def unflatten(spec, arrays):
             plan(sp.get("n"))
         elif t == "arr":
             if not sp.get("host"):
-                want_dev(sp, get_array(sp.get("k")))
+                want_dev(sp, get_array(sp.get("k"), sp.get("dt")))
         elif t in ("tuple", "list"):
             for v in sp.get("items", ()):
                 plan(v)
@@ -299,7 +318,7 @@ def unflatten(spec, arrays):
             raise StoreError(
                 f"smat rehydration needs persisted {name!r}"
             )
-        return get_array(fsp.get("k"))
+        return get_array(fsp.get("k"), fsp.get("dt"))
 
     def _plan_smat(sp):
         st = sp.get("static") or {}
@@ -381,7 +400,7 @@ def unflatten(spec, arrays):
                 # out zero-copy views into the WHOLE payload blob, and
                 # a long-lived holder (a warm-booted PaddedPattern)
                 # would otherwise pin every byte of it in host memory
-                return np.array(get_array(sp["k"]))
+                return np.array(get_array(sp["k"], sp.get("dt")))
             return dev_of(sp)
         if t == "tuple":
             return tuple(rec(v) for v in sp["items"])
@@ -440,8 +459,17 @@ def unflatten(spec, arrays):
 
 
 def materialize(arrays: dict) -> dict:
-    """Device arrays -> host numpy (the one sync point of a save)."""
-    return {k: np.asarray(v) for k, v in arrays.items()}
+    """Device arrays -> host numpy (the one sync point of a save).
+    Extension dtypes (bfloat16) are stored through a same-width uint
+    view — npz would silently degrade them to raw void bytes — and
+    reinterpreted on read via the spec's "dt" tag."""
+    out = {}
+    for k, v in arrays.items():
+        a = np.asarray(v)
+        if a.dtype.kind == "V" and a.dtype.names is None:
+            a = a.view(np.dtype(f"u{a.dtype.itemsize}"))
+        out[k] = a
+    return out
 
 
 # ---------------------------------------------------------------------------

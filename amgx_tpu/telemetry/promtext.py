@@ -135,6 +135,7 @@ _CACHE_RENAME = {
 _SERVE_SKIP = {
     "buckets", "latency", "lanes", "profile",
     "ticket_p50_s", "ticket_p99_s", "tenant_device_s",
+    "hierarchy_bytes",
 }
 
 
@@ -195,6 +196,13 @@ def serve_families(fams: FamilyTable, comp: str, snap: dict) -> None:
             else:
                 fams.add(f"amgx_serve_{k}", "gauge",
                          f"serve derived gauge {k}", labels, v)
+    for dt, nb in (snap.get("hierarchy_bytes") or {}).items():
+        fams.add("amgx_cache_hierarchy_bytes", "gauge",
+                 "resident hierarchy-cache bytes by array dtype "
+                 "(mixed-precision policy observability: a "
+                 "hierarchy_dtype=FLOAT32 hierarchy moves value "
+                 "bytes from the float64 to the float32 family)",
+                 {**labels, "dtype": dt}, nb)
     for stage, summ in (snap.get("latency") or {}).items():
         _quantile_samples(
             fams, "amgx_serve_ticket_latency_seconds",

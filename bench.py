@@ -445,6 +445,36 @@ def _sstep_record():
         return {"error": str(e)}
 
 
+def _precision_record():
+    """Cheap preconditioner (PR 13): retired-iteration parity of the
+    f64-refined mixed-precision / INEXACT-coarse configs and the
+    measured coarse-setup + store-bytes reductions
+    (ci/precision_bench.py, reduced matrices).  Guarded — must never
+    take the headline bench down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.precision_bench import run as precision_run
+
+        rec, problems = precision_run(small=True)
+        out = {
+            "coarse_setup_speedup": rec["value"],
+            "store_bytes_ratio": rec["store_bytes_ratio"],
+            "parity": rec["parity"],
+            "coarse_cost": rec["coarse_cost"],
+            "fallback": rec["fallback"],
+            "ok": rec["ok"],
+        }
+        if problems:
+            out["problems"] = problems
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: precision record skipped: {e}", file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _session_record():
     """Streaming solve sessions (PR 9): steps/s on the implicit-Euler
     sequence vs the naive per-step resubmit baseline and hand-rolled
@@ -722,6 +752,10 @@ def main():
     sstep_rec = _sstep_record()
     print(f"bench: sstep {sstep_rec}", file=sys.stderr)
 
+    # ---- cheap preconditioner (mixed precision + inexact coarse) ---
+    precision_rec = _precision_record()
+    print(f"bench: precision {precision_rec}", file=sys.stderr)
+
     # ---- streaming solve sessions ----------------------------------
     session_rec = _session_record()
     print(f"bench: session {session_rec}", file=sys.stderr)
@@ -757,6 +791,7 @@ def main():
                 "setup": setup_rec,
                 "telemetry": telemetry_rec,
                 "sstep": sstep_rec,
+                "precision": precision_rec,
                 "session": session_rec,
                 "mesh": mesh_rec,
                 "resilience": resilience_rec,

@@ -4,12 +4,14 @@ the overhead ceiling.
 Prints ONE JSON line (same contract as the other ci/ gates) and exits
 non-zero when:
 
-* the Prometheus exposition fails to parse, exports fewer than 34
+* the Prometheus exposition fails to parse, exports fewer than 35
   distinct metric names, misses one of the required sources
   (serve, gateway/admission, store, cache, setup-phase, solver,
-  session, mesh placement), or misses the PR 8
+  session, mesh placement), misses the PR 8
   communication-observability names
-  (amgx_solver_reductions_total, amgx_solver_iterations_bucket);
+  (amgx_solver_reductions_total, amgx_solver_iterations_bucket), or
+  misses amgx_cache_hierarchy_bytes (mixed-precision resident-bytes
+  observability, PR 13);
 * a sampled gateway request does not produce a CONNECTED
   submit -> admission -> pad -> dispatch -> device -> fetch span
   chain in the exported Chrome trace JSON;
@@ -185,9 +187,9 @@ def _validate_observability(problems, store_dir):
                 problems.append(f"unparseable exposition line: {line!r}")
                 break
             names.add(m.group(1))
-        if len(names) < 34:
+        if len(names) < 35:
             problems.append(
-                f"only {len(names)} metric names exported (floor 34)"
+                f"only {len(names)} metric names exported (floor 35)"
             )
         for prefix in ("amgx_serve_", "amgx_gateway_", "amgx_store_",
                        "amgx_cache_", "amgx_setup_phase_",
@@ -201,6 +203,11 @@ def _validate_observability(problems, store_dir):
                     f"required metric {required} missing (PR 8 "
                     "communication observability)"
                 )
+        if "amgx_cache_hierarchy_bytes" not in names:
+            problems.append(
+                "required metric amgx_cache_hierarchy_bytes missing "
+                "(mixed-precision resident-bytes observability)"
+            )
 
         # ---- chrome trace ----------------------------------------
         trace = tracing.export_chrome()

@@ -55,6 +55,17 @@ JAX_PLATFORMS=cpu python ci/store_bench.py
 # one host->device transfer batch per hierarchy.
 JAX_PLATFORMS=cpu python ci/setup_bench.py
 
+# ---- cheap preconditioner: precision + inexact-coarse gates ----------
+# One JSON line; non-zero exit when the f64-refined mixed-precision or
+# INEXACT-coarse configs need more than +10% retired inner-step
+# equivalents over the f64/DenseLU baseline at unchanged final
+# tolerance, when coarse_solver=INEXACT fails the measured
+# setup:coarse_factor (2x) or store-bytes (3x) reduction floors on the
+# large-coarse-level problem, or when a tripped
+# refine_iteration_guard does not produce exactly one counted,
+# converging f64 fallback.
+JAX_PLATFORMS=cpu python ci/precision_bench.py
+
 # ---- communication-free inner loops: parity + reduction gates --------
 # One JSON line; non-zero exit when OPT_POLYNOMIAL or SSTEP_PCG needs
 # more than +10% iterations (inner-CG-step equivalents, +s-1 s-step
