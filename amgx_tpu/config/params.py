@@ -61,6 +61,16 @@ register("matrix_halo_exchange", I, 0, "halo exchange depth on lower levels")
 register("boundary_coloring", S, "SYNC_COLORS", "ILU boundary coloring")
 register("halo_coloring", S, "LAST", "ILU halo coloring")
 register("use_sum_stopping_criteria", I, 0, "sum rows across ranks for stop")
+register("dist_coarse_sparsify", F, 0.0,
+         "communication-reduced coarse grids (TPU distributed path): "
+         "drop cross-shard coarse-level Galerkin entries with "
+         "|a_ij| < theta*sqrt(|a_ii a_jj|) diagonal-lumped, capping "
+         "halo width on coarse levels (stencil sparsification, "
+         "arxiv 1512.04629); 0 disables")
+register("dist_sparsify_from_level", I, 1,
+         "first hierarchy level dist_coarse_sparsify applies to: "
+         "spare the strongest-coupled first coarse levels, trim the "
+         "deep ones where per-exchange latency dominates")
 register("rhs_from_a", I, 0, "reader: synthesize rhs from A")
 register("complex_conversion", I, 0, "reader: convert complex system")
 register("matrix_writer", S, "matrixmarket", "", ("matrixmarket", "binary"))

@@ -1,4 +1,8 @@
 from amgx_tpu.core.types import Mode, ViewType, mode_from_name
 from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.core.rowsharded import RowShardedMatrix, row_shard_rules
 
-__all__ = ["Mode", "ViewType", "mode_from_name", "SparseMatrix"]
+__all__ = [
+    "Mode", "ViewType", "mode_from_name", "SparseMatrix",
+    "RowShardedMatrix", "row_shard_rules",
+]

@@ -1,0 +1,109 @@
+"""SPMD sharding compatibility layer.
+
+The distributed row-decomposition path (amgx_tpu.distributed) and the
+mesh serve placement both trace ``shard_map`` programs.  JAX moved
+``shard_map`` from ``jax.experimental.shard_map`` to top-level
+``jax.shard_map`` (and introduced ``jax.lax.pvary`` for the
+varying-manual-axes typing the new implementation requires); this repo
+must run on both sides of that move — the env-dependent tier-1
+failures of the seed's distributed tests were exactly this API drift.
+Everything SPMD in the repo funnels through this module so the
+fallback logic exists once.
+
+``shard_map`` here is keyword-compatible with both APIs and usable
+either directly or via ``functools.partial(shard_map, mesh=..., ...)``
+(the decorator shape the distributed solvers use).  ``pvary`` degrades
+to identity on versions without varying-axes typing — the old
+``shard_map`` does not track device variance, so marking is a no-op
+there by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _resolve_shard_map():
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm, True
+    from jax.experimental.shard_map import shard_map as sm  # type: ignore
+
+    return sm, False
+
+
+_SHARD_MAP, _IS_NEW_API = _resolve_shard_map()
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_rep=False):
+    """Version-stable ``shard_map``.
+
+    ``check_rep=False`` (the repo-wide default): replicated out_specs
+    (``P()``) in the distributed solve loops come from ``psum``'d
+    scalars that the OLD tracer cannot prove replicated; the new API
+    dropped the flag entirely (it types variance instead)."""
+    if f is None:
+        return functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_rep,
+        )
+    if _IS_NEW_API:
+        return _SHARD_MAP(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
+    return _SHARD_MAP(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_rep,
+    )
+
+
+def pvary(x, axis_names):
+    """``jax.lax.pvary`` where it exists (the new shard_map's
+    device-varying type marker); identity on versions whose shard_map
+    has no variance typing (nothing to mark)."""
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is None:
+        return x
+    return fn(x, axis_names)
+
+
+def pallas_compiler_params(pltpu_mod, **kw):
+    """TPU pallas compiler-params across the CompilerParams /
+    TPUCompilerParams rename (same fields; the pallas module is passed
+    in so this jax-drift home needs no pallas import itself)."""
+    cls = getattr(pltpu_mod, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu_mod.TPUCompilerParams
+    return cls(**kw)
+
+
+def make_stacked_array(shape, sharding, leaves, dtype):
+    """``jax.make_array_from_single_device_arrays`` across the
+    ``dtype=`` keyword addition: newer jax takes the dtype explicitly
+    (required when a process holds no leaves); older versions infer it
+    from the leaves, so the leaves are cast first to keep the global
+    metadata identical on every process."""
+    import numpy as np
+
+    try:
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, leaves, dtype=np.dtype(dtype)
+        )
+    except TypeError:
+        pass
+    leaves = [
+        leaf if leaf.dtype == np.dtype(dtype)
+        else leaf.astype(np.dtype(dtype))
+        for leaf in leaves
+    ]
+    return jax.make_array_from_single_device_arrays(
+        shape, sharding, leaves
+    )

@@ -12,6 +12,7 @@ from amgx_tpu.distributed.solve import (
     dist_cg,
     dist_pcg_jacobi,
     dist_spmv_replicated_check,
+    halo_site_counter,
 )
 from amgx_tpu.distributed.eigen import (
     dist_inverse_iteration,
@@ -22,6 +23,7 @@ from amgx_tpu.distributed.eigen import (
 __all__ = [
     "DistributedMatrix",
     "partition_matrix",
+    "halo_site_counter",
     "dist_cg",
     "dist_pcg_jacobi",
     "dist_spmv_replicated_check",

@@ -34,6 +34,7 @@ from amgx_tpu.distributed.hierarchy import (
 )
 from amgx_tpu.distributed.solve import (
     _pdot,
+    _pgram,
     _safe_block_inv,
     _shard_params,
     exchange_halo,
@@ -41,6 +42,7 @@ from amgx_tpu.distributed.solve import (
     make_local_spmv,
 )
 from amgx_tpu.core.profiling import named_scope, trace_range
+from amgx_tpu.core.sharding import pvary, shard_map
 
 
 def _level_is_sharded(A) -> bool:
@@ -436,6 +438,8 @@ class DistributedAMG:
                  owner=None, grid=None,
                  grade_lower: int | None = None,
                  block_size: int = 1,
+                 sparsify_theta: float | None = None,
+                 sparsify_from_level: int | None = None,
                  _local=None):
         from amgx_tpu.config.amg_config import AMGConfig
 
@@ -497,6 +501,11 @@ class DistributedAMG:
         self._grid = grid
         self._local = _local
         self.block_size = int(block_size)
+        # explicit kwargs override the cfg knobs (callers like the
+        # serve placement thread the sparsification settings directly
+        # instead of cloning a config blob)
+        self._sparsify_override = sparsify_theta
+        self._sparsify_from_override = sparsify_from_level
         self._setup(Asp)
 
     def _stop_measure(self) -> str:
@@ -605,6 +614,18 @@ class DistributedAMG:
             self.cfg.get("cycle", self.scope)
         ).upper()
         self.cycle_iters = int(self.cfg.get("cycle_iters", self.scope))
+        # communication-reduced coarse grids (dist_coarse_sparsify):
+        # theta for the cross-shard Galerkin drop; 0 keeps exact RAP
+        self.sparsify_theta = float(
+            self.cfg.get("dist_coarse_sparsify", self.scope)
+            if self._sparsify_override is None
+            else self._sparsify_override
+        )
+        self.sparsify_from_level = int(
+            self.cfg.get("dist_sparsify_from_level", self.scope)
+            if self._sparsify_from_override is None
+            else self._sparsify_from_override
+        )
         self._solve_cache = {}
 
         algorithm = str(
@@ -666,6 +687,8 @@ class DistributedAMG:
                     grade_lower=self.grade_lower,
                     mesh=self.mesh,
                     stop_measure=self._stop_measure(),
+                    sparsify_theta=self.sparsify_theta,
+                    sparsify_from_level=self.sparsify_from_level,
                 )
         elif algorithm == "CLASSICAL":
             from amgx_tpu.distributed.classical import (
@@ -685,6 +708,8 @@ class DistributedAMG:
                 consolidate_rows=self.consolidate_rows,
                 grade_lower=self.grade_lower,
                 stop_measure=self._stop_measure(),
+                sparsify_theta=self.sparsify_theta,
+                sparsify_from_level=self.sparsify_from_level,
             )
         self.fine = self.h.levels[0].A
         self._setup_level_smoothers()
@@ -1224,7 +1249,7 @@ class DistributedAMG:
         fine_spmv = make_local_spmv(self.fine, axis)
 
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=self.mesh,
             in_specs=(in_lps, None, P(axis)),
             out_specs=(P(axis), P(), P()),
@@ -1266,6 +1291,122 @@ class DistributedAMG:
 
         return jax.jit(solve_sm), lps
 
+    def _build_solve_sstep(self, max_iters, tol, s):
+        """Distributed s-step PCG outer (reference SSTEP_PCG economics
+        on the row-sharded mesh): s cycle applications and s halo-
+        exchanged SpMVs per outer iteration, but only TWO cross-shard
+        collectives per s steps — ONE psum'd fused Gram block
+        (:func:`amgx_tpu.distributed.solve._pgram`, every inner
+        product of the outer iteration) plus the monitor norm —
+        versus 3 psums per step for monitored PCG.  The scalar
+        recurrences are the serial SSTEP_PCG's (solvers/sstep.py),
+        operating on the replicated Gram matrix, with the SCALED-basis
+        column normalization read off the Gram diagonal (no extra
+        reduction).  ``max_iters`` bounds OUTER iterations (one outer
+        = s inner steps)."""
+        from amgx_tpu.solvers.sstep import _guarded_solve
+
+        axis = self.axis
+        lps = self._traced_level_params()
+        in_lps = jax.tree.map(lambda _: P(axis), lps)
+        cycle = self._make_cycle()
+        fine_spmv = make_local_spmv(self.fine, axis)
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(in_lps, None, P(axis)),
+            out_specs=(P(axis), P(), P()),
+        )
+        def solve_sm(lps_stk, tail_params, b_stk):
+            lps_loc = jax.tree.map(lambda st: st[0], lps_stk)
+            b_loc = b_stk[0]
+            sh0 = lps_loc[0][0]
+            M = lambda r: cycle(lps_loc, tail_params, r)
+            dt = b_loc.dtype
+            nrm0 = jnp.sqrt(_pdot(b_loc, b_loc, axis))
+            x = jnp.zeros_like(b_loc)
+            r = b_loc
+            # previous direction block and its A-image: zero on entry
+            # makes the first A-orthogonalization a no-op exactly;
+            # pvary marks them device-varying (shard-local basis) for
+            # the new shard_map's while_loop carry typing
+            Pr = pvary(jnp.zeros((s,) + b_loc.shape, dt), (axis,))
+            APr = jnp.zeros_like(Pr)
+
+            def cond(c):
+                it, x, r, Pr, APr, nrm = c
+                return (
+                    (it < max_iters) & (nrm >= tol * nrm0) & (nrm0 > 0)
+                )
+
+            def body(c):
+                it, x, r, Pr, APr, nrm = c
+                # -- s-step Krylov block: s SpMVs + s cycle applies --
+                z = M(r)
+                z_rows, az_rows = [z], []
+                for _ in range(s - 1):
+                    az = fine_spmv(sh0, z_rows[-1])
+                    az_rows.append(az)
+                    z_rows.append(M(az))
+                az_rows.append(fine_spmv(sh0, z_rows[-1]))
+                Z = jnp.stack(z_rows)
+                AZ = jnp.stack(az_rows)
+
+                # -- collective 1 of 2: the psum'd fused Gram block --
+                L = jnp.concatenate([Z, Pr, r[None]], axis=0)
+                Rt = jnp.concatenate([AZ, APr, r[None]], axis=0)
+                G = _pgram(L, Rt, axis)  # (2s+1, 2s+1) replicated
+
+                # SCALED basis: normalize columns by their A-norms
+                # from the Gram diagonal — no extra reduction
+                rdt = jnp.zeros((), G.dtype).real.dtype
+                d = jnp.sqrt(jnp.maximum(
+                    jnp.abs(jnp.diagonal(G)[:s].real),
+                    jnp.finfo(rdt).tiny,
+                )).astype(rdt)
+                inv = (1.0 / d).astype(G.dtype)
+                sl = jnp.concatenate(
+                    [inv, jnp.ones((s + 1,), G.dtype)]
+                )
+                G = G * sl[:, None] * sl[None, :]
+                Z = Z * inv[:, None]
+                AZ = AZ * inv[:, None]
+
+                G_ZAZ = G[:s, :s]
+                G_ZAP = G[:s, s:2 * s]
+                G_Zr = G[:s, -1]
+                G_PAZ = G[s:2 * s, :s]
+                W_prev = G[s:2 * s, s:2 * s]
+                G_Pr = G[s:2 * s, -1]
+
+                # scalar recurrences off the replicated Gram matrix
+                # (identical on every shard — SPMD)
+                C = -_guarded_solve(W_prev, G_PAZ).T
+                P_new = Z + C @ Pr
+                AP_new = AZ + C @ APr
+                Cc = jnp.conj(C)
+                W_new = (
+                    G_ZAZ
+                    + G_ZAP @ C.T
+                    + Cc @ (G_PAZ + W_prev @ C.T)
+                )
+                g = G_Zr + Cc @ G_Pr
+                a = _guarded_solve(W_new, g)
+
+                x = x + jnp.tensordot(a, P_new, axes=1)
+                r = r - jnp.tensordot(a, AP_new, axes=1)
+                # -- collective 2 of 2: the monitor norm -------------
+                nrm = jnp.sqrt(_pdot(r, r, axis))
+                return (it + 1, x, r, P_new, AP_new, nrm)
+
+            it, x, r, Pr, APr, nrm = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), x, r, Pr, APr, nrm0)
+            )
+            return x[None], it, nrm
+
+        return jax.jit(solve_sm), lps
+
     def _build_solve_fgmres(self, max_iters, tol, restart):
         """Distributed FGMRES(restart) preconditioned by the AMG cycle
         (reference fgmres_solver.cu; the north-star outer solver).
@@ -1282,7 +1423,7 @@ class DistributedAMG:
         m = restart
 
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=self.mesh,
             in_specs=(in_lps, None, P(axis)),
             out_specs=(P(axis), P(), P()),
@@ -1359,13 +1500,13 @@ class DistributedAMG:
                 # zero initializers as device-varying so the while_loop
                 # carry types match (shard_map vma typing).  Shapes
                 # follow b_loc so block residuals [rows, b] work.
-                V = jax.lax.pvary(
+                V = pvary(
                     jnp.zeros((m + 1,) + b_loc.shape, dt), (axis,)
                 )
                 V = V.at[0].set(
                     r / jnp.where(beta > 0, beta, 1.0)
                 )
-                Z = jax.lax.pvary(
+                Z = pvary(
                     jnp.zeros((m,) + b_loc.shape, dt), (axis,)
                 )
                 H = jnp.zeros((m + 1, m), dt)
@@ -1487,21 +1628,62 @@ class DistributedAMG:
         parts = self.h.comm.allgather(loc, kind="solve-x")
         return np.concatenate(parts)
 
-    def solve(self, b, max_iters=200, tol=1e-8, outer="pcg",
-              restart=32):
-        """Distributed AMG-preconditioned solve -> (x, iters, nrm).
-        ``outer``: 'pcg' (default) or 'fgmres' (the north-star outer,
-        reference FGMRES_AGGREGATION).  Jitted programs are cached per
-        (outer, max_iters, tol, restart)."""
-        key = (outer, max_iters, float(tol), restart)
+    def _resolve_program(self, outer, max_iters, tol, restart,
+                         s_step=None):
+        """The jitted sharded program + traced level params for one
+        (outer, max_iters, tol, restart/s) key, building on miss."""
+        if outer == "sstep":
+            s = int(
+                self.cfg.get("s_step", self.scope)
+                if s_step is None else s_step
+            )
+            s = max(s, 1)
+            key = (outer, max_iters, float(tol), s)
+        else:
+            key = (outer, max_iters, float(tol), restart)
         hit = self._solve_cache.get(key)
         if hit is None:
             if outer == "fgmres":
                 hit = self._build_solve_fgmres(max_iters, tol, restart)
+            elif outer == "sstep":
+                hit = self._build_solve_sstep(max_iters, tol, key[3])
             else:
                 hit = self._build_solve(max_iters, tol)
             self._solve_cache[key] = hit
-        fn, lps = hit
+        return hit
+
+    def solve_device(self, b, max_iters=200, tol=1e-8, outer="pcg",
+                     restart=32, s_step=None):
+        """Async face of :meth:`solve` (the serve placement path):
+        launches the sharded program and returns the DEVICE results
+        ``(x_stacked [N, rows], iters, nrm)`` with NO host sync — the
+        caller (a serve group's lazy ``SolveResult``) owns the one
+        fetch.  Single-process stacked-numpy hierarchies only (the
+        multi-process per-rank path syncs in its gather anyway)."""
+        fn, lps = self._resolve_program(
+            outer, max_iters, tol, restart, s_step
+        )
+        if _level_is_sharded(self.fine):
+            raise NotImplementedError(
+                "solve_device: per-rank sharded assembly gathers at "
+                "unpad; use solve()"
+            )
+        bp = jnp.asarray(self.fine.pad_vector(np.asarray(b)))
+        return fn(lps, self._tail_params, bp)
+
+    def solve(self, b, max_iters=200, tol=1e-8, outer="pcg",
+              restart=32, s_step=None):
+        """Distributed AMG-preconditioned solve -> (x, iters, nrm).
+        ``outer``: 'pcg' (default), 'fgmres' (the north-star outer,
+        reference FGMRES_AGGREGATION), or 'sstep' (communication-
+        avoiding s-step PCG: two collectives per s inner steps via the
+        psum'd fused Gram block; ``s_step`` defaults to the config's
+        ``s_step``, and the returned iteration count is OUTER
+        iterations — multiply by s for inner-step parity).  Jitted
+        programs are cached per (outer, max_iters, tol, restart/s)."""
+        fn, lps = self._resolve_program(
+            outer, max_iters, tol, restart, s_step
+        )
         if _level_is_sharded(self.fine):
             bp = self._pad_vector_sharded(np.asarray(b))
             x, it, nrm = fn(lps, self._tail_params_dev, bp)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from amgx_tpu.core.sharding import shard_map
 from amgx_tpu.distributed.partition import DistributedMatrix
 from amgx_tpu.distributed.solve import (
     _pdot,
@@ -66,7 +67,7 @@ def dist_power_iteration(
     in_shard = jax.tree.map(lambda _: P(axis), shard)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(in_shard, P(axis)),
         out_specs=(P(axis), P(), P(), P()),
@@ -123,7 +124,7 @@ def dist_lanczos(
     m = int(m)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(in_shard, P(axis)),
         out_specs=(P(None, axis), P(), P()),

@@ -246,6 +246,132 @@ def _grade_groups(ncs, grade_lower):
     return lead_of, moff, tuple(perms_down), is_leader
 
 
+def _sparsify_offpart_rows(m, own_c, p, theta, d_own, offcols,
+                           answers_by_o):
+    """Communication-reduced coarse rows (the stencil-sparsification
+    idea of arxiv 1512.04629 / SParSH-AMG's halo trimming): drop WEAK
+    off-part entries of one part's summed Galerkin rows and lump the
+    dropped mass onto the row diagonal.
+
+    The drop test is the strength-of-connection criterion
+    ``|a_ij| < theta * sqrt(|a_ii a_jj|)`` — symmetric by construction
+    (both sides of a cross-part edge evaluate the same quantity, so an
+    entry and its transpose are dropped together and a symmetric
+    operator stays symmetric), and boundary-consistent (the remote
+    diagonal ``a_jj`` was fetched from its owner, not estimated).
+    Diagonal lumping preserves row sums, so the action on the
+    aggregation near-kernel (constants) is exact; only smoothing of
+    oscillatory cross-boundary error weakens, which the outer Krylov
+    absorbs (iteration-parity gated by tests/ci).
+
+    ``m`` is the (owned coarse rows x global coarse cols) CSR;
+    ``offcols`` its sorted unique off-part columns; ``answers_by_o``
+    the fetched diagonals aligned with the per-owner request order.
+    Returns ``(sparsified m, entries dropped)``.
+    """
+    coo = m.tocoo()
+    owners_col = own_c.owner_of(coo.col)
+    offp = owners_col != p
+    if not offp.any():
+        return m, 0
+    g_rows = own_c.global_rows(p)
+    # diagonal magnitude per entry: own rows from d_own; off-part
+    # columns from the owner-fetched map
+    dmap = np.empty(len(offcols), dtype=np.float64)
+    owners_u = own_c.owner_of(offcols)
+    for o, vals in answers_by_o.items():
+        dmap[owners_u == o] = np.abs(np.asarray(vals, dtype=np.float64))
+    dcol = np.empty(coo.col.shape[0], dtype=np.float64)
+    ow = ~offp
+    dcol[ow] = np.abs(
+        np.asarray(d_own, dtype=np.float64)[
+            own_c.local_of_ids(coo.col[ow])
+        ]
+    )
+    dcol[offp] = dmap[np.searchsorted(offcols, coo.col[offp])]
+    drow = np.abs(np.asarray(d_own, dtype=np.float64))[coo.row]
+    weak = offp & (
+        np.abs(coo.data) < theta * np.sqrt(drow * dcol)
+    )
+    n_drop = int(weak.sum())
+    if n_drop == 0:
+        return m, 0
+    lump = np.zeros(m.shape[0], dtype=coo.data.dtype)
+    np.add.at(lump, coo.row[weak], coo.data[weak])
+    keep = ~weak
+    rows = np.concatenate([coo.row[keep], np.arange(m.shape[0])])
+    cols = np.concatenate([coo.col[keep], g_rows])
+    data = np.concatenate([coo.data[keep], lump])
+    m2 = sps.csr_matrix((data, (rows, cols)), shape=m.shape)
+    m2.sum_duplicates()
+    m2.sort_indices()
+    return m2, n_drop
+
+
+def _sparsify_coarse_level(rap, own_c, comm, my_parts, theta):
+    """One comm round + per-part sparsification of the freshly summed
+    coarse Galerkin rows: each part extracts its OWNED coarse diagonal,
+    off-part column diagonals ride an O(boundary) fetch_by_owner round
+    (the same fabric shape as the halo coarse-id fetch), then weak
+    cross-part entries are dropped diagonal-lumped.  Returns
+    ``(total entries dropped, off-part columns before, after)`` — the
+    halo-width evidence for setup_stats/telemetry.
+
+    MUST be called on every process of a multi-process launch even
+    when theta <= 0 is handled by the caller — the fetch round is
+    collective (SPMD round matching).
+    """
+    # owned coarse diagonals (complete: leaders already summed RAP)
+    diag_own = {}
+    for p in my_parts:
+        d = np.zeros(int(own_c.counts[p]), dtype=np.float64)
+        m = rap.get(p)
+        if m is not None:
+            coo = m.tocoo()
+            hit = coo.col == own_c.global_rows(p)[coo.row]
+            np.add.at(d, coo.row[hit], coo.data[hit].real
+                      if np.iscomplexobj(coo.data) else coo.data[hit])
+        diag_own[p] = d
+    requests = {}
+    offcols = {}
+    halo_before = 0
+    for p in my_parts:
+        m = rap.get(p)
+        if m is None:
+            continue
+        cols = m.tocoo().col
+        oc = np.unique(cols[own_c.owner_of(cols) != p])
+        if oc.size == 0:
+            continue
+        offcols[p] = oc
+        halo_before += int(oc.size)
+        owners = own_c.owner_of(oc)
+        requests[p] = {
+            int(o): oc[owners == o] for o in np.unique(owners)
+        }
+    answers = fetch_by_owner(
+        comm,
+        requests,
+        lambda o, ids: diag_own[o][own_c.local_of_ids(ids)],
+        kind="sparsify-diag",
+    )
+    dropped = 0
+    halo_after = 0
+    for p in my_parts:
+        if p not in offcols:
+            continue
+        rap[p], nd = _sparsify_offpart_rows(
+            rap[p], own_c, p, theta, diag_own[p], offcols[p],
+            answers.get(p, {}),
+        )
+        dropped += nd
+        cols = rap[p].tocoo().col
+        halo_after += int(
+            np.unique(cols[own_c.owner_of(cols) != p]).size
+        )
+    return dropped, halo_before, halo_after
+
+
 def _finalize_level(
     parts_by_p: Dict[int, dict],
     own: Ownership,
@@ -394,9 +520,21 @@ def build_distributed_hierarchy_local(
     proc_grid=None,
     mesh=None,
     stop_measure: str = "sum",
+    sparsify_theta: float = 0.0,
+    sparsify_from_level: int = 1,
 ) -> DistHierarchy:
     """The distributed setup loop from per-process local blocks
     (reference per-rank setup_v2, amg.cu:425-660).
+
+    ``sparsify_theta`` > 0 enables communication-reduced coarse grids
+    (``dist_coarse_sparsify``): after each level's Galerkin rows are
+    summed, weak CROSS-PART entries (|a_ij| < theta sqrt|a_ii a_jj|,
+    remote diagonals owner-fetched) are dropped diagonal-lumped before
+    the coarse halo is built — capping the halo width growth that
+    otherwise makes coarse-level exchanges latency-dominated
+    (arxiv 1512.04629's stencil sparsification, SParSH-AMG's halo
+    trimming).  Per-level drop/halo counts land in
+    ``setup_stats["sparsify"]``.
 
     ``local_parts[p]`` is the localized CSR dict of part p
     (``localize_columns``/``local_part_from_rows`` output: owned-first
@@ -422,6 +560,7 @@ def build_distributed_hierarchy_local(
     lvl_parts = init_lvl_parts(local_parts, ownership, my_parts)
     lvl_own: Ownership = ownership
     levels: List[DistLevel] = []
+    sparsify_stats: List[dict] = []
 
     while (
         _stop_rows(lvl_own, stop_measure) > consolidate_rows
@@ -561,6 +700,28 @@ def build_distributed_hierarchy_local(
             if acc is not None:
                 rap[L] = acc
 
+        # 3b. communication-reduced coarse grid: sparsify weak
+        # cross-part couplings of the summed Galerkin rows BEFORE the
+        # coarse halo is derived from them (one O(boundary) diagonal
+        # fetch round — SPMD-matched: theta and the level gate are
+        # replicated config).  ``sparsify_from_level`` spares the
+        # first coarse levels (still bandwidth-dominated, and the
+        # levels where dropped couplings cost convergence most) and
+        # trims the DEEP levels, where per-exchange latency dominates
+        # the tiny payloads — the coarse-level latency wall.
+        if (
+            sparsify_theta > 0.0
+            and len(levels) + 1 >= max(int(sparsify_from_level), 1)
+        ):
+            dropped, hb, ha = _sparsify_coarse_level(
+                rap, own_c, comm, my_parts, float(sparsify_theta)
+            )
+            sparsify_stats.append(
+                dict(level=len(levels) + 1, dropped=int(dropped),
+                     offpart_cols_before=int(hb),
+                     offpart_cols_after=int(ha))
+            )
+
         # 4. owned-first renumber of the coarse level (analytic coarse
         # ownership; halo slots appended per part)
         rows_pp_c = max(int(own_c.counts.max()), 1)
@@ -612,10 +773,13 @@ def build_distributed_hierarchy_local(
         lvl_parts = new_parts
         lvl_own = own_c
 
-    return finish_distributed_hierarchy(
+    h = finish_distributed_hierarchy(
         lvl_parts, lvl_own, comm, levels, proc_grid,
         max_part_nnz, max_part_rows, my_parts, mesh=mesh,
     )
+    if sparsify_stats:
+        h.setup_stats["sparsify"] = sparsify_stats
+    return h
 
 
 def lvl_parts_to_parts(lvl_parts):
@@ -1022,6 +1186,8 @@ def build_distributed_hierarchy(
     consolidate_rows: int = _CONSOLIDATE_ROWS,
     grade_lower: int = _GRADE_LOWER,
     stop_measure: str = "sum",
+    sparsify_theta: float = 0.0,
+    sparsify_from_level: int = 1,
 ) -> DistHierarchy:
     """Single-process convenience wrapper: partition the global matrix
     into local parts, then run the per-process setup loop
@@ -1058,6 +1224,8 @@ def build_distributed_hierarchy(
         grade_lower=grade_lower,
         proc_grid=proc_grid,
         stop_measure=stop_measure,
+        sparsify_theta=sparsify_theta,
+        sparsify_from_level=sparsify_from_level,
     )
     # fine-level pad/unpad convenience for non-contiguous partitions
     # (grid slabs / arbitrary partition vectors): the global-matrix

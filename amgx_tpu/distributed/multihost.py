@@ -289,11 +289,13 @@ def stack_parts_sharded(
         )
         for p in sorted(per_part)
     ]
-    return jax.make_array_from_single_device_arrays(
+    from amgx_tpu.core.sharding import make_stacked_array
+
+    return make_stacked_array(
         (n_parts,) + tuple(shape),
         NamedSharding(mesh, P(axis)),
         leaves,
-        dtype=np.dtype(dtype),
+        np.dtype(dtype),
     )
 
 
@@ -356,12 +358,29 @@ def assemble_level_sharded(
         )
 
     # ---- replicated plan from allgathered O(boundary) metadata ------
+    from amgx_tpu.core.matrix import sparsity_fingerprint
+
     local_meta = {
         p: dict(
             halo_glob=np.asarray(part["halo_glob"], dtype=np.int64),
             w=int(np.diff(part["indptr"]).max(initial=0)),
             dtype=np.dtype(part["vals"].dtype).str,
             nb=int(_part_boundary_count(part, counts[p], rows_pp)),
+            # per-shard pattern key (core.matrix.sparsity_fingerprint,
+            # the serve cache's content hash) — O(local) to compute,
+            # O(1) to gather; every process then holds the full tuple
+            # so DistributedMatrix.fingerprint agrees replicated.
+            # block_size is literally 1: this assembly path is
+            # scalar-only (from_local_parts raises for blocks), which
+            # keeps the key identical to finalize_partition's for any
+            # pattern both paths can actually build
+            fp=sparsity_fingerprint(
+                np.asarray(part["indptr"]),
+                np.asarray(part["cols"]),
+                np.asarray(part["indptr"]).shape[0] - 1,
+                rows_pp + len(part["halo_glob"]),
+                1,
+            ),
         )
         for p, part in parts_by_p.items()
     }
@@ -486,6 +505,7 @@ def assemble_level_sharded(
         local_of=None,
         n_owned=counts.astype(np.int32),
         proc_grid=proc_grid,
+        shard_fps=tuple(meta[p]["fp"] for p in range(n_parts)),
     )
 
 

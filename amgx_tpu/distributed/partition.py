@@ -101,10 +101,82 @@ class DistributedMatrix:
     n_owned: Optional[np.ndarray] = None
     # process grid (px, py, pz) when the slab partition was used
     proc_grid: Any = None
+    # per-shard sparsity keys: the LOCALIZED pattern of each shard
+    # hashed through core.matrix.sparsity_fingerprint — the same
+    # content hash the serve HierarchyCache/ArtifactStore key on, so a
+    # sharded hierarchy is cache-addressable exactly like a
+    # single-device one (no ad-hoc hash; stable across processes)
+    shard_fps: Any = None
 
     @property
     def uses_ppermute(self) -> bool:
         return self.perms is not None
+
+    @property
+    def fingerprint(self) -> Optional[str]:
+        """Content hash of the WHOLE partitioned pattern: the shard
+        fingerprints plus the layout metadata that changes the traced
+        program (part count, padded rows, block size).  Two uploads of
+        the same global pattern under the same partition collide; a
+        different shard count is a different program and keys apart."""
+        if self.shard_fps is None:
+            return None
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        h.update(
+            np.asarray(
+                [self.n_global, self.n_parts, self.rows_per_part,
+                 self.block_size],
+                dtype=np.int64,
+            ).tobytes()
+        )
+        for fp in self.shard_fps:
+            h.update(str(fp).encode())
+        return h.hexdigest()
+
+    def halo_stats(self) -> dict:
+        """Halo-map anatomy for telemetry and the ci gates: per-shard
+        ghost-row counts, the exchange mode, neighbor-direction count,
+        and the analytic bytes one halo exchange moves (the same model
+        DistributedAMG.collective_stats uses per level)."""
+        item = np.dtype(
+            self.ell_vals.dtype
+            if hasattr(self.ell_vals, "dtype") else np.float64
+        ).itemsize
+        bvec = max(int(self.block_size), 1)
+        ghost = None
+        if isinstance(self.ell_cols, np.ndarray):
+            rows_pp = self.rows_per_part
+            ghost = [
+                int(np.unique(
+                    self.ell_cols[p][self.ell_cols[p] >= rows_pp]
+                ).size)
+                for p in range(self.n_parts)
+            ]
+        if self.uses_ppermute:
+            mode = "ppermute"
+            directions = len(self.perms)
+            exchange_bytes = sum(
+                len(self.perms[d]) * int(np.asarray(s).shape[-1])
+                for d, s in enumerate(self.send_idx_d)
+            ) * item * bvec
+        else:
+            mode = "allgather"
+            directions = 0
+            exchange_bytes = (
+                self.n_parts * int(self.max_send) * item * bvec
+            )
+        return dict(
+            mode=mode,
+            directions=directions,
+            ghost_rows=ghost,
+            ghost_rows_total=(
+                int(sum(ghost)) if ghost is not None else None
+            ),
+            max_halo=int(self.max_halo),
+            exchange_bytes=int(exchange_bytes),
+        )
 
     def pad_vector(self, v):
         """Global vector (n_global*b,) -> stacked padded [N, rows[, b]].
@@ -753,6 +825,23 @@ def finalize_partition(
     bshape = np.asarray(parts[0]["vals"]).shape[1:] if parts else ()
     block_size = bshape[0] if bshape else 1
 
+    # per-shard pattern keys through the serve cache's content hash
+    # (core.matrix.sparsity_fingerprint) — computed here, where the
+    # localized CSR indices still exist, so sharded hierarchies key
+    # the HierarchyCache/ArtifactStore without an ad-hoc hash
+    from amgx_tpu.core.matrix import sparsity_fingerprint
+
+    shard_fps = tuple(
+        sparsity_fingerprint(
+            part["indptr"],
+            part["cols"],
+            part["indptr"].shape[0] - 1,
+            rows_pp + len(part["halo_glob"]),
+            block_size,
+        )
+        for part in parts
+    )
+
     if owner_fn is None:
         owner_fn = lambda ids: owner[ids]
     if local_fn is None:
@@ -839,4 +928,5 @@ def finalize_partition(
         local_of=local_of,
         n_owned=counts.astype(np.int32),
         proc_grid=proc_grid,
+        shard_fps=shard_fps,
     )

@@ -18,7 +18,25 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from amgx_tpu.core.sharding import shard_map
 from amgx_tpu.distributed.partition import DistributedMatrix
+from amgx_tpu.ops import blas as blas_mod
+
+# Collective-site accounting (trace-time, the PR 8 machinery):
+#   * every cross-shard reduction (psum) records into BOTH the PR 8
+#     reduction slot (ops/blas.reduction_counter — one psum IS one
+#     global reduction) and the "psum_sites" slot serve/batched's
+#     psum_site_counter reads, so the serve-side collective gates see
+#     distributed solves with no extra plumbing;
+#   * every halo exchange records into its own "halo_sites" slot —
+#     ci/halo_bench.py gates the fine-level SpMV to <= 1 exchange per
+#     apply (forward; the reverse exchange records the same site).
+_record_psum_site, _psum_sites = blas_mod.make_site_counter(
+    "psum_sites"
+)
+record_halo_exchange, halo_site_counter = blas_mod.make_site_counter(
+    "halo_sites"
+)
 
 
 def _shard_params(A: DistributedMatrix, cfg=None, scope="default"):
@@ -78,6 +96,7 @@ def exchange_halo(A: DistributedMatrix, shard, x_loc, axis):
     shard_map; `shard` is the _shard_params dict with the leading
     shard axis dropped.  Block vectors ([rows, b]) exchange whole
     b-vectors per halo slot (reference block halo buffers)."""
+    record_halo_exchange()
     blk = x_loc.ndim == 2
     if A.uses_ppermute:
         send_idx_d, halo_dir, halo_pos = shard["ex"]
@@ -110,6 +129,7 @@ def exchange_halo_reverse(A: DistributedMatrix, shard, y_own, y_halo,
     ``y_own``: [rows] owned partials; ``y_halo``: [max_halo] halo-slot
     partials.  Returns y_own with remote contributions added.
     """
+    record_halo_exchange()
     if A.uses_ppermute:
         send_idx_d, halo_dir, halo_pos = shard["ex"]
         for d, perm in enumerate(A.perms):
@@ -253,8 +273,24 @@ def make_local_spmv(A: DistributedMatrix, axis):
 
 
 def _pdot(a, b, axis):
-    # vdot flattens, so block vectors [rows, b] reduce correctly
+    # vdot flattens, so block vectors [rows, b] reduce correctly.
+    # One psum = one global reduction: counted into both the PR 8
+    # reduction slot and the serve psum-site slot at trace time.
+    blas_mod.record_reduction()
+    _record_psum_site()
     return jax.lax.psum(jnp.vdot(a, b), axis)
+
+
+def _pgram(L, Rt, axis):
+    """Distributed fused Gram block: the shard-local
+    :func:`amgx_tpu.ops.blas.gram_block` matmul followed by ONE psum —
+    ALL inner products of an s-step outer iteration in a single
+    collective (gram_block already records the reduction site; only
+    the psum site is added here)."""
+    from amgx_tpu.ops.blas import gram_block
+
+    _record_psum_site()
+    return jax.lax.psum(gram_block(L, Rt), axis)
 
 
 def _safe_block_inv(d):
@@ -316,7 +352,7 @@ def _run_dist_solve(A, b_global, mesh, max_iters, tol, preconditioned):
     in_shard = jax.tree.map(lambda _: P(axis), shard)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(in_shard, P(axis)),
         out_specs=(P(axis), P(), P()),
@@ -350,7 +386,7 @@ def dist_spmv_replicated_check(A: DistributedMatrix, x, mesh: Mesh):
     in_shard = jax.tree.map(lambda _: P(axis), shard)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(in_shard, P(axis)),
         out_specs=P(axis),

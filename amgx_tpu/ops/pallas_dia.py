@@ -47,6 +47,13 @@ try:  # soft import: CPU-only deployments never touch the TPU dialect
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
+
+def _compiler_params(**kw):
+    from amgx_tpu.core.sharding import pallas_compiler_params
+
+    return pallas_compiler_params(pltpu, **kw)
+
+
 _LANE = 128
 _ROW_BLOCK = 64 * 1024  # rows per grid step (f32: 256 KB out block)
 # VMEM budget for the double-buffered diagonal-values block
@@ -141,7 +148,7 @@ def _pallas_dia_spmv(dia_vals, x, offsets, n, interpret=False):
             pltpu.VMEM((mwin, _LANE), dia_vals.dtype),
             pltpu.SemaphoreType.DMA,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -45,6 +45,13 @@ try:  # soft import
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
+
+def _compiler_params(**kw):
+    from amgx_tpu.core.sharding import pallas_compiler_params
+
+    return pallas_compiler_params(pltpu, **kw)
+
+
 _SUB = 8
 _LANE = 128
 _ROW_TILE = _SUB * _LANE  # 1024 rows per grid step
@@ -205,7 +212,7 @@ def _pallas_well_spmv(tcols, tvals, bases, x, n_rows, W, interpret=False):
             pltpu.VMEM((W // _LANE, _LANE), tvals.dtype),
             pltpu.SemaphoreType.DMA,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -12,9 +12,15 @@ WHERE a flushed group executes (ROADMAP item 1):
 * :class:`AffinityPlacement` — route each whole group to the device
   whose hierarchy/compile caches are already warm for its fingerprint
   (:class:`AffinityRouter`), falling back to least-loaded.
+* :class:`DistributedPlacement` — row-shard ONE big system over the
+  mesh (domain decomposition, AmgX L3): patterns crossing
+  ``row_threshold`` rows are partitioned with halo maps, solved by
+  the shard-aware distributed AMG hierarchy, and settled through the
+  normal group pipeline (see doc/DISTRIBUTED.md).
 
 Select with the service's ``placement=`` argument or
-``AMGX_TPU_PLACEMENT=single|mesh[:N]|affinity`` (see doc/MESH.md).
+``AMGX_TPU_PLACEMENT=single|mesh[:N]|affinity|distributed[:N]``
+(see doc/MESH.md, doc/DISTRIBUTED.md).
 
 Failure domains (doc/ROBUSTNESS.md "Failure domains"): every policy
 carries a :class:`DeviceHealthBoard` of per-device breakers — a lost
@@ -44,6 +50,7 @@ from amgx_tpu.serve.placement.router import (
     AffinityPlacement,
     AffinityRouter,
 )
+from amgx_tpu.serve.placement.distributed import DistributedPlacement
 
 __all__ = [
     "ENV_VAR",
@@ -55,6 +62,7 @@ __all__ = [
     "MeshPlacement",
     "AffinityPlacement",
     "AffinityRouter",
+    "DistributedPlacement",
     "template_partition_specs",
     "parse_placement",
     "placement_from_env",

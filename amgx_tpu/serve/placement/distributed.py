@@ -1,0 +1,441 @@
+"""DistributedPlacement: row-shard ONE big system over the mesh.
+
+Where :class:`~amgx_tpu.serve.placement.mesh.MeshPlacement` shards the
+BATCH axis of many small systems, this policy shards the ROW axis of a
+single large one (domain decomposition, AmgX L3): a flushed group
+whose pattern crosses ``row_threshold`` rows is partitioned over the
+mesh (:class:`~amgx_tpu.core.rowsharded.RowShardedMatrix`), solved by
+the shard-aware AMG hierarchy
+(:class:`~amgx_tpu.distributed.amg.DistributedAMG` — per-rank host
+coarsening, ghost-row Galerkin, optional ``dist_coarse_sparsify`` halo
+capping, consolidated tail), and settled through the NORMAL serve
+pipeline: the ticket is submitted, traced, flight-recorded, and
+drained like any other group — ``plan.fn`` returns a lazy
+``SolveResult`` pytree and the group's single fetch stays the only
+host sync.
+
+Eligibility: ``pattern.n >= row_threshold``, a real (non-complex)
+dtype, and >= 2 mesh devices; everything else takes the ``fallback``
+policy's plan (single-device by default) bit-identically.  The
+sharded hierarchy is cached per pattern ``fingerprint`` + values hash
+— the per-shard keys reuse ``core.matrix.sparsity_fingerprint``
+(``DistributedMatrix.fingerprint``), so repeat fingerprints skip
+setup exactly like the service's ``HierarchyCache``.
+
+Known scope bound (documented, ROADMAP item 2): the service still
+resolves its single-device hierarchy entry for the pattern before any
+placement policy runs; bypassing that host build for patterns too
+large to set up anywhere is the remaining fleet-tier step (each
+worker serving one shard).
+
+Outer loops: ``outer="pcg"`` (default) or ``"sstep"`` (s-step PCG —
+two collectives per s steps through the psum'd fused Gram block).
+Convergence is relative-residual at the entry solver's tolerance.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from amgx_tpu.serve.placement.policy import (
+    GroupPlan,
+    PlacementPolicy,
+    SingleDevicePolicy,
+)
+
+DEFAULT_ROW_THRESHOLD = 65536
+ENV_ROW_THRESHOLD = "AMGX_TPU_DIST_ROWS"
+
+
+def _orig_csr(pat):
+    """Recover the ORIGINAL (unpadded) CSR pattern from a
+    PaddedPattern: ``scatter`` maps original entries into the padded
+    arrays, so the original columns/indptr fall out of two gathers."""
+    ro = np.asarray(pat.row_offsets)
+    ci = np.asarray(pat.col_indices)
+    rows = (
+        np.searchsorted(ro, pat.scatter, side="right") - 1
+    ).astype(np.int64)
+    indptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(rows, minlength=pat.n))]
+    ).astype(np.int64)
+    return indptr, ci[pat.scatter].astype(np.int64)
+
+
+class _ShardedSolver:
+    """One fingerprint's sharded state: the RowShardedMatrix, its
+    DistributedAMG hierarchy (rebuilt when values change), and the
+    jit-side unpad metadata."""
+
+    __slots__ = (
+        "rs", "amg", "vals_hash", "setup_s", "n", "uniform",
+    )
+
+    def __init__(self, rs, amg, vals_hash, setup_s):
+        self.rs = rs
+        self.amg = amg
+        self.vals_hash = vals_hash
+        self.setup_s = setup_s
+        self.n = rs.dm.n_global
+        n_owned = np.asarray(rs.dm.n_owned)
+        # reshape-unpad is valid only for uniform contiguous blocks
+        # (every part except the last owns exactly rows_per_part rows)
+        self.uniform = bool(
+            (n_owned[:-1] == rs.dm.rows_per_part).all()
+        )
+
+
+class DistributedPlacement(PlacementPolicy):
+    """Row-shard big-pattern groups over the mesh; delegate the rest.
+
+    Parameters
+    ----------
+    devices: chips to mesh over (default all ``jax.devices()``).
+    axis_name: mesh axis name ("rows").
+    max_shards: cap on the shard count
+        (``AMGX_TPU_PLACEMENT=distributed:N``).
+    row_threshold: minimum pattern rows to shard; smaller groups take
+        the fallback plan.  None resolves ``AMGX_TPU_DIST_ROWS``
+        (default 65536).
+    outer: "pcg" | "sstep" — the distributed outer Krylov loop.
+    sparsify_theta: ``dist_coarse_sparsify`` for the sharded
+        hierarchy (0 = exact Galerkin).
+    consolidate_rows / grade_lower: the hierarchy's consolidation
+        knobs (None = DistributedAMG defaults).
+    fallback: policy for ineligible groups (default
+        :class:`SingleDevicePolicy` — bitwise the pre-placement
+        behavior).
+    """
+
+    name = "distributed"
+    telemetry_kind = "dist"
+
+    def __init__(self, devices=None, axis_name: str = "rows",
+                 max_shards: Optional[int] = None,
+                 row_threshold: Optional[int] = None,
+                 outer: str = "pcg",
+                 sparsify_theta: float = 0.0,
+                 consolidate_rows: Optional[int] = None,
+                 grade_lower: Optional[int] = None,
+                 fallback: Optional[PlacementPolicy] = None):
+        import jax
+        import os
+
+        if outer not in ("pcg", "sstep"):
+            raise ValueError(
+                f"DistributedPlacement outer must be 'pcg' or "
+                f"'sstep', got {outer!r}"
+            )
+        self.devices = (
+            list(devices) if devices is not None
+            else list(jax.devices())
+        )
+        if max_shards:
+            self.devices = self.devices[:max_shards]
+        self.axis_name = axis_name
+        self.max_shards = max_shards
+        if row_threshold is None:
+            row_threshold = int(
+                os.environ.get(
+                    ENV_ROW_THRESHOLD, str(DEFAULT_ROW_THRESHOLD)
+                )
+            )
+        self.row_threshold = int(row_threshold)
+        self.outer = outer
+        self.sparsify_theta = float(sparsify_theta)
+        self.consolidate_rows = consolidate_rows
+        self.grade_lower = grade_lower
+        self._fallback = fallback or SingleDevicePolicy()
+        self.health = self._fallback.health
+        self._lock = threading.Lock()
+        self._mesh = None
+        self._solvers: dict = {}  # pattern fingerprint -> _ShardedSolver
+        # telemetry (guarded by _lock)
+        self._sharded_groups = 0
+        self._fallback_groups = 0
+        self._solves = 0
+        self._setups = 0
+        self._setup_s = 0.0
+        self._iters_total = 0
+        self._level_stats: list = []
+        self._sparsify_stats: list = []
+        self._consolidation_level = -1
+        self._halo_bytes_cycle = 0
+        self.psum_sites: Optional[int] = None
+        self._dist_fp: Optional[str] = None
+
+    # -- mesh -----------------------------------------------------------
+
+    def _mesh_for(self):
+        from jax.sharding import Mesh
+
+        with self._lock:
+            if self._mesh is None:
+                self._mesh = Mesh(
+                    np.array(self.devices), (self.axis_name,)
+                )
+            return self._mesh
+
+    def _eligible(self, entry, Bb: int) -> bool:
+        pat = entry.pattern
+        dt = np.dtype(entry.solver.A.values.dtype)
+        return (
+            len(self.devices) >= 2
+            and pat.n >= self.row_threshold
+            and dt.kind == "f"
+        )
+
+    # -- sharded state --------------------------------------------------
+
+    def _solver_for(self, entry, values: np.ndarray) -> _ShardedSolver:
+        """The fingerprint's sharded hierarchy, rebuilt when the
+        coefficient content changes (hash of the value bytes)."""
+        from amgx_tpu.core.rowsharded import RowShardedMatrix
+
+        pat = entry.pattern
+        vh = hashlib.blake2b(
+            np.ascontiguousarray(values).tobytes(), digest_size=16
+        ).hexdigest()
+        with self._lock:
+            ss = self._solvers.get(pat.fingerprint)
+        if ss is not None and ss.vals_hash == vh:
+            return ss
+        t0 = time.perf_counter()
+        indptr, cols = _orig_csr(pat)
+        mesh = self._mesh_for()
+        rs = RowShardedMatrix.from_csr(
+            indptr, cols, values, pat.n, mesh=mesh
+        )
+        kw = {}
+        if self.consolidate_rows is not None:
+            kw["consolidate_rows"] = self.consolidate_rows
+        if self.grade_lower is not None:
+            kw["grade_lower"] = self.grade_lower
+        if self.sparsify_theta > 0.0:
+            kw["sparsify_theta"] = self.sparsify_theta
+        amg = rs.solver(**kw)
+        setup_s = time.perf_counter() - t0
+        ss = _ShardedSolver(rs, amg, vh, setup_s)
+        if not ss.uniform:
+            # the lazy jit-side unpad (flatten + slice) requires the
+            # uniform contiguous layout from_csr builds; anything else
+            # must not silently misorder rows
+            raise ValueError(
+                "DistributedPlacement requires a uniform contiguous "
+                "row partition for the jit-side unpad"
+            )
+        cs = amg.collective_stats()
+        cons = next(
+            (
+                l for l, lvl in enumerate(amg.h.levels)
+                if lvl.bridge is not None
+            ),
+            len(amg.h.levels),
+        )
+        with self._lock:
+            self._solvers[pat.fingerprint] = ss
+            self._setups += 1
+            self._setup_s += setup_s
+            self._dist_fp = rs.fingerprint
+            self._level_stats = [
+                dict(
+                    level=l["level"],
+                    halo_bytes=l["halo_bytes"],
+                    active_shards=l["active_shards"],
+                    ghost_rows=g,
+                )
+                for l, g in zip(
+                    cs["levels"],
+                    [
+                        (lvl.A.halo_stats()["ghost_rows_total"]
+                         if isinstance(lvl.A.ell_cols, np.ndarray)
+                         else None)
+                        for lvl in amg.h.levels
+                    ],
+                )
+            ]
+            self._sparsify_stats = list(
+                (amg.h.setup_stats or {}).get("sparsify", [])
+            )
+            self._consolidation_level = cons
+            self._halo_bytes_cycle = sum(
+                l["halo_bytes"] + l["bridge_bytes"]
+                for l in cs["levels"]
+            )
+        return ss
+
+    # -- PlacementPolicy ------------------------------------------------
+
+    def plan(self, service, entry, Bb: int) -> GroupPlan:
+        if not self._eligible(entry, Bb):
+            with self._lock:
+                self._fallback_groups += 1
+            return self._fallback.plan(service, entry, Bb)
+
+        import jax.numpy as jnp
+
+        from amgx_tpu.serve.batched import psum_site_counter
+        from amgx_tpu.solvers.base import (
+            NOT_CONVERGED,
+            SUCCESS,
+            SolveResult,
+        )
+
+        pat = entry.pattern
+        tol = float(entry.solver.tolerance)
+        max_iters = int(entry.solver.max_iters)
+        outer = self.outer
+        policy = self
+
+        def fn(_template, vals_B, bs_B, x0_B):
+            """Host-staged sharded dispatch: per live instance, one
+            shard_map solve launched async; the returned SolveResult
+            leaves are lazy device arrays — the group's single fetch
+            stays the only host sync."""
+            vals_B = np.asarray(vals_B)
+            bs_B = np.asarray(bs_B)
+            x0_B = np.asarray(x0_B)
+            Bb_ = vals_B.shape[0]
+            hist = np.full(
+                (max_iters + 1, 1), np.nan, dtype=np.float64
+            )
+            xs, its, sts, fns, ins, hs = [], [], [], [], [], []
+            prev_vals = None
+            ss = None
+            solved = 0
+            for i in range(Bb_):
+                b_i = bs_B[i, : pat.n]
+                if not np.any(b_i):
+                    # batch-padding clone (b = 0): converged at 0
+                    xs.append(jnp.zeros((pat.nb,), vals_B.dtype))
+                    its.append(jnp.asarray(np.int32(0)))
+                    sts.append(jnp.asarray(np.int32(SUCCESS)))
+                    fns.append(jnp.zeros((1,), np.float64))
+                    ins.append(jnp.zeros((1,), np.float64))
+                    hs.append(jnp.asarray(hist))
+                    continue
+                v_i = pat.extract_values(vals_B[i])
+                if ss is None or (
+                    prev_vals is not None
+                    and not np.array_equal(prev_vals, v_i)
+                ):
+                    ss = policy._solver_for(entry, v_i)
+                    prev_vals = v_i
+                x0_i = x0_B[i, : pat.n]
+                # warm starts: solve the shifted system A d = b - A x0
+                # (one host SpMV off the cached pattern), x = x0 + d
+                shift = np.any(x0_i)
+                rhs = (
+                    b_i - ss.rs._scipy @ x0_i if shift else b_i
+                )
+                nrm0 = float(np.linalg.norm(rhs))
+                with psum_site_counter() as c:
+                    x_d, it_d, nrm_d = ss.amg.solve_device(
+                        rhs, max_iters=max_iters, tol=tol,
+                        outer=outer,
+                    )
+                if c.count and policy.psum_sites is None:
+                    with policy._lock:
+                        policy.psum_sites = c.count
+                # jit-side unpad (uniform contiguous blocks): flatten
+                # the stacked [N, rows] shards and slice the real rows
+                # — an async device op, no host sync
+                x_flat = jnp.reshape(x_d, (-1,))[: pat.n]
+                if shift:
+                    x_flat = x_flat + jnp.asarray(x0_i)
+                x_full = jnp.pad(x_flat, (0, pat.nb - pat.n))
+                ok = nrm_d <= tol * max(nrm0, 1e-300)
+                xs.append(x_full)
+                its.append(it_d.astype(jnp.int32))
+                sts.append(
+                    jnp.where(
+                        ok,
+                        jnp.int32(SUCCESS),
+                        jnp.int32(NOT_CONVERGED),
+                    )
+                )
+                fns.append(jnp.reshape(nrm_d, (1,)).astype(np.float64))
+                ins.append(jnp.asarray([nrm0], dtype=np.float64))
+                hs.append(jnp.asarray(hist))
+                solved += 1
+            with policy._lock:
+                policy._sharded_groups += 1
+                policy._solves += solved
+            return SolveResult(
+                x=jnp.stack(xs),
+                iters=jnp.stack(its),
+                status=jnp.stack(sts),
+                final_norm=jnp.stack(fns),
+                initial_norm=jnp.stack(ins),
+                history=jnp.stack(hs),
+            )
+
+        def on_fetch(host, device_s):
+            with policy._lock:
+                policy._iters_total += int(
+                    np.asarray(host.iters).sum()
+                )
+
+        return GroupPlan(
+            fn=fn,
+            put=np.asarray,  # host staging: fn partitions per shard
+            zeros=lambda bb, nb, dtype: np.zeros((bb, nb), dtype),
+            zeros_key=("dist", len(self.devices)),
+            donate=False,
+            device_label=f"dist{len(self.devices)}",
+            on_fetch=on_fetch,
+        )
+
+    def warm(self, service, entry, Bb: int) -> None:
+        if not self._eligible(entry, Bb):
+            self._fallback.warm(service, entry, Bb)
+
+    def evicted(self, entry) -> None:
+        with self._lock:
+            self._solvers.pop(entry.pattern.fingerprint, None)
+        self._fallback.evicted(entry)
+
+    def evict_signature(self, signature) -> None:
+        self._fallback.evict_signature(signature)
+
+    def describe(self) -> dict:
+        return {
+            "policy": self.name,
+            "devices": len(self.devices),
+            "axis": self.axis_name,
+            "row_threshold": self.row_threshold,
+            "outer": self.outer,
+            "sparsify_theta": self.sparsify_theta,
+        }
+
+    def telemetry_snapshot(self) -> dict:
+        """Registry source (kind="dist") -> the ``amgx_dist_*``
+        families: per-level halo bytes and ghost rows, setup counts,
+        collective accounting, consolidation level index."""
+        with self._lock:
+            return {
+                "policy": self.name,
+                "devices": len(self.devices),
+                "row_threshold": self.row_threshold,
+                "outer": self.outer,
+                "sharded_groups_total": self._sharded_groups,
+                "fallback_groups_total": self._fallback_groups,
+                "sharded_solves_total": self._solves,
+                "setups_total": self._setups,
+                "setup_seconds_total": self._setup_s,
+                "iterations_total": self._iters_total,
+                "psum_sites_per_solve": self.psum_sites or 0,
+                "consolidation_level": self._consolidation_level,
+                "halo_exchange_bytes_per_cycle":
+                    self._halo_bytes_cycle,
+                "sparsify_dropped_total": sum(
+                    s["dropped"] for s in self._sparsify_stats
+                ),
+                "levels": list(self._level_stats),
+                "fingerprint": self._dist_fp,
+            }

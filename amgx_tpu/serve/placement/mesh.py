@@ -310,10 +310,7 @@ class MeshPlacement(PlacementPolicy):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        try:
-            from jax.experimental.shard_map import shard_map
-        except ImportError:  # newer jax promoted it out of experimental
-            from jax import shard_map
+        from amgx_tpu.core.sharding import shard_map
 
         from amgx_tpu.serve.batched import (
             make_batched_solve,

@@ -236,9 +236,33 @@ def parse_placement(spec: str) -> PlacementPolicy:
         from amgx_tpu.serve.placement.router import AffinityPlacement
 
         return AffinityPlacement()
+    if spec == "distributed" or spec.startswith("distributed:"):
+        from amgx_tpu.serve.placement.distributed import (
+            DistributedPlacement,
+        )
+
+        max_shards = None
+        outer = "pcg"
+        for arg in spec.split(":")[1:]:
+            if arg in ("pcg", "sstep"):
+                outer = arg
+                continue
+            try:
+                max_shards = int(arg)
+            except ValueError:
+                raise ValueError(
+                    f"{ENV_VAR}: distributed option must be a shard "
+                    f"count or pcg|sstep, got {arg!r}"
+                ) from None
+            if max_shards <= 0:
+                raise ValueError(
+                    f"{ENV_VAR}: distributed shard count must be "
+                    f"positive, got {max_shards}"
+                )
+        return DistributedPlacement(max_shards=max_shards, outer=outer)
     raise ValueError(
         f"{ENV_VAR}: unknown placement policy {spec!r} "
-        "(expected single | mesh[:N] | affinity)"
+        "(expected single | mesh[:N] | affinity | distributed[:N])"
     )
 
 

@@ -461,6 +461,66 @@ def mesh_families(fams: FamilyTable, comp: str, snap: dict) -> None:
                  {**labels, "device": dev}, secs)
 
 
+def dist_families(fams: FamilyTable, comp: str, snap: dict) -> None:
+    """DistributedPlacement.telemetry_snapshot() (domain
+    decomposition, PR 14) -> amgx_dist_* families: per-level halo
+    bytes and ghost rows, setup counts, collective accounting, and
+    the consolidation level index."""
+    labels = {"component": comp, "policy": snap.get("policy", "?")}
+    fams.add("amgx_dist_devices", "gauge",
+             "mesh devices the row-sharding policy spans", labels,
+             snap.get("devices"))
+    fams.add("amgx_dist_row_threshold", "gauge",
+             "minimum pattern rows for a group to row-shard", labels,
+             snap.get("row_threshold"))
+    fams.add("amgx_dist_sharded_groups_total", "counter",
+             "groups solved row-sharded over the mesh", labels,
+             snap.get("sharded_groups_total"))
+    fams.add("amgx_dist_fallback_groups_total", "counter",
+             "groups below the row threshold (fallback policy)",
+             labels, snap.get("fallback_groups_total"))
+    fams.add("amgx_dist_solves_total", "counter",
+             "row-sharded instance solves", labels,
+             snap.get("sharded_solves_total"))
+    fams.add("amgx_dist_setups_total", "counter",
+             "sharded hierarchy setups (fingerprint or values miss)",
+             labels, snap.get("setups_total"))
+    fams.add("amgx_dist_setup_seconds_total", "counter",
+             "seconds spent in sharded hierarchy setup", labels,
+             snap.get("setup_seconds_total"))
+    fams.add("amgx_dist_iterations_total", "counter",
+             "outer Krylov iterations retired by sharded solves",
+             labels, snap.get("iterations_total"))
+    fams.add("amgx_dist_psum_sites_per_solve", "gauge",
+             "psum call sites traced into the sharded solve program "
+             "(ci/halo_bench.py gates the reduction budget)", labels,
+             snap.get("psum_sites_per_solve"))
+    fams.add("amgx_dist_consolidation_level", "gauge",
+             "hierarchy level index where graded consolidation onto "
+             "fewer shards begins (= level count when never graded)",
+             labels, snap.get("consolidation_level"))
+    fams.add("amgx_dist_halo_exchange_bytes_per_cycle", "gauge",
+             "analytic bytes one V-cycle's halo exchanges move "
+             "(all levels + consolidation bridges)", labels,
+             snap.get("halo_exchange_bytes_per_cycle"))
+    fams.add("amgx_dist_sparsify_dropped_total", "counter",
+             "cross-shard coarse Galerkin entries dropped by "
+             "dist_coarse_sparsify (diagonal-lumped)", labels,
+             snap.get("sparsify_dropped_total"))
+    for lvl in (snap.get("levels") or ()):
+        ll = {**labels, "level": str(lvl.get("level"))}
+        fams.add("amgx_dist_level_halo_bytes", "gauge",
+                 "bytes one halo exchange moves at this level", ll,
+                 lvl.get("halo_bytes"))
+        fams.add("amgx_dist_level_ghost_rows", "gauge",
+                 "ghost (halo) rows per level, summed over shards",
+                 ll, lvl.get("ghost_rows"))
+        fams.add("amgx_dist_level_active_shards", "gauge",
+                 "shards owning rows at this level (graded "
+                 "consolidation shrinks the active tier)", ll,
+                 lvl.get("active_shards"))
+
+
 def tracing_families(fams: FamilyTable, comp: str, snap: dict) -> None:
     labels = {"component": comp}
     fams.add("amgx_trace_spans_total", "counter",
@@ -492,6 +552,7 @@ _RENDERERS = {
     "solvers": solver_families,
     "sessions": session_families,
     "mesh": mesh_families,
+    "dist": dist_families,
     "tracing": tracing_families,
     "recorder": recorder_families,
 }

@@ -70,8 +70,10 @@ def compiled_spmv_hlo() -> str:
     spmv = make_local_spmv(D, "x")
     sh = _shard_params(D)
 
+    from amgx_tpu.core.sharding import shard_map
+
     @jax.jit
-    @jax.shard_map(
+    @shard_map(
         mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P("x"), sh), P("x")),
         out_specs=P("x"),
@@ -84,8 +86,13 @@ def compiled_spmv_hlo() -> str:
     return f.lower(sh, xs).compile().as_text()
 
 
+# the result type between "=" and the op may itself be a TUPLE
+# "(f64[...], s32[])" (while/tuple instructions — some XLA pipelines
+# route the boundary scatter-add through a while loop), so the type
+# matcher must tolerate spaces/parens: non-greedy skip to the first
+# "op(" token
 _INSTR = re.compile(
-    r"^\s*(?:ROOT\s+)?%(?P<name>[\w\.\-]+)\s*=\s*\S+\s+"
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w\.\-]+)\s*=\s*(?:[^=]*?)\s"
     r"(?P<op>[\w\-]+)\((?P<args>.*)$"
 )
 

@@ -1,0 +1,255 @@
+"""Domain-decomposition CI gate: row-sharded solve floors on the
+simulated device mesh (PR 14).
+
+One JSON line (the ci/ contract) and a non-zero exit when:
+
+* **solution parity** — the 4-shard row-sharded PCG+AMG solve of the
+  128^2 Poisson problem diverges from the single-shard reference
+  solution beyond rtol 1e-10, or needs more than +10% of its
+  iterations (the acceptance-criterion contract);
+* **collective budget** — the fine-level sharded SpMV traces to more
+  than ONE halo exchange per apply
+  (``distributed.solve.halo_site_counter``), the monitored-PCG
+  program traces to more than 5 psum sites (2 init + 3 per
+  iteration — the PR 8 reduction budget), or the s-step program to
+  more than 3 (1 init + 2 per s steps: the psum'd fused Gram block
+  plus the monitor norm);
+* **communication-reduced coarse grids** — ``dist_coarse_sparsify``
+  at theta 0.3 fails to shrink the modeled per-cycle halo bytes, or
+  breaks the +10% iteration-parity envelope;
+* **weak scaling** — 4-shard solves/s drops below 1.5x the 1-shard
+  arm (best of three time-diversified interleaved attempts).
+  Conservative like ci/mesh_bench.py: the simulated devices SHARE
+  the host's cores, so passing here under-promises what a real mesh
+  (which adds chips) delivers.  On a SINGLE-core host overlap is
+  physically impossible (the ratio would measure only collective
+  overhead), so the gate records the measurement and skips
+  enforcement — ``host_cpus``/``speedup_gate`` are in the record.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python ci/halo_bench.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SPARSIFY_CFG = (
+    '{"config_version": 2, "solver": {"scope": "amg",'
+    ' "solver": "AMG", "algorithm": "AGGREGATION",'
+    ' "selector": "SIZE_2", "smoother": {"scope": "jac",'
+    ' "solver": "BLOCK_JACOBI", "relaxation_factor": 0.8,'
+    ' "monitor_residual": 0}, "presweeps": 1, "postsweeps": 1,'
+    ' "max_iters": 1, "cycle": "V",'
+    ' "coarse_solver": "DENSE_LU_SOLVER",'
+    ' "dist_coarse_sparsify": 0.3, "dist_sparsify_from_level": 3,'
+    ' "monitor_residual": 0}}'
+)
+
+
+def run(side=128, shards=4, consolidate=512, tol=1e-10, reps=3):
+    import multiprocessing
+
+    import numpy as np
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from jax.sharding import Mesh
+
+    from amgx_tpu.config.amg_config import AMGConfig
+    from amgx_tpu.distributed import partition_matrix
+    from amgx_tpu.distributed.amg import DistributedAMG
+    from amgx_tpu.distributed.solve import (
+        dist_spmv_replicated_check,
+        halo_site_counter,
+    )
+    from amgx_tpu.io.poisson import poisson_2d_5pt
+    from amgx_tpu.serve.batched import psum_site_counter
+
+    problems = []
+    ndev = len(jax.devices())
+    shards = min(shards, ndev)
+    Asp = poisson_2d_5pt(side).to_scipy()
+    n = Asp.shape[0]
+    b = np.ones(n)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("rows",))
+    meshN = Mesh(np.array(jax.devices()[:shards]), ("rows",))
+
+    # ---- collective budget (trace-time site counts) ------------------
+    D = partition_matrix(Asp, shards)
+    with halo_site_counter() as hc:
+        dist_spmv_replicated_check(D, b, meshN)
+    halo_per_apply = hc.count
+    if halo_per_apply > 1:
+        problems.append(
+            f"fine-level SpMV traced {halo_per_apply} halo exchanges "
+            "per apply (budget: 1)"
+        )
+
+    amgN = DistributedAMG(
+        Asp, meshN, consolidate_rows=consolidate, grade_lower=0
+    )
+    with psum_site_counter() as pc:
+        xN, itN, _ = amgN.solve(b, tol=tol)
+    pcg_psum_sites = pc.count
+    if pcg_psum_sites > 5:
+        problems.append(
+            f"monitored PCG traced {pcg_psum_sites} psum sites "
+            "(PR 8 budget: 5 = 2 init + 3/iteration)"
+        )
+    amgS = DistributedAMG(
+        Asp, meshN, consolidate_rows=consolidate, grade_lower=0
+    )
+    with psum_site_counter() as pc2:
+        amgS.solve(b, tol=tol, outer="sstep")
+    sstep_psum_sites = pc2.count
+    if sstep_psum_sites > 3:
+        problems.append(
+            f"SSTEP_PCG traced {sstep_psum_sites} psum sites "
+            "(budget: 3 = 1 init + 2 per s steps)"
+        )
+
+    # ---- solution parity vs the single-shard reference ---------------
+    amg1 = DistributedAMG(
+        Asp, mesh1, consolidate_rows=consolidate, grade_lower=0
+    )
+    x1, it1, _ = amg1.solve(b, tol=tol)
+    denom = np.linalg.norm(x1)
+    rel = float(np.linalg.norm(np.asarray(xN) - np.asarray(x1)) / denom)
+    if rel > 1e-10:
+        problems.append(
+            f"{shards}-shard solution diverges from the 1-shard "
+            f"reference: rel {rel:.3e} > 1e-10"
+        )
+    if itN > int(it1 * 1.10) + 1:
+        problems.append(
+            f"iteration parity broken: {itN} sharded vs {it1} "
+            "reference (+10% envelope)"
+        )
+
+    # ---- communication-reduced coarse grids --------------------------
+    cfg = AMGConfig.from_string(SPARSIFY_CFG)
+    amg_sp = DistributedAMG(
+        Asp, meshN, cfg=cfg, scope="amg",
+        consolidate_rows=consolidate, grade_lower=0,
+    )
+    x_sp, it_sp, _ = amg_sp.solve(b, tol=tol)
+    halo_exact = sum(
+        l["halo_bytes"] for l in amgN.collective_stats()["levels"]
+    )
+    halo_sp = sum(
+        l["halo_bytes"] for l in amg_sp.collective_stats()["levels"]
+    )
+    dropped = sum(
+        s["dropped"]
+        for s in amg_sp.h.setup_stats.get("sparsify", [])
+    )
+    if not (halo_sp < halo_exact and dropped > 0):
+        problems.append(
+            "dist_coarse_sparsify(0.3) did not reduce per-cycle halo "
+            f"bytes ({halo_exact} -> {halo_sp}, dropped {dropped})"
+        )
+    if it_sp > int(itN * 1.10) + 1:
+        problems.append(
+            f"sparsified iteration parity broken: {it_sp} vs {itN}"
+        )
+
+    # ---- weak scaling: solves/s, interleaved best-of-reps ------------
+    # paired attempts (the ci/mesh_bench.py protocol): each rep times
+    # BOTH arms back to back, so a noisy-neighbor burst lands on both
+    # halves of a pair instead of deflating one arm; best pair wins
+    amg1.solve(b, tol=tol)  # warm both compiled programs
+    amgN.solve(b, tol=tol)
+    best1 = bestN = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        amg1.solve(b, tol=tol)
+        best1 = min(best1, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        amgN.solve(b, tol=tol)
+        bestN = min(bestN, time.perf_counter() - t0)
+    r1 = 1.0 / best1
+    rN = 1.0 / bestN
+    speedup = rN / r1
+    cpus = multiprocessing.cpu_count()
+    # a single-core host cannot overlap the simulated devices AT ALL —
+    # the parallel arms serialize by construction and the ratio
+    # measures only collective overhead, not scaling.  The gate is
+    # enforced wherever overlap is physically possible (>= 2 cores,
+    # the calibrated CI host); single-core records the measurement
+    # and the skip reason instead of a meaningless failure.
+    speedup_gate = "enforced"
+    if cpus < 2:
+        speedup_gate = "skipped: single-core host (no overlap possible)"
+    elif ndev > 1 and speedup < 1.5:
+        problems.append(
+            f"row-sharded speedup {speedup:.2f}x below the 1.5x floor "
+            f"at {shards} shards (1-shard {r1:.2f}/s vs {rN:.2f}/s; "
+            "simulated devices share host cores — see docstring)"
+        )
+
+    rec = {
+        "metric": "rowsharded_solves_per_s",
+        "side": side,
+        "rows": n,
+        "shards": shards,
+        "host_cpus": cpus,
+        "speedup_gate": speedup_gate,
+        "devices": ndev,
+        "solves_per_s_1shard": round(r1, 3),
+        "solves_per_s_sharded": round(rN, 3),
+        "speedup": round(speedup, 3),
+        "iters_1shard": int(it1),
+        "iters_sharded": int(itN),
+        "iters_sparsified": int(it_sp),
+        "solution_rel": rel,
+        "halo_exchanges_per_spmv": int(halo_per_apply),
+        "pcg_psum_sites": int(pcg_psum_sites),
+        "sstep_psum_sites": int(sstep_psum_sites),
+        "halo_bytes_per_cycle_exact": int(halo_exact),
+        "halo_bytes_per_cycle_sparsified": int(halo_sp),
+        "sparsify_dropped": int(dropped),
+        "ok": not problems,
+    }
+    return rec, problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--side", type=int, default=128)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    rec, problems = run(side=args.side, shards=args.shards)
+    line = json.dumps(rec)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    for p in problems:
+        print(f"halo_bench: {p}", file=sys.stderr)
+    return len(problems)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

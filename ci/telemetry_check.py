@@ -4,14 +4,16 @@ the overhead ceiling.
 Prints ONE JSON line (same contract as the other ci/ gates) and exits
 non-zero when:
 
-* the Prometheus exposition fails to parse, exports fewer than 35
+* the Prometheus exposition fails to parse, exports fewer than 37
   distinct metric names, misses one of the required sources
   (serve, gateway/admission, store, cache, setup-phase, solver,
-  session, mesh placement), misses the PR 8
+  session, mesh placement, distributed placement), misses the PR 8
   communication-observability names
-  (amgx_solver_reductions_total, amgx_solver_iterations_bucket), or
+  (amgx_solver_reductions_total, amgx_solver_iterations_bucket),
   misses amgx_cache_hierarchy_bytes (mixed-precision resident-bytes
-  observability, PR 13);
+  observability, PR 13), or misses the PR 14 domain-decomposition
+  names (amgx_dist_level_halo_bytes, amgx_dist_consolidation_level,
+  amgx_dist_halo_exchange_bytes_per_cycle);
 * a sampled gateway request does not produce a CONNECTED
   submit -> admission -> pad -> dispatch -> device -> fetch span
   chain in the exported Chrome trace JSON;
@@ -176,6 +178,21 @@ def _validate_observability(problems, store_dir):
         if any(int(r.status) != 0 for r in mres):
             problems.append("mesh-placed workload solves failed")
 
+        # distributed placement source (PR 14, domain decomposition):
+        # one row-sharded group over the simulated mesh feeds the
+        # amgx_dist_* families (per-level halo bytes / ghost rows,
+        # collective accounting, consolidation level index)
+        from amgx_tpu.serve.placement import DistributedPlacement
+
+        dsvc = BatchedSolveService(
+            placement=DistributedPlacement(
+                row_threshold=n, grade_lower=0, consolidate_rows=64
+            )
+        )
+        dres = dsvc.solve_many([(sp, rng.standard_normal(n))])
+        if any(int(r.status) != 0 for r in dres):
+            problems.append("row-sharded workload solve failed")
+
         # ---- prometheus ------------------------------------------
         text = telemetry.get_registry().render_prometheus()
         names = set()
@@ -187,13 +204,14 @@ def _validate_observability(problems, store_dir):
                 problems.append(f"unparseable exposition line: {line!r}")
                 break
             names.add(m.group(1))
-        if len(names) < 35:
+        if len(names) < 37:
             problems.append(
-                f"only {len(names)} metric names exported (floor 35)"
+                f"only {len(names)} metric names exported (floor 37)"
             )
         for prefix in ("amgx_serve_", "amgx_gateway_", "amgx_store_",
                        "amgx_cache_", "amgx_setup_phase_",
-                       "amgx_solver_", "amgx_session_", "amgx_mesh_"):
+                       "amgx_solver_", "amgx_session_", "amgx_mesh_",
+                       "amgx_dist_"):
             if not any(nm.startswith(prefix) for nm in names):
                 problems.append(f"no metric from source {prefix}*")
         for required in ("amgx_solver_reductions_total",
@@ -208,6 +226,14 @@ def _validate_observability(problems, store_dir):
                 "required metric amgx_cache_hierarchy_bytes missing "
                 "(mixed-precision resident-bytes observability)"
             )
+        for required in ("amgx_dist_level_halo_bytes",
+                         "amgx_dist_consolidation_level",
+                         "amgx_dist_halo_exchange_bytes_per_cycle"):
+            if required not in names:
+                problems.append(
+                    f"required metric {required} missing (PR 14 "
+                    "domain-decomposition observability)"
+                )
 
         # ---- chrome trace ----------------------------------------
         trace = tracing.export_chrome()

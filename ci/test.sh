@@ -98,11 +98,23 @@ JAX_PLATFORMS=cpu python ci/session_bench.py
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
     python ci/mesh_bench.py
 
+# ---- domain decomposition: halo-exchange + weak-scaling floors -------
+# One JSON line; non-zero exit when the 4-shard row-sharded PCG+AMG
+# solve of the 128^2 Poisson problem diverges from the 1-shard
+# reference (rtol 1e-10) or breaks +10% iteration parity, the
+# fine-level SpMV traces more than one halo exchange per apply, PCG /
+# SSTEP_PCG exceed their psum-site budgets (5 / 3), coarse-grid
+# sparsification fails to shrink per-cycle halo bytes within parity,
+# or (on multi-core hosts, where simulated-device overlap is
+# physically possible) sharded solves/s drops below 1.5x single-shard.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python ci/halo_bench.py
+
 # ---- unified telemetry: exposition + tracing + overhead --------------
 # One JSON line; non-zero exit when the Prometheus exposition fails to
-# parse or exports fewer than 34 metric names across the serve /
+# parse or exports fewer than 37 metric names across the serve /
 # admission / store / cache / setup-phase / solver / session / mesh
-# placement sources,
+# placement / distributed placement sources,
 # when a sampled gateway request does not produce a connected
 # submit->admission->pad->dispatch->device->fetch span chain in the
 # Chrome trace JSON, when a sampled streaming-session step does not

@@ -3,6 +3,8 @@ aggregates_coarsening_factor.cu, classical_pmis.cu,
 fgmres_convergence_poisson.cu)."""
 
 import numpy as np
+import os
+
 import pytest
 
 import amgx_tpu
@@ -97,6 +99,10 @@ def test_pcg_amg_preconditioner():
     assert int(res.iters) < 25  # AMG-PCG converges fast
 
 
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference"),
+    reason="reference AmgX tree not mounted in this environment",
+)
 def test_fgmres_aggregation_reference_config():
     """The FGMRES_AGGREGATION.json shipped config (BASELINE acceptance
     config 1) — adapted: DILU smoother, SIZE_2, V-cycle."""
@@ -404,9 +410,15 @@ def test_profiling_hooks():
     params = s.apply_params()
     import jax.numpy as jnp
 
-    hlo = jax.jit(cyc).lower(
+    lowered = jax.jit(cyc).lower(
         params, jnp.asarray(b), jnp.zeros_like(jnp.asarray(b))
-    ).as_text(debug_info=True)
+    )
+    try:
+        hlo = lowered.as_text(debug_info=True)
+    except TypeError:
+        # older jax: Lowered.as_text() has no debug_info and strips
+        # scope metadata — the COMPILED module keeps op_name metadata
+        hlo = lowered.compile().as_text()
     assert "amg_l0_restrict" in hlo
     assert "amg_coarse_solve" in hlo
     # API-level trace spans are usable as context managers

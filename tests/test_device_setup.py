@@ -8,6 +8,8 @@ interpolators/distance1.cu, csr_multiply.cu:207.
 """
 
 import numpy as np
+import os
+
 import pytest
 import scipy.sparse as sps
 
@@ -229,6 +231,10 @@ def test_truncation_parity(rng):
     assert abs(Ac_d2 - Ac_h2).max() < 1e-10
 
 
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference"),
+    reason="reference AmgX tree not mounted in this environment",
+)
 def test_reference_classical_config_device(rng):
     """AMG_CLASSICAL_PMIS.json (D2 + aggressive + interp_max_elements)
     runs fully on the device pipeline with host-parity iterations."""

@@ -131,8 +131,10 @@ def test_ppermute_comm_volume():
     spmv = make_local_spmv(D, "x")
     in_shard = jax.tree.map(lambda _: P("x"), shard)
 
+    from amgx_tpu.core.sharding import shard_map
+
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(in_shard, P("x")),
+        shard_map, mesh=mesh, in_specs=(in_shard, P("x")),
         out_specs=P("x"),
     )
     def f(sh_stk, x_stk):

