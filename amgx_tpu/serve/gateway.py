@@ -735,7 +735,14 @@ class SolveGateway:
         state, budget occupancy, queue depth, breaker count, shed and
         lane-latency summaries, and the flight-recorder ``incidents``
         summary (what has tripped lately — counts by kind; the full
-        incident log is :meth:`debug_report`)."""
+        incident log is :meth:`debug_report`).
+
+        When the service's placement policy keeps per-device failure
+        breakers (affinity/mesh — ``placement.health`` is a
+        :class:`~amgx_tpu.serve.placement.health.DeviceHealthBoard`),
+        its snapshot rides along as ``device_health`` so one probe
+        reads worker AND device health (the fleet frontend polls this
+        over the wire instead of making two round trips)."""
         m = self.metrics
         snap = {
             "incidents": self.recorder.summary(),
@@ -753,4 +760,10 @@ class SolveGateway:
         for lane in LANES:
             p99 = m.lane_percentile(lane, 99.0)
             snap[f"{lane}_p99_s"] = p99
+        board = getattr(self.service.placement, "health", None)
+        if board is not None:
+            try:
+                snap["device_health"] = board.snapshot()
+            except Exception:  # noqa: BLE001 — health must not raise
+                self.metrics.inc("telemetry_errors")
         return snap

@@ -540,6 +540,28 @@ def test_health_snapshot(sysmat):
     assert h["untyped_failures"] == 0
 
 
+def test_health_folds_placement_device_health(sysmat):
+    """health() carries the placement policy's device-health board
+    snapshot (one probe reads worker + device health); the
+    single-device default, which keeps no board, omits the key."""
+    from amgx_tpu.serve.placement.router import AffinityPlacement
+
+    n = sysmat.shape[0]
+    gw = SolveGateway(max_batch=4, placement=AffinityPlacement())
+    h = gw.health()
+    dh = h["device_health"]
+    assert dh["devices"] >= 1 and dh["unhealthy"] == 0
+    assert dh["trips"] == 0 and dh["tripped"] == []
+    # a tripped device surfaces through the same probe
+    gw.service.placement.health.failure(0)
+    dh = gw.health()["device_health"]
+    assert dh["unhealthy"] == 1 and dh["tripped"] == [0]
+    assert dh["trips"] == 1
+    # the default policy has no board: no device_health key at all
+    gw2 = SolveGateway(max_batch=4)
+    assert "device_health" not in gw2.health()
+
+
 def test_async_solve_roundtrip(sysmat):
     n = sysmat.shape[0]
     b = _rhs(n, 3)
