@@ -22,11 +22,16 @@ sharded hierarchy is cached per pattern ``fingerprint`` + values hash
 (``DistributedMatrix.fingerprint``), so repeat fingerprints skip
 setup exactly like the service's ``HierarchyCache``.
 
-Known scope bound (documented, ROADMAP item 2): the service still
-resolves its single-device hierarchy entry for the pattern before any
-placement policy runs; bypassing that host build for patterns too
-large to set up anywhere is the remaining fleet-tier step (each
-worker serving one shard).
+Oversized-pattern bypass: the service consults
+:meth:`DistributedPlacement.entry_for` BEFORE resolving its
+single-device hierarchy entry, so a pattern above ``row_threshold``
+never pays (or even attempts) a single-device setup — the policy
+hands the flusher a lightweight entry stub carrying only what the
+sharded plan reads (pattern, solver tolerance/max_iters, dtype) and
+the hierarchy work happens exclusively in the sharded
+``_solver_for`` path.  The only remaining single-device exposure for
+a bypassed pattern is the quarantine fallback after a FAILED sharded
+group (per-request isolation re-derives a fresh setup).
 
 Outer loops: ``outer="pcg"`` (default) or ``"sstep"`` (s-step PCG —
 two collectives per s steps through the psum'd fused Gram block).
@@ -65,6 +70,37 @@ def _orig_csr(pat):
         [[0], np.cumsum(np.bincount(rows, minlength=pat.n))]
     ).astype(np.int64)
     return indptr, ci[pat.scatter].astype(np.int64)
+
+
+class _BypassOperator:
+    """The ``entry.solver.A`` face of a bypass entry: carries only
+    the dtype the eligibility check reads."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, dtype):
+        self.values = np.empty(0, dtype)
+
+
+class _BypassSolverParams:
+    """The ``entry.solver`` face of a bypass entry: the outer-loop
+    parameters ``plan`` reads (tolerance / max_iters, resolved from
+    the service config WITHOUT running any setup) plus the dtype
+    probe."""
+
+    __slots__ = ("A", "tolerance", "max_iters")
+
+    def __init__(self, dtype, tolerance, max_iters):
+        self.A = _BypassOperator(dtype)
+        self.tolerance = float(tolerance)
+        self.max_iters = int(max_iters)
+
+
+def _bypass_batch_fn(*_a, **_k):  # pragma: no cover — never invoked
+    raise RuntimeError(
+        "distributed-bypass entry has no single-device executable; "
+        "its groups dispatch through DistributedPlacement.plan"
+    )
 
 
 class _ShardedSolver:
@@ -155,6 +191,9 @@ class DistributedPlacement(PlacementPolicy):
         self._lock = threading.Lock()
         self._mesh = None
         self._solvers: dict = {}  # pattern fingerprint -> _ShardedSolver
+        # (fingerprint, dtype str) -> bypass HierarchyEntry stub
+        self._bypass_entries: dict = {}
+        self._bypass_builds = 0
         # telemetry (guarded by _lock)
         self._sharded_groups = 0
         self._fallback_groups = 0
@@ -270,6 +309,53 @@ class DistributedPlacement(PlacementPolicy):
         return ss
 
     # -- PlacementPolicy ------------------------------------------------
+
+    def entry_for(self, service, pattern, dtype):
+        """Serve-tier oversized-pattern bypass: for a pattern this
+        policy WILL shard (rows >= ``row_threshold``, real dtype,
+        >= 2 devices), hand the flusher a lightweight entry — the
+        single-device ``cache.get_or_build`` (and its whole hierarchy
+        setup) never runs.  The stub quacks exactly like the entry
+        fields the dispatch path touches: ``pattern``,
+        ``solver.tolerance`` / ``solver.max_iters`` (resolved from
+        the service config without setup), a truthy ``batch_fn`` (so
+        the sequential fallback is not taken), ``template=None``
+        (ignored by the sharded executable) and a distinct
+        ``signature`` for the bucket-warmup map.  Ineligible patterns
+        return None and resolve the cache unchanged."""
+        dt = np.dtype(dtype)
+        if not (
+            len(self.devices) >= 2
+            and pattern.n >= self.row_threshold
+            and dt.kind == "f"
+        ):
+            return None
+        key = (pattern.fingerprint, str(dt))
+        with self._lock:
+            entry = self._bypass_entries.get(key)
+        if entry is not None:
+            return entry
+        import amgx_tpu.solvers  # noqa: F401 — registry side effects
+        import amgx_tpu.amg  # noqa: F401 — registers "AMG"
+        from amgx_tpu.serve.cache import HierarchyEntry
+        from amgx_tpu.solvers.registry import create_solver, make_nested
+
+        proto = make_nested(create_solver(service.cfg, "default"))
+        entry = HierarchyEntry(
+            solver=_BypassSolverParams(
+                dt, proto.tolerance, proto.max_iters
+            ),
+            template=None,
+            batch_fn=_bypass_batch_fn,
+            signature=("dist-bypass", pattern.fingerprint, str(dt)),
+            pattern=pattern,
+        )
+        with self._lock:
+            if len(self._bypass_entries) >= 64:
+                self._bypass_entries.clear()
+            entry = self._bypass_entries.setdefault(key, entry)
+            self._bypass_builds += 1
+        return entry
 
     def plan(self, service, entry, Bb: int) -> GroupPlan:
         if not self._eligible(entry, Bb):
@@ -426,6 +512,7 @@ class DistributedPlacement(PlacementPolicy):
                 "sharded_groups_total": self._sharded_groups,
                 "fallback_groups_total": self._fallback_groups,
                 "sharded_solves_total": self._solves,
+                "bypassed_builds_total": self._bypass_builds,
                 "setups_total": self._setups,
                 "setup_seconds_total": self._setup_s,
                 "iterations_total": self._iters_total,

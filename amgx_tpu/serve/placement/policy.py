@@ -141,6 +141,20 @@ class PlacementPolicy:
     def plan(self, service, entry, Bb: int) -> GroupPlan:
         raise NotImplementedError
 
+    def entry_for(self, service, pattern, dtype):
+        """Pattern-level bypass of the single-device hierarchy build.
+
+        The flusher consults this BEFORE resolving the pattern's
+        entry through the service's ``HierarchyCache``: a policy that
+        can execute the pattern without any single-device setup
+        (distributed row-sharding of a pattern too large to set up on
+        one chip) returns a lightweight entry stub here and the
+        expensive ``cache.get_or_build`` never runs.  ``None`` — the
+        default — resolves the cache normally (bitwise-unchanged
+        behavior for every shipped policy except
+        :class:`~amgx_tpu.serve.placement.distributed.DistributedPlacement`)."""
+        return None
+
     def warm(self, service, entry, Bb: int) -> None:
         """Background-compile the executable a future ``plan`` for
         this (entry, bucket) would resolve."""

@@ -1943,15 +1943,26 @@ class BatchedSolveService:
                 return
             # fall through: half-open probe attempts one batched group
         try:
-            vals0 = grp.pattern.extract_values(
-                grp.slot.vals[live[0].row]
+            # oversized-pattern bypass: a policy that executes the
+            # pattern without any single-device hierarchy (distributed
+            # row-sharding above AMGX_TPU_DIST_ROWS) supplies its own
+            # lightweight entry BEFORE the cache resolves — the
+            # single-device setup for a too-big pattern never runs
+            entry = self.placement.entry_for(
+                self, grp.pattern, grp.dtype
             )
-            entry = self.cache.get_or_build(
-                grp.pattern,
-                self.cfg_key,
-                grp.dtype,
-                lambda: self._build_entry(grp.pattern, vals0, grp.dtype),
-            )
+            if entry is None:
+                vals0 = grp.pattern.extract_values(
+                    grp.slot.vals[live[0].row]
+                )
+                entry = self.cache.get_or_build(
+                    grp.pattern,
+                    self.cfg_key,
+                    grp.dtype,
+                    lambda: self._build_entry(
+                        grp.pattern, vals0, grp.dtype
+                    ),
+                )
             if entry.batch_fn is None:
                 self._execute_sequential(entry, grp, live)
                 self._breaker_success(fp)

@@ -305,6 +305,53 @@ def test_distributed_placement_end_to_end():
     )
 
 
+def test_distributed_bypass_skips_single_device_setup():
+    """Serve-tier oversized-pattern bypass: a pattern above
+    row_threshold is sharded WITHOUT the service ever resolving (or
+    building) its single-device hierarchy entry — no cache entry, no
+    setup counted — while results stay correct and a small pattern
+    still builds the normal cached entry."""
+    from amgx_tpu.serve.placement import DistributedPlacement
+    from amgx_tpu.serve.service import BatchedSolveService
+
+    Asp = poisson_2d_5pt(40).to_scipy()  # 1600 rows -> bypassed
+    small = poisson_2d_5pt(8).to_scipy()  # 64 rows -> normal entry
+    b = np.ones(Asp.shape[0])
+    pol = DistributedPlacement(
+        row_threshold=1024, grade_lower=0, consolidate_rows=64
+    )
+    svc = BatchedSolveService(placement=pol)
+    t1 = svc.submit(Asp, b)
+    svc.flush()
+    r1 = t1.result()
+    assert int(r1.status) == 0
+    x = np.asarray(r1.x)
+    rel = np.linalg.norm(Asp @ x - b) / np.linalg.norm(b)
+    assert rel < 1e-6, rel
+    # the single-device pipeline never touched the big pattern: no
+    # hierarchy setup ran and nothing landed in the hierarchy cache
+    assert svc.metrics.get("setups") == 0
+    assert svc.metrics.get("cache_misses") == 0
+    pat = svc._patterns[next(iter(svc._patterns))]
+    assert svc.cache.peek(
+        pat.fingerprint, svc.cfg_key, np.dtype(np.float64)
+    ) is None
+    snap = pol.telemetry_snapshot()
+    assert snap["sharded_groups_total"] == 1
+    assert snap["bypassed_builds_total"] == 1
+    # repeat fingerprint reuses the SAME bypass entry (one build)
+    t2 = svc.submit(Asp, 2.0 * b)
+    svc.flush()
+    assert int(t2.result().status) == 0
+    assert pol.telemetry_snapshot()["bypassed_builds_total"] == 1
+    assert svc.metrics.get("setups") == 0
+    # a small pattern still resolves the normal single-device entry
+    t3 = svc.submit(small, np.ones(64))
+    svc.flush()
+    assert int(t3.result().status) == 0
+    assert svc.metrics.get("setups") == 1
+
+
 def test_distributed_placement_spec_string():
     from amgx_tpu.serve.placement import (
         DistributedPlacement,
