@@ -209,6 +209,11 @@ class _SolverHandle:
         # optional fleet gateway in front of it (admission control /
         # load shedding), built when AMGX_TPU_CAPI_ADMISSION is set
         self.batch_gateway = None
+        # multi-process fleet client (amgx_tpu.fleet), built when
+        # AMGX_TPU_FLEET points at a worker registry / address list;
+        # when set, batch solves cross the wire instead of building a
+        # local serve stack
+        self.batch_fleet = None
         # streaming-session manager (solver_session_*), lazily built
         # over the same batch service/gateway
         self.session_manager = None
@@ -1085,11 +1090,76 @@ def solver_get_iteration_residual(slv_h: int, it: int, idx: int = 0):
     return float(hist[it, idx])
 
 
+def _build_fleet_front(spec: str):
+    """``AMGX_TPU_FLEET`` -> a connected FleetFrontend.  The spec is
+    either a worker-registry DIRECTORY (every live announced worker
+    attaches) or an explicit comma-separated ``host:port`` list.
+    Malformed specs and empty/unreachable fleets raise typed
+    (RC_BAD_CONFIGURATION / RC_IO_ERROR) — set-but-broken fails
+    loudly on every call."""
+    import os
+
+    from amgx_tpu.fleet.frontend import FleetFrontend
+    from amgx_tpu.fleet.registry import WorkerRecord, WorkerRegistry
+
+    spec = spec.strip()
+    if os.path.isdir(spec):
+        registry = WorkerRegistry(spec)
+        records = registry.workers()
+        if not records:
+            raise AMGXError(
+                RC_BAD_CONFIGURATION,
+                f"AMGX_TPU_FLEET registry {spec!r} has no live "
+                "workers",
+            )
+    else:
+        records = []
+        for i, item in enumerate(spec.split(",")):
+            host, sep, port = item.strip().rpartition(":")
+            try:
+                port_i = int(port)
+            except ValueError:
+                port_i = -1
+            if not sep or not host or not 0 < port_i < 65536:
+                raise AMGXError(
+                    RC_BAD_CONFIGURATION,
+                    "AMGX_TPU_FLEET must be a registry directory or "
+                    f"a host:port list, got {item.strip()!r}",
+                ) from None
+            records.append(WorkerRecord(
+                f"addr{i}", host, port_i, pid=0, slot=i,
+            ))
+    front = FleetFrontend(capacity=max(len(records), 1))
+    try:
+        for rec in records:
+            front.attach(rec)
+    except OSError as e:
+        front.close()
+        raise AMGXError(
+            RC_IO_ERROR,
+            f"AMGX_TPU_FLEET: cannot reach fleet worker: {e}",
+        ) from None
+    return front
+
+
 def _ensure_batch_front(s):
     """Build the handle's serve layer on first use (shared by
     solver_solve_batch and solver_session_create); returns the
     submit front (gateway when admission control is enabled, else
     the bare service)."""
+    # AMGX_TPU_FLEET=<registry-dir | host:port[,host:port...]>: route
+    # batch solves to a multi-process fleet (amgx_tpu.fleet) instead
+    # of an embedded serve stack.  Same strict set-but-malformed-
+    # fails-loudly contract as AMGX_TPU_CAPI_ADMISSION below: a typo
+    # must fail EVERY call typed, never silently solve locally.
+    if s.batch_fleet is None:
+        import os
+
+        fleet_env = os.environ.get("AMGX_TPU_FLEET", "")
+        if fleet_env:
+            s.batch_fleet = _build_fleet_front(fleet_env)
+    if s.batch_fleet is not None:
+        return s.batch_fleet
     if s.batch_service is None:
         import os
 
@@ -1218,7 +1288,7 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
     # unexpected propagates to _rc_guard so host apps still see a
     # diagnostic RC instead of a silent RC_OK
     pending = []
-    front = s.batch_gateway or s.batch_service
+    front = s.batch_fleet or s.batch_gateway or s.batch_service
     for sys_, sh in zip(systems, sol_handles):
         n = sys_[0].n_rows * sys_[0].block_size
         try:
@@ -1231,8 +1301,9 @@ def solver_solve_batch(slv_h: int, mtx_handles, rhs_handles, sol_handles):
             _get(sh, _Vector)._batch_owner = s
         pending.append((t, n, sh))
     # dispatch without fetching: the device executes while the host
-    # app goes on; results land on the first batch accessor
-    s.batch_service.flush()
+    # app goes on; results land on the first batch accessor (a fleet
+    # front's flush is a no-op — workers flush on their own cadence)
+    front.flush()
     s.batch_pending = pending
     s.batch_results = None
     return RC_OK
@@ -1437,7 +1508,12 @@ def solver_load(slv_h: int, path: str):
 
 
 def solver_destroy(slv_h):
-    _objects.pop(slv_h, None)
+    s = _objects.pop(slv_h, None)
+    if s is not None and getattr(s, "batch_fleet", None) is not None:
+        try:
+            s.batch_fleet.close()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
     return RC_OK
 
 
@@ -1497,6 +1573,16 @@ def solver_session_create(slv_h: int, mtx_h: int) -> int:
     if m.A is None:
         raise AMGXError(RC_BAD_PARAMETERS, "matrix not uploaded")
     front = _ensure_batch_front(s)
+    if s.batch_fleet is not None:
+        # streaming sessions stay a wire-native feature of the fleet
+        # tier (fleet worker session verbs); the C API's embedded
+        # session manager needs a LOCAL serve stack
+        raise AMGXError(
+            RC_NOT_SUPPORTED_TARGET,
+            "solver_session_create is not available with "
+            "AMGX_TPU_FLEET (sessions ride the fleet wire protocol, "
+            "not the embedded session manager)",
+        )
     if s.session_manager is None:
         from amgx_tpu.sessions import SessionManager
 

@@ -1,0 +1,58 @@
+"""amgx_tpu.fleet — a multi-process solve fleet over RPC.
+
+One process per TPU slice, each wrapping the full single-process
+serving stack (:class:`~amgx_tpu.serve.gateway.SolveGateway`), wired
+together by a stdlib-only length-prefixed wire protocol
+(:mod:`~amgx_tpu.fleet.wire`), discovered through a file-based
+registry (:mod:`~amgx_tpu.fleet.registry`), and fronted by a client
+that routes on fingerprint affinity ACROSS processes with per-worker
+circuit breakers (:mod:`~amgx_tpu.fleet.frontend` /
+:mod:`~amgx_tpu.fleet.router`).  Rolling restarts drain through the
+shared :class:`~amgx_tpu.store.store.ArtifactStore` so a replacement
+worker's first repeat fingerprint is a cache HIT
+(:mod:`~amgx_tpu.fleet.lifecycle`).
+
+Heavy imports (jax, the serve stack) stay inside the modules that
+need them — importing this package costs nothing, so the C API can
+probe ``AMGX_TPU_FLEET`` cheaply.
+"""
+
+from amgx_tpu.fleet.wire import (  # noqa: F401
+    WireClosed,
+    WireError,
+    marshal_error,
+    pack_frame,
+    read_frame,
+    read_frame_async,
+    unmarshal_error,
+)
+from amgx_tpu.fleet.registry import (  # noqa: F401
+    WorkerRecord,
+    WorkerRegistry,
+)
+from amgx_tpu.fleet.router import FleetRouter  # noqa: F401
+
+__all__ = [
+    "WireClosed", "WireError", "marshal_error", "pack_frame",
+    "read_frame", "read_frame_async", "unmarshal_error",
+    "WorkerRecord", "WorkerRegistry", "FleetRouter",
+    "FleetFrontend", "FleetTicket", "FleetWorker",
+    "FleetSupervisor", "launch_fleet",
+]
+
+
+def __getattr__(name):
+    # lazy: frontend/worker/lifecycle pull in the serve stack
+    if name in ("FleetFrontend", "FleetTicket"):
+        from amgx_tpu.fleet import frontend
+
+        return getattr(frontend, name)
+    if name == "FleetWorker":
+        from amgx_tpu.fleet.worker import FleetWorker
+
+        return FleetWorker
+    if name in ("FleetSupervisor", "launch_fleet"):
+        from amgx_tpu.fleet import lifecycle
+
+        return getattr(lifecycle, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

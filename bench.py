@@ -264,6 +264,50 @@ def _fleet_record():
         return {"error": str(e)}
 
 
+def _fleet_wire_record():
+    """Multi-process fleet over the wire: 2-worker scaling,
+    cross-process affinity, typed sheds, rolling restart and kill -9
+    floors against real worker subprocesses (ci/fleet_bench.py,
+    reduced durations).  Guarded — the fleet-wire record must never
+    take the headline bench down."""
+    try:
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.fleet_bench import run as fleet_wire_run
+
+        rec, problems = fleet_wire_run(
+            calib_s=1.0, restart_load_s=2.0
+        )
+        out = {
+            k: rec[k]
+            for k in (
+                "value",
+                "unit",
+                "rate1_per_s",
+                "rate2_per_s",
+                "host_cpus",
+                "speedup_floor",
+                "affinity_hit_ratio",
+                "warm_boots",
+                "sheds",
+                "restart",
+                "kill9",
+                "wire_latency",
+                "ok",
+            )
+            if k in rec
+        }
+        if problems:
+            out["problems"] = problems
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: fleet_wire record skipped: {e}",
+              file=sys.stderr)
+        return {"error": str(e)}
+
+
 def _store_record():
     """Setup-artifact store: cold setup vs restore speedup plus the
     warm-boot serving scenario (ci/store_bench.py, one small case).
@@ -736,6 +780,10 @@ def main():
     fleet_rec = _fleet_record()
     print(f"bench: fleet {fleet_rec}", file=sys.stderr)
 
+    # ---- multi-process fleet over the wire -------------------------
+    fleet_wire_rec = _fleet_wire_record()
+    print(f"bench: fleet_wire {fleet_wire_rec}", file=sys.stderr)
+
     # ---- setup-artifact store --------------------------------------
     store_rec = _store_record()
     print(f"bench: store {store_rec}", file=sys.stderr)
@@ -787,6 +835,7 @@ def main():
                 "solve": solve_rec,
                 "serve": serve_rec,
                 "fleet": fleet_rec,
+                "fleet_wire": fleet_wire_rec,
                 "store": store_rec,
                 "setup": setup_rec,
                 "telemetry": telemetry_rec,

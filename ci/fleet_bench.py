@@ -1,0 +1,445 @@
+"""Multi-process fleet bench: real worker subprocesses over the wire.
+
+Prints ONE JSON line (same contract as serve_bench/load_bench):
+{"metric": "fleet_wire", "value": <2-worker speedup>, ...}.
+
+Where ci/load_bench.py stresses ONE gateway in-process, this bench
+spawns actual ``python -m amgx_tpu.fleet.worker`` processes and
+asserts the cross-process contracts end to end:
+
+1. **Scaling** — closed-loop solves/s over a 4-fingerprint Poisson
+   mix, N=1 worker vs N=2 workers sharing one artifact store.  On a
+   host with >= 2 usable cores the two-worker fleet must reach
+   >= 1.5x the single worker (real process parallelism, not wire
+   overhead).  On a single-core host (starved CI containers) process
+   parallelism is physically impossible, so — like load_bench's
+   floored offered rate — the floor degrades to a no-collapse sanity
+   check (>= 0.5x) and the record says which floor applied.
+2. **Affinity** — during the N=2 phase every repeat fingerprint must
+   land on the worker whose caches are warm: hit ratio >= 0.90 after
+   warm-up.
+3. **Typed sheds over the wire** — a worker spawned with a tiny
+   ``--max-inflight`` is flooded through a no-retry frontend; every
+   reject must unmarshal as a typed AdmissionRejected/Overloaded
+   carrying ``retry_after_s``, and nothing may surface untyped.
+4. **Rolling restart under load** — mid-closed-loop,
+   ``FleetSupervisor.rolling_restart`` drains worker 0 and replaces
+   it: zero lost tickets (every client solve settles ok-or-typed),
+   drain report shows failed == 0 and timed_out == 0 with the cache
+   exported, and the replacement's gateway reports **setups == 0**
+   with ``warm_booted >= 1`` (warm boot from the shared store).
+5. **kill -9** — in-flight tickets on the victim settle requeued-or-
+   typed (never lost, never a hang), the worker breaker trips, and
+   after a replacement attaches at the SAME slot the half-open probe
+   closes the breaker again.
+
+Floors (non-zero exit on violation): all five above, plus zero
+unhandled (non-taxonomy) exceptions anywhere.
+
+Run on the CPU backend (the tier the acceptance gate measures):
+
+    JAX_PLATFORMS=cpu python ci/fleet_bench.py [--calib 2.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+# runnable from any cwd: the repo root precedes ci/ on the path
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+_SHAPES = ((12, 12), (13, 13), (14, 14), (15, 15))
+_SPAWN_TIMEOUT_S = 180.0
+
+
+def _systems():
+    import numpy as np
+
+    from amgx_tpu.io.poisson import poisson_scipy
+
+    out = []
+    for i, shape in enumerate(_SHAPES):
+        sp = poisson_scipy(shape).tocsr()
+        sp.sort_indices()
+        b = np.random.default_rng(i).standard_normal(sp.shape[0])
+        out.append((sp, b))
+    return out
+
+
+class _Outcomes:
+    """Thread-safe settlement ledger: every submit must land here."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ok = 0
+        self.typed = 0
+        self.unhandled = []
+
+    def settle(self, kind, detail=None):
+        with self.lock:
+            if kind == "ok":
+                self.ok += 1
+            elif kind == "typed":
+                self.typed += 1
+            else:
+                self.unhandled.append(detail)
+
+    def totals(self):
+        with self.lock:
+            return {
+                "ok": self.ok,
+                "typed": self.typed,
+                "unhandled": len(self.unhandled),
+            }
+
+
+def _closed_loop(front, systems, duration_s, out, threads=4,
+                 timeout_s=120.0):
+    """K threads, each pinned to one fingerprint, solve back to back
+    for ``duration_s``.  Pinning keeps the affinity question honest:
+    a repeat of fp i is a warm hit or the router is broken."""
+    from amgx_tpu.core.errors import AMGXTPUError
+
+    stop = time.monotonic() + duration_s
+
+    def worker(i):
+        A, b = systems[i % len(systems)]
+        while time.monotonic() < stop:
+            try:
+                front.solve(A, b, deadline_s=timeout_s,
+                            timeout=timeout_s)
+                out.settle("ok")
+            except AMGXTPUError:
+                out.settle("typed")
+            except Exception as e:  # noqa: BLE001 — the gate itself
+                out.settle("unhandled", f"{type(e).__name__}: {e}")
+
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+          for i in range(threads)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return time.monotonic() - t0
+
+
+def run(calib_s=2.0, restart_load_s=4.0, shed_requests=24,
+        probe_solve_cap=64):
+    from amgx_tpu.core.errors import (
+        AdmissionRejected,
+        AMGXTPUError,
+        DeviceLostError,
+    )
+    from amgx_tpu.fleet.frontend import FleetFrontend
+    from amgx_tpu.fleet.lifecycle import FleetSupervisor
+    from amgx_tpu.serve.retry import RetryPolicy
+
+    systems = _systems()
+    tmp = tempfile.mkdtemp(prefix="amgx_fleet_wire_")
+    sup = FleetSupervisor(
+        tmp + "/registry", tmp + "/store",
+        spawn_timeout_s=_SPAWN_TIMEOUT_S,
+        worker_args=["--max-batch", "8"],
+    )
+    problems = []
+    rec = {"metric": "fleet_wire"}
+    front = None
+    try:
+        # ---- phase 1: N=1 baseline (cold setups, then steady) ------
+        rec0 = sup.spawn(0)
+        front1 = FleetFrontend(register_telemetry=False)
+        front1.attach(rec0)
+        for A, b in systems:  # setups + compiles out of the clock
+            front1.solve(A, b, timeout=180.0)
+        out1 = _Outcomes()
+        el1 = _closed_loop(front1, systems, calib_s, out1)
+        t1 = out1.totals()
+        rate1 = t1["ok"] / el1 if el1 > 0 else 0.0
+        # drain exports the warm caches to the SHARED store, so the
+        # N=2 fleet below warm-boots instead of re-paying setup
+        drain0 = front1.drain_worker(0, timeout=120.0)
+        sup.reap(rec0.worker_id)
+        front1.close()
+        if out1.unhandled:
+            problems.append(
+                f"N=1 phase unhandled: {out1.unhandled[:3]}"
+            )
+
+        # ---- phase 2: N=2 scaling + cross-process affinity ---------
+        records = sup.launch(2)
+        front = FleetFrontend(register_telemetry=False)
+        for r in records:
+            front.attach(r)
+        for A, b in systems:  # route once: fingerprints pick workers
+            front.solve(A, b, timeout=180.0)
+        snap_pre = front.telemetry_snapshot()["routing"]
+        out2 = _Outcomes()
+        el2 = _closed_loop(front, systems, calib_s, out2)
+        t2 = out2.totals()
+        rate2 = t2["ok"] / el2 if el2 > 0 else 0.0
+        snap_post = front.telemetry_snapshot()["routing"]
+        hits = snap_post["hits"] - snap_pre["hits"]
+        misses = snap_post["misses"] - snap_pre["misses"]
+        hit_ratio = hits / (hits + misses) if (hits + misses) else 0.0
+        speedup = rate2 / rate1 if rate1 > 0 else 0.0
+        try:
+            host_cpus = len(os.sched_getaffinity(0))
+        except AttributeError:
+            host_cpus = os.cpu_count() or 1
+        speedup_floor = 1.5 if host_cpus >= 2 else 0.5
+        warm_boots = [
+            front.health(r.slot)["worker"]["warm_booted"]
+            for r in records
+        ]
+        if out2.unhandled:
+            problems.append(
+                f"N=2 phase unhandled: {out2.unhandled[:3]}"
+            )
+        if speedup < speedup_floor:
+            problems.append(
+                f"2-worker speedup {speedup:.2f}x < "
+                f"{speedup_floor}x floor on {host_cpus} cpu(s) "
+                f"({rate1:.1f} -> {rate2:.1f} solves/s)"
+            )
+        if hit_ratio < 0.90:
+            problems.append(
+                f"affinity hit ratio {hit_ratio:.2f} < 0.90 floor"
+            )
+        if min(warm_boots) < 1:
+            problems.append(
+                f"N=2 workers did not warm-boot from the shared "
+                f"store: {warm_boots}"
+            )
+
+        # ---- phase 3: typed sheds over the wire --------------------
+        # a deliberately tiny worker (max_inflight=2) flooded through
+        # a no-retry frontend: every reject must round-trip typed
+        shed_rec = sup.spawn(3, extra_args=["--max-inflight", "2"])
+        front3 = FleetFrontend(
+            register_telemetry=False,
+            retry_policy=RetryPolicy(max_attempts=1),
+        )
+        front3.attach(shed_rec)
+        A0, b0 = systems[0]
+        tickets = [
+            front3.submit(A0, b0, deadline_s=120.0)
+            for _ in range(shed_requests)
+        ]
+        shed = {"ok": 0, "typed_sheds": 0, "other_typed": 0,
+                "untyped": 0, "missing_retry_hint": 0}
+        for t in tickets:
+            try:
+                t.result(timeout=120.0)
+                shed["ok"] += 1
+            except AdmissionRejected as e:  # includes Overloaded
+                shed["typed_sheds"] += 1
+                if getattr(e, "retry_after_s", None) is None:
+                    shed["missing_retry_hint"] += 1
+            except AMGXTPUError:
+                shed["other_typed"] += 1
+            except Exception:  # noqa: BLE001 — the gate itself
+                shed["untyped"] += 1
+        front3.close()
+        sup.kill(shed_rec.worker_id)
+        sup.reap(shed_rec.worker_id)
+        if shed["typed_sheds"] == 0:
+            problems.append(
+                f"overload produced no typed sheds over the wire: "
+                f"{shed}"
+            )
+        if shed["untyped"] or shed["missing_retry_hint"]:
+            problems.append(f"untyped or hint-less sheds: {shed}")
+
+        # ---- phase 4: rolling restart under load -------------------
+        out4 = _Outcomes()
+        restart_out = {}
+        restart_err = []
+
+        def do_restart():
+            time.sleep(restart_load_s * 0.25)
+            try:
+                restart_out.update(sup.rolling_restart(
+                    records[0].worker_id, front, timeout_s=120.0,
+                ))
+            except Exception as e:  # noqa: BLE001 — the gate itself
+                restart_err.append(f"{type(e).__name__}: {e}")
+
+        restarter = threading.Thread(target=do_restart, daemon=True)
+        restarter.start()
+        _closed_loop(front, systems, restart_load_s, out4)
+        restarter.join(timeout=180.0)
+        t4 = out4.totals()
+        drain4 = restart_out.get("drain", {})
+        h_new = front.health(0)
+        if restart_err or restarter.is_alive():
+            problems.append(
+                f"rolling restart failed: {restart_err or 'hung'}"
+            )
+        if out4.unhandled:
+            problems.append(
+                f"restart-phase lost/unhandled tickets: "
+                f"{out4.unhandled[:3]}"
+            )
+        if drain4.get("failed", 1) or drain4.get("timed_out", 1):
+            problems.append(
+                f"restart drain not lossless: {drain4}"
+            )
+        if drain4.get("exported", 0) < 1:
+            problems.append(f"restart drain exported nothing: {drain4}")
+        if h_new["serve"]["setups"] != 0:
+            problems.append(
+                f"replacement paid {h_new['serve']['setups']} setups "
+                f"instead of warm-booting"
+            )
+        if h_new["worker"]["warm_booted"] < 1:
+            problems.append("replacement did not warm-boot")
+        records[0] = restart_out.get("replacement", records[0])
+
+        # ---- phase 5: kill -9, requeue, breaker half-open ----------
+        # a COLD fingerprint: its first solve pays setup + compile,
+        # which is the wide in-flight window the kill lands in
+        import numpy as np
+
+        from amgx_tpu.io.poisson import poisson_scipy
+
+        A_cold = poisson_scipy((17, 17)).tocsr()
+        A_cold.sort_indices()
+        b_cold = np.random.default_rng(99).standard_normal(
+            A_cold.shape[0]
+        )
+        kill_tickets = [
+            front.submit(A_cold, b_cold, deadline_s=300.0)
+            for _ in range(3)
+        ]
+        victim_slot = kill_tickets[0]._pending.slot
+        victim = next(r for r in records if r.slot == victim_slot)
+        sup.kill(victim.worker_id)
+        kill_outcomes = {"ok": 0, "typed": 0, "untyped": 0}
+        for t in kill_tickets:
+            try:
+                t.result(timeout=180.0)
+                kill_outcomes["ok"] += 1
+            except DeviceLostError:
+                kill_outcomes["typed"] += 1
+            except AMGXTPUError:
+                kill_outcomes["typed"] += 1
+            except Exception:  # noqa: BLE001 — the gate itself
+                kill_outcomes["untyped"] += 1
+        snap5 = front.telemetry_snapshot()
+        if kill_outcomes["untyped"]:
+            problems.append(
+                f"kill -9 left untyped outcomes: {kill_outcomes}"
+            )
+        if snap5["routing"]["health"]["trips"] < 1:
+            problems.append("kill -9 did not trip the worker breaker")
+        if snap5["counters"]["conn_losses"] < 1:
+            problems.append("kill -9 did not register a conn loss")
+
+        # replacement at the SAME slot: the half-open probe must
+        # close the inherited breaker within a bounded solve budget
+        rep = sup.spawn(victim_slot)
+        front.attach(rep)
+        closes0 = snap5["routing"]["health"]["closes"]
+        probe_solves = 0
+        A_p, b_p = systems[victim_slot % len(systems)]
+        while (front.router.board.tripped_indices()
+               and probe_solves < probe_solve_cap):
+            try:
+                front.solve(A_p, b_p, timeout=180.0)
+            except AMGXTPUError:
+                pass
+            probe_solves += 1
+        snap6 = front.telemetry_snapshot()
+        closed = not front.router.board.tripped_indices()
+        if not closed:
+            problems.append(
+                f"breaker still open after {probe_solves} solves "
+                f"against the replacement slot"
+            )
+        if snap6["routing"]["health"]["closes"] - closes0 < 1:
+            problems.append("half-open probe never closed the breaker")
+
+        rec.update({
+            "value": round(speedup, 3),
+            "unit": "2-worker over 1-worker closed-loop solves/s",
+            "rate1_per_s": round(rate1, 2),
+            "rate2_per_s": round(rate2, 2),
+            "host_cpus": host_cpus,
+            "speedup_floor": speedup_floor,
+            "affinity_hit_ratio": round(hit_ratio, 4),
+            "warm_boots": warm_boots,
+            "baseline_drain": {
+                k: drain0.get(k) for k in
+                ("settled", "failed", "timed_out", "exported")
+            },
+            "sheds": shed,
+            "restart": {
+                "settled_ok": t4["ok"],
+                "settled_typed": t4["typed"],
+                "unhandled": t4["unhandled"],
+                "drain": drain4,
+                "exit_code": restart_out.get("exit_code"),
+                "replacement_setups": h_new["serve"]["setups"],
+                "replacement_warm_booted":
+                    h_new["worker"]["warm_booted"],
+            },
+            "kill9": {
+                "outcomes": kill_outcomes,
+                "trips": snap5["routing"]["health"]["trips"],
+                "conn_losses": snap5["counters"]["conn_losses"],
+                "requeued": snap5["counters"]["requeued"],
+                "requeue_failures":
+                    snap5["counters"]["requeue_failures"],
+                "probe_solves_to_close": probe_solves,
+                "breaker_closed": closed,
+            },
+            "wire_latency": front.telemetry_snapshot()["wire_latency"],
+            "ok": not problems,
+        })
+    finally:
+        try:
+            if front is not None:
+                front.close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        sup.terminate_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return rec, problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON record to this file")
+    ap.add_argument("--calib", type=float, default=2.0,
+                    help="closed-loop seconds per throughput phase")
+    ap.add_argument("--restart-load", type=float, default=4.0,
+                    help="seconds of load around the rolling restart")
+    args = ap.parse_args(argv)
+
+    import amgx_tpu
+
+    amgx_tpu.initialize()
+    rec, problems = run(
+        calib_s=args.calib, restart_load_s=args.restart_load,
+    )
+    line = json.dumps(rec)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    for p in problems:
+        print(f"fleet_bench: {p}", file=sys.stderr)
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,6 +40,18 @@ JAX_PLATFORMS=cpu python ci/serve_bench.py
 # mid-load drain that loses an admitted ticket / exports nothing.
 JAX_PLATFORMS=cpu python ci/load_bench.py
 
+# ---- multi-process fleet: wire + restart + breaker floors ------------
+# One JSON line; non-zero exit when real worker subprocesses driven
+# over the wire miss the scaling floor (2-worker >= 1.5x one worker on
+# a >= 2-core host; no-collapse sanity floor on starved single-core
+# CI), repeat fingerprints miss the cross-process affinity floor, any
+# shed crosses the wire untyped or without retry_after_s, a mid-load
+# rolling restart loses a ticket / pays a setup on the warm-booted
+# replacement, or a kill -9 fails to requeue-or-type every in-flight
+# ticket, trip the worker breaker, and half-open-close it on the
+# replacement.
+JAX_PLATFORMS=cpu python ci/fleet_bench.py
+
 # ---- setup-artifact store: restore + warm-boot floors ----------------
 # One JSON line; non-zero exit when load_setup restore drops below 3x
 # over cold setup on the Poisson suite, or a warm-booted service fails

@@ -1,0 +1,474 @@
+"""Fleet wire-protocol, router, and registry units — in-process.
+
+The garbage/fuzz suite is the satellite contract: truncated frames,
+oversize length prefixes, mid-frame disconnects, and unknown verbs
+all surface as TYPED errors (WireError and friends), never hangs or
+unhandled tracebacks.  The multi-process end-to-end suite lives in
+tests/test_fleetproc.py.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from amgx_tpu.core.errors import (
+    AMGXTPUError,
+    AdmissionRejected,
+    DeadlineExceededError,
+    DeviceLostError,
+    NonFiniteValuesError,
+    Overloaded,
+    RC_IO_ERROR,
+    ResourceError,
+    SetupError,
+    SingularDiagonalError,
+    SolveBreakdown,
+    StoreError,
+)
+from amgx_tpu.fleet import wire
+from amgx_tpu.fleet.registry import WorkerRecord, WorkerRegistry
+from amgx_tpu.fleet.router import FleetRouter
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------------
+# framing round trips
+
+
+def _roundtrip(header, arrays=None):
+    frame = wire.pack_frame(header, arrays)
+    return wire.read_frame(io.BytesIO(frame))
+
+
+def test_frame_roundtrip_header_only():
+    h, arrs = _roundtrip({"verb": "ping", "rid": "r-1"})
+    assert h == {"verb": "ping", "rid": "r-1"}
+    assert arrs == {}
+
+
+def test_frame_roundtrip_arrays():
+    arrays = {
+        "b": np.linspace(0, 1, 7),
+        "idx": np.arange(5, dtype=np.int32),
+        "m": np.ones((3, 4), np.float32),
+        "empty": np.empty(0, np.float64),
+    }
+    h, out = _roundtrip({"verb": "submit", "rid": "r", "n": 7}, arrays)
+    assert h["n"] == 7
+    for name, arr in arrays.items():
+        assert out[name].dtype == arr.dtype
+        assert out[name].shape == arr.shape
+        np.testing.assert_array_equal(out[name], arr)
+
+
+def test_frame_zero_dim_arrays_between_others():
+    # a 0-d scalar array mid-blob must not swallow its successors
+    arrays = {
+        "iters": np.asarray(7, np.int32),
+        "x": np.arange(3.0),
+        "status": np.asarray(0, np.int32),
+    }
+    _, out = _roundtrip({}, arrays)
+    assert out["iters"].shape == () and int(out["iters"]) == 7
+    np.testing.assert_array_equal(out["x"], np.arange(3.0))
+    assert int(out["status"]) == 0
+
+
+def test_frame_arrays_are_copies():
+    src = np.arange(4.0)
+    _, out = _roundtrip({"v": 1}, {"a": src})
+    out["a"][0] = 99.0
+    assert src[0] == 0.0
+    assert out["a"].flags.writeable
+
+
+def test_frame_non_contiguous_array():
+    src = np.arange(20.0).reshape(4, 5)[:, ::2]
+    _, out = _roundtrip({}, {"a": src})
+    np.testing.assert_array_equal(out["a"], src)
+
+
+def test_multiple_frames_on_one_stream():
+    buf = io.BytesIO(
+        wire.pack_frame({"rid": "a"}) + wire.pack_frame({"rid": "b"})
+    )
+    assert wire.read_frame(buf)[0]["rid"] == "a"
+    assert wire.read_frame(buf)[0]["rid"] == "b"
+    with pytest.raises(wire.WireClosed):
+        wire.read_frame(buf)
+
+
+# ---------------------------------------------------------------------------
+# garbage: every malformed input is a TYPED error, never a hang
+
+
+def test_clean_eof_is_wire_closed():
+    with pytest.raises(wire.WireClosed):
+        wire.read_frame(io.BytesIO(b""))
+
+
+def test_truncated_prefix():
+    with pytest.raises(wire.WireError, match="truncated frame prefix"):
+        wire.read_frame(io.BytesIO(b"AMG"))
+
+
+def test_bad_magic():
+    junk = b"HTTP/1.1 200 OK\r\n\r\n" + b"\x00" * 64
+    with pytest.raises(wire.WireError, match="bad frame magic"):
+        wire.read_frame(io.BytesIO(junk))
+
+
+def test_bad_version():
+    frame = bytearray(wire.pack_frame({"v": 1}))
+    frame[4] = 99
+    with pytest.raises(wire.WireError, match="unsupported wire version"):
+        wire.read_frame(io.BytesIO(bytes(frame)))
+
+
+def test_oversize_header_prefix_refused_before_read():
+    prefix = struct.pack(
+        "!4sB3xIQ", wire.MAGIC, wire.VERSION,
+        wire.MAX_HEADER_BYTES + 1, 0,
+    )
+    with pytest.raises(wire.WireError, match="oversize header"):
+        wire.read_frame(io.BytesIO(prefix))
+
+
+def test_oversize_blob_prefix_refused_before_read():
+    # a corrupt u64 length must not become a giant allocation: the
+    # prefix alone is enough to refuse
+    prefix = struct.pack(
+        "!4sB3xIQ", wire.MAGIC, wire.VERSION, 2, 1 << 62,
+    )
+    with pytest.raises(wire.WireError, match="oversize blob"):
+        wire.read_frame(io.BytesIO(prefix))
+
+
+def test_mid_frame_disconnect():
+    frame = wire.pack_frame({"verb": "submit"}, {"b": np.ones(100)})
+    with pytest.raises(wire.WireError, match="mid-frame disconnect"):
+        wire.read_frame(io.BytesIO(frame[:-17]))
+
+
+def test_malformed_json_header():
+    hb = b"{this is not json"
+    frame = struct.pack(
+        "!4sB3xIQ", wire.MAGIC, wire.VERSION, len(hb), 0
+    ) + hb
+    with pytest.raises(wire.WireError, match="malformed frame header"):
+        wire.read_frame(io.BytesIO(frame))
+
+
+def test_header_must_be_object():
+    hb = json.dumps([1, 2, 3]).encode()
+    frame = struct.pack(
+        "!4sB3xIQ", wire.MAGIC, wire.VERSION, len(hb), 0
+    ) + hb
+    with pytest.raises(wire.WireError, match="JSON object"):
+        wire.read_frame(io.BytesIO(frame))
+
+
+def test_manifest_overruns_blob():
+    good = wire.pack_frame({"v": 1}, {"a": np.ones(8)})
+    # corrupt the declared nbytes upward
+    hlen = struct.unpack_from("!4sB3xIQ", good)[2]
+    header = json.loads(good[wire.PREFIX_LEN:wire.PREFIX_LEN + hlen])
+    header["arrays"][0]["nbytes"] = 10_000
+    hb = json.dumps(header).encode()
+    blob = good[wire.PREFIX_LEN + hlen:]
+    frame = struct.pack(
+        "!4sB3xIQ", wire.MAGIC, wire.VERSION, len(hb), len(blob)
+    ) + hb + blob
+    with pytest.raises(wire.WireError, match="overruns"):
+        wire.read_frame(io.BytesIO(frame))
+
+
+def test_undeclared_blob_bytes():
+    good = wire.pack_frame({"v": 1})
+    frame = bytearray(good)
+    extra = b"\xde\xad\xbe\xef"
+    struct.pack_into(
+        "!4sB3xIQ", frame, 0, wire.MAGIC, wire.VERSION,
+        struct.unpack_from("!4sB3xIQ", good)[2], len(extra),
+    )
+    with pytest.raises(wire.WireError, match="undeclared bytes"):
+        wire.read_frame(io.BytesIO(bytes(frame) + extra))
+
+
+def test_random_garbage_never_hangs():
+    rng = np.random.default_rng(1234)
+    for _ in range(50):
+        blob = rng.integers(0, 256, rng.integers(1, 200)).astype(
+            np.uint8
+        ).tobytes()
+        with pytest.raises(wire.WireError):  # WireClosed is a subclass
+            wire.read_frame(io.BytesIO(blob))
+
+
+def test_wire_error_is_typed_taxonomy_member():
+    assert issubclass(wire.WireError, AMGXTPUError)
+    assert wire.WireError("x").rc == RC_IO_ERROR
+
+
+def test_max_frame_env_knob(monkeypatch):
+    monkeypatch.setenv(wire.ENV_MAX_FRAME, "1")
+    assert wire.max_blob_bytes() == 1 << 20
+    with pytest.raises(wire.WireError, match="exceeds"):
+        wire.pack_frame({}, {"big": np.ones(1 << 18)})  # 2 MiB f64
+    monkeypatch.setenv(wire.ENV_MAX_FRAME, "garbage")
+    assert wire.max_blob_bytes() == 1024 << 20
+
+
+# ---------------------------------------------------------------------------
+# typed error marshalling: the taxonomy round-trips the wire
+
+
+@pytest.mark.parametrize("exc", [
+    AMGXTPUError("base"),
+    SetupError("setup blew up"),
+    SingularDiagonalError("zero diag at row 3"),
+    NonFiniteValuesError("nan in values"),
+    SolveBreakdown("rho underflow"),
+    ResourceError("oom"),
+    DeadlineExceededError("too slow"),
+    StoreError("corrupt artifact"),
+    wire.WireError("garbage frame"),
+])
+def test_error_roundtrip_class_and_rc(exc):
+    back = wire.unmarshal_error(wire.marshal_error(exc))
+    assert type(back) is type(exc)
+    assert str(back) == str(exc)
+    assert back.rc == exc.rc
+
+
+def test_admission_rejected_roundtrips_retry_hint():
+    exc = AdmissionRejected(
+        "quota exhausted", retry_after_s=3.25, reason="quota"
+    )
+    back = wire.unmarshal_error(wire.marshal_error(exc))
+    assert type(back) is AdmissionRejected
+    assert back.retry_after_s == 3.25
+    assert back.reason == "quota"
+
+
+def test_overloaded_roundtrips_as_overloaded():
+    exc = Overloaded("queue full", retry_after_s=0.5)
+    back = wire.unmarshal_error(wire.marshal_error(exc))
+    assert type(back) is Overloaded
+    assert isinstance(back, AdmissionRejected)
+    assert back.retry_after_s == 0.5
+    assert back.reason == "overloaded"
+
+
+def test_device_lost_roundtrips_label():
+    exc = DeviceLostError("chip fell over", device_label="worker:w3")
+    back = wire.unmarshal_error(wire.marshal_error(exc))
+    assert type(back) is DeviceLostError
+    assert back.device_label == "worker:w3"
+
+
+def test_unknown_error_type_degrades_typed():
+    back = wire.unmarshal_error(
+        {"etype": "SomeFutureError", "msg": "??", "rc": 15}
+    )
+    assert type(back) is AMGXTPUError
+    assert back.rc == 15
+    assert "SomeFutureError" in str(back)
+
+
+def test_malformed_error_payload_degrades_typed():
+    assert isinstance(wire.unmarshal_error(None), AMGXTPUError)
+    assert isinstance(wire.unmarshal_error("boom"), AMGXTPUError)
+    assert isinstance(wire.unmarshal_error({}), AMGXTPUError)
+
+
+def test_arbitrary_exception_marshals_with_rc():
+    d = wire.marshal_error(ValueError("nope"))
+    assert d["etype"] == "ValueError"
+    back = wire.unmarshal_error(d)
+    assert isinstance(back, AMGXTPUError)
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter: cross-process affinity + worker breakers
+
+
+def _router(n=3, **kw):
+    kw.setdefault("dist_rows", 1000)
+    r = FleetRouter(capacity=8, **kw)
+    for slot in range(n):
+        r.add_worker(slot)
+    return r
+
+
+def test_route_requires_workers():
+    r = FleetRouter(capacity=4)
+    with pytest.raises(RuntimeError, match="no workers"):
+        r.route("fp0")
+
+
+def test_affinity_repeat_fingerprint_sticks():
+    r = _router()
+    slot, warm = r.route("fpA")
+    assert not warm
+    r.settle(slot, 0.01)
+    slot2, warm2 = r.route("fpA")
+    assert warm2 and slot2 == slot
+    r.settle(slot2, 0.01)
+    snap = r.snapshot()
+    assert snap["hits"] == 1 and snap["misses"] == 1
+
+
+def test_cold_fingerprints_spread_least_loaded():
+    r = _router(3)
+    slots = set()
+    for i in range(3):
+        slot, warm = r.route(f"fp{i}")  # loads stay outstanding
+        assert not warm
+        slots.add(slot)
+    assert slots == {0, 1, 2}
+
+
+def test_failure_trips_and_forgets_warm_set():
+    r = _router(2)
+    slot, _ = r.route("fpA")
+    r.settle(slot, 0.01)
+    assert r.failure(slot) is True
+    assert slot in r.board.tripped_indices()
+    # warm state gone: fpA re-routes to the OTHER (healthy) worker
+    slot2, warm = r.route("fpA")
+    assert slot2 != slot and not warm
+
+
+def test_half_open_probe_routes_to_tripped_worker():
+    r = _router(2, probe_every=2)
+    r.failure(0)
+    probed = []
+    for i in range(6):
+        slot, _ = r.route(f"fp{i}")
+        if slot == 0:
+            probed.append(i)
+        r.settle(slot, 0.0) if slot != 0 else r.release(slot)
+    assert probed, "probe cadence never admitted the tripped worker"
+    # a SUCCESSFUL probe closes the breaker
+    slot, _ = r.route("probe-win")
+    while slot != 0:
+        r.release(slot)
+        slot, _ = r.route("probe-win")
+    r.settle(slot, 0.01)
+    assert r.board.tripped_indices() == []
+    assert r.board.closes >= 1
+
+
+def test_all_tripped_still_routes_counted_fallback():
+    r = _router(2)
+    r.failure(0)
+    r.failure(1)
+    # probes may or may not be due; exhaust until a non-probe route
+    routed = 0
+    for i in range(20):
+        slot, _ = r.route(f"fp{i}")
+        assert slot in (0, 1)
+        r.release(slot)
+        routed += 1
+    assert routed == 20
+    assert r.snapshot()["fallbacks"] >= 1
+
+
+def test_oversized_patterns_restrict_to_dist_workers():
+    r = FleetRouter(capacity=4, dist_rows=500)
+    r.add_worker(0)
+    r.add_worker(1, dist_capable=True)
+    for i in range(4):
+        slot, _ = r.route(f"big{i}", n_rows=1000)
+        assert slot == 1
+        r.settle(slot, 0.0)
+    small_slots = set()
+    for i in range(8):
+        slot, _ = r.route(f"small{i}", n_rows=100)
+        small_slots.add(slot)
+        r.settle(slot, 0.0)
+    assert 0 in small_slots
+    assert r.snapshot()["dist_routed"] == 4
+
+
+def test_oversized_without_dist_worker_routes_anyway():
+    r = _router(2, dist_rows=500)
+    slot, _ = r.route("big", n_rows=10_000)
+    assert slot in (0, 1)
+
+
+def test_remove_worker_forgets_without_trip():
+    r = _router(2)
+    slot, _ = r.route("fpA")
+    r.settle(slot, 0.0)
+    r.remove_worker(slot)
+    assert r.board.tripped_indices() == []
+    other, warm = r.route("fpA")
+    assert other != slot and not warm
+
+
+# ---------------------------------------------------------------------------
+# WorkerRegistry: discovery + liveness
+
+
+def test_registry_announce_lookup_withdraw(tmp_path):
+    reg = WorkerRegistry(tmp_path / "reg")
+    rec = WorkerRecord("w0", "127.0.0.1", 4242, os.getpid(), slot=0)
+    reg.announce(rec)
+    got = reg.lookup("w0")
+    assert got is not None
+    assert got.address == ("127.0.0.1", 4242)
+    assert got.alive()
+    assert [r.worker_id for r in reg.workers()] == ["w0"]
+    reg.withdraw("w0")
+    assert reg.lookup("w0") is None
+    reg.withdraw("w0")  # idempotent
+
+
+def test_registry_dead_pid_filtered(tmp_path):
+    reg = WorkerRegistry(tmp_path)
+    # a pid far above pid_max-ish values that's extremely unlikely live
+    reg.announce(WorkerRecord("dead", "h", 1, 2**22 + 12345, slot=0))
+    reg.announce(WorkerRecord("live", "h", 2, os.getpid(), slot=1))
+    assert [r.worker_id for r in reg.workers()] == ["live"]
+    assert len(reg.workers(live_only=False)) == 2
+
+
+def test_registry_corrupt_record_is_skipped(tmp_path):
+    reg = WorkerRegistry(tmp_path)
+    reg.announce(WorkerRecord("ok", "h", 9, os.getpid()))
+    (tmp_path / "bad.json").write_text("{not json")
+    (tmp_path / "half.json").write_text('{"worker_id": "half"}')
+    (tmp_path / "noise.txt").write_text("irrelevant")
+    assert [r.worker_id for r in reg.workers()] == ["ok"]
+
+
+def test_registry_rejects_traversal_ids(tmp_path):
+    reg = WorkerRegistry(tmp_path)
+    for bad in ("../evil", "a/b", ".hidden", ""):
+        with pytest.raises(ValueError):
+            reg.lookup(bad)
+
+
+def test_registry_wait_for_timeout_lists_present(tmp_path):
+    reg = WorkerRegistry(tmp_path)
+    reg.announce(WorkerRecord("here", "h", 1, os.getpid()))
+    with pytest.raises(TimeoutError, match="here"):
+        reg.wait_for("missing", timeout_s=0.2, poll_s=0.02)
+
+
+def test_registry_heartbeat_updates(tmp_path):
+    reg = WorkerRegistry(tmp_path)
+    rec = WorkerRecord("w", "h", 1, os.getpid())
+    reg.announce(rec)
+    t0 = reg.lookup("w").heartbeat_at
+    reg.heartbeat(rec)
+    assert reg.lookup("w").heartbeat_at >= t0

@@ -1,0 +1,329 @@
+"""Multi-process fleet tests: real worker subprocesses over the wire.
+
+Spawns actual ``python -m amgx_tpu.fleet.worker`` processes (CPU
+backend, inherited from the test environment) and drives them through
+the :class:`~amgx_tpu.fleet.frontend.FleetFrontend`: end-to-end
+solves with cross-process affinity, typed-error round trips, garbage
+resilience, the drain-then-warmboot rolling restart, and the kill -9
+requeue path.  A shared two-worker fleet amortizes the subprocess
+boot cost across the read-only tests; the restart/kill tests spawn
+their own."""
+
+from __future__ import annotations
+
+import os
+import socket
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import amgx_tpu
+from amgx_tpu.core.errors import (
+    AMGXTPUError,
+    DeviceLostError,
+    NonFiniteValuesError,
+)
+from amgx_tpu.fleet import wire
+from amgx_tpu.fleet.frontend import FleetFrontend
+from amgx_tpu.fleet.lifecycle import FleetSupervisor
+from amgx_tpu.io.poisson import poisson_scipy
+
+amgx_tpu.initialize()
+
+pytestmark = pytest.mark.serve
+
+_SPAWN_TIMEOUT_S = 180.0
+
+
+def _mat(shape=(8, 8)):
+    sp = poisson_scipy(shape).tocsr()
+    sp.sort_indices()
+    return sp
+
+
+def _rhs(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(n)
+
+
+def _check(A, b, res, tol=1e-6):
+    x = np.asarray(res.x)
+    rel = np.linalg.norm(A @ x - b) / np.linalg.norm(b)
+    assert rel < tol, f"relative residual {rel}"
+
+
+def _spawn_fleet(n, tmp_root):
+    reg = os.path.join(tmp_root, "registry")
+    store = os.path.join(tmp_root, "store")
+    sup = FleetSupervisor(
+        reg, store, spawn_timeout_s=_SPAWN_TIMEOUT_S,
+        worker_args=["--max-batch", "8"],
+    )
+    records = sup.launch(n)
+    front = FleetFrontend(register_telemetry=False)
+    for rec in records:
+        front.attach(rec)
+    return sup, front, records
+
+
+@pytest.fixture(scope="module")
+def fleet2():
+    """Two workers + one frontend, shared by the read-only tests."""
+    tmp = tempfile.mkdtemp(prefix="fleetproc_")
+    sup, front, records = _spawn_fleet(2, tmp)
+    try:
+        yield sup, front, records
+    finally:
+        front.close()
+        sup.terminate_all()
+
+
+# ---------------------------------------------------------------------------
+# end to end
+
+
+def test_end_to_end_solve_and_cross_process_affinity(fleet2):
+    _sup, front, _records = fleet2
+    A1 = _mat((8, 8))
+    A2 = _mat((9, 9))
+    b1, b2 = _rhs(A1.shape[0], 1), _rhs(A2.shape[0], 2)
+
+    r1 = front.solve(A1, b1, deadline_s=120.0, timeout=180.0)
+    _check(A1, b1, r1)
+    r2 = front.solve(A2, b2, deadline_s=120.0, timeout=180.0)
+    _check(A2, b2, r2)
+
+    # distinct fingerprints spread (busy-time tie-break), repeats
+    # stick to the worker whose caches are warm
+    slots = {front.router.peek(a._amgx_tpu_fp) for a in (A1, A2)}
+    assert len(slots) == 2
+
+    snap0 = front.telemetry_snapshot()
+    for i in range(3):
+        _check(A1, b1, front.solve(A1, b1, timeout=180.0))
+        _check(A2, b2, front.solve(A2, b2, timeout=180.0))
+    snap = front.telemetry_snapshot()
+    assert (
+        snap["routing"]["hits"] - snap0["routing"]["hits"] == 6
+    ), "repeat fingerprints must be cross-process affinity hits"
+    assert snap["counters"]["completed"] >= 8
+    assert snap["counters"]["conn_losses"] == 0
+
+
+def test_typed_error_roundtrips_the_wire(fleet2):
+    _sup, front, _records = fleet2
+    A = _mat((8, 8))
+    bad = np.full(A.shape[0], np.nan)
+    with pytest.raises(NonFiniteValuesError):
+        front.solve(A, bad, timeout=180.0)
+    # the worker is fine: no breaker trip, and it still serves
+    assert front.router.board.tripped_indices() == []
+    b = _rhs(A.shape[0], 7)
+    _check(A, b, front.solve(A, b, timeout=180.0))
+
+
+def test_garbage_connection_leaves_worker_serving(fleet2):
+    _sup, front, records = fleet2
+    rec = records[0]
+    # hand-rolled garbage straight at the worker's socket
+    with socket.create_connection(rec.address, timeout=30) as s:
+        s.sendall(b"GET / HTTP/1.1\r\nHost: nope\r\n\r\n")
+        reply = s.makefile("rb")
+        header, _ = wire.read_frame(reply)
+        err = wire.unmarshal_error(header["error"])
+        assert isinstance(err, wire.WireError)
+        # worker then drops THIS connection...
+        assert s.recv(1) == b""
+    # ...but keeps serving everyone else
+    A = _mat((8, 8))
+    b = _rhs(A.shape[0], 9)
+    _check(A, b, front.solve(A, b, timeout=180.0))
+    h = front.health(records[0].slot)
+    assert h["worker"]["wire_errors"] >= 1
+
+
+def test_health_and_metrics_over_the_wire(fleet2):
+    _sup, front, records = fleet2
+    assert front.ping(0) and front.ping(1)
+    h = front.health(0)
+    assert h["worker"]["worker_id"] == records[0].worker_id
+    assert h["worker"]["pid"] == records[0].pid
+    assert h["state"] == "serving"
+    assert "setups" in h["serve"]
+    assert "coarsen_calls" in h["setup_evidence"]
+    text = front.metrics_text(0)
+    assert "amgx_serve_" in text
+
+
+def test_frontend_telemetry_renders_fleet_families(fleet2):
+    _sup, front, _records = fleet2
+    from amgx_tpu.telemetry.promtext import FamilyTable, fleet_families
+
+    fams = FamilyTable()
+    fleet_families(fams, "fleet0", front.telemetry_snapshot())
+    text = fams.render()
+    assert "amgx_fleet_submitted_total" in text
+    assert "amgx_fleet_affinity_hits_total" in text
+    assert "amgx_fleet_workers" in text
+
+
+# ---------------------------------------------------------------------------
+# rolling restart: drain -> warm boot, zero setups on the replacement
+
+
+def test_rolling_restart_drains_and_warm_boots(tmp_path):
+    sup, front, records = _spawn_fleet(1, str(tmp_path))
+    try:
+        A = _mat((10, 10))
+        b = _rhs(A.shape[0], 3)
+        _check(A, b, front.solve(A, b, timeout=180.0))
+        h0 = front.health(0)
+        assert h0["serve"]["setups"] == 1
+
+        out = sup.rolling_restart(
+            records[0].worker_id, front, timeout_s=120.0
+        )
+        rep = out["drain"]
+        assert rep["failed"] == 0 and rep["timed_out"] == 0
+        assert rep["exported"] >= 1
+        assert out["exit_code"] == 0
+
+        # the replacement warm-booted the persisted fingerprint from
+        # the SHARED store: its first group is a hierarchy-cache HIT —
+        # zero setups, zero coarsening
+        h1 = front.health(0)
+        assert h1["worker"]["worker_id"] != records[0].worker_id
+        assert h1["worker"]["warm_booted"] >= 1
+        assert h1["serve"]["setups"] == 0
+
+        _check(A, b, front.solve(A, b, timeout=180.0))
+        h2 = front.health(0)
+        assert h2["serve"]["setups"] == 0
+        assert h2["serve"]["cache_hits"] >= 1
+        assert h2["setup_evidence"]["coarsen_calls"] == 0
+    finally:
+        front.close()
+        sup.terminate_all()
+
+
+# ---------------------------------------------------------------------------
+# kill -9: breaker trips, in-flight work requeues exactly once
+
+
+def test_kill9_trips_breaker_and_requeues(tmp_path):
+    sup, front, records = _spawn_fleet(2, str(tmp_path))
+    try:
+        # warm both workers so the survivor solves fast
+        A_warm = _mat((8, 8))
+        bw = _rhs(A_warm.shape[0], 4)
+        _check(A_warm, bw, front.solve(A_warm, bw, timeout=180.0))
+
+        # route a COLD fingerprint (its first solve pays setup +
+        # compile — a wide in-flight window), then kill its worker
+        A_cold = _mat((11, 11))
+        bc = _rhs(A_cold.shape[0], 5)
+        tickets = [
+            front.submit(A_cold, bc, deadline_s=300.0)
+            for _ in range(3)
+        ]
+        victim_slot = tickets[0]._pending.slot
+        victim = next(
+            r for r in records if r.slot == victim_slot
+        )
+        assert sup.kill(victim.worker_id) is True
+
+        # every ticket settles: requeued to the healthy worker, or a
+        # typed DeviceLostError — never silently lost, never a hang
+        outcomes = []
+        for t in tickets:
+            try:
+                res = t.result(timeout=180.0)
+                _check(A_cold, bc, res)
+                outcomes.append("ok")
+            except AMGXTPUError as e:
+                assert isinstance(e, DeviceLostError)
+                outcomes.append("typed")
+        assert len(outcomes) == 3
+
+        snap = front.telemetry_snapshot()
+        assert snap["counters"]["conn_losses"] >= 1
+        assert snap["routing"]["health"]["trips"] >= 1
+        assert (
+            snap["counters"]["requeued"]
+            + snap["counters"]["requeue_failures"]
+        ) >= 1
+
+        # the fleet keeps serving on the survivor
+        _check(A_warm, bw, front.solve(A_warm, bw, timeout=180.0))
+    finally:
+        front.close()
+        sup.terminate_all()
+
+
+# ---------------------------------------------------------------------------
+# C API front: AMGX_TPU_FLEET routes solver_solve_batch over the wire
+
+
+def test_capi_batch_over_fleet(fleet2, monkeypatch):
+    _sup, _front, records = fleet2
+    from amgx_tpu.api import capi
+
+    monkeypatch.setenv("AMGX_TPU_FLEET", _sup.registry.root)
+    capi.initialize()
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG", "max_iters": 100, "tolerance": 1e-8,'
+        ' "monitor_residual": 1, "convergence": "RELATIVE_INI"}}'
+    )
+    res_h = capi.resources_create_simple(cfg)
+    A = _mat((8, 8))
+    n = A.shape[0]
+    mh, rh, sh = [], [], []
+    for i in range(3):
+        m = capi.matrix_create(res_h)
+        capi.matrix_upload_all(
+            m, n, A.nnz, 1, 1,
+            A.indptr.astype(np.int32),
+            A.indices.astype(np.int32), A.data,
+        )
+        r = capi.vector_create(res_h)
+        capi.vector_upload(r, n, 1, _rhs(n, 20 + i))
+        x = capi.vector_create(res_h)
+        capi.vector_set_zero(x, n, 1)
+        mh.append(m)
+        rh.append(r)
+        sh.append(x)
+    slv = capi.solver_create(res_h, "dDDI", cfg)
+    try:
+        rc = capi.solver_solve_batch(slv, mh, rh, sh)
+        assert rc == capi.RC_OK
+        s = capi._get(slv, capi._SolverHandle)
+        assert s.batch_fleet is not None
+        assert s.batch_service is None  # no local serve stack built
+        for i in range(3):
+            assert capi.solver_get_batch_status(slv, i) == 0
+            out = capi.vector_download(sh[i])
+            b_i = _rhs(n, 20 + i)
+            rel = np.linalg.norm(A @ out - b_i) / np.linalg.norm(b_i)
+            assert rel < 1e-6
+    finally:
+        capi.solver_destroy(slv)
+
+
+def test_capi_fleet_env_malformed_fails_loudly(monkeypatch):
+    from amgx_tpu.api import capi
+
+    monkeypatch.setenv("AMGX_TPU_FLEET", "not-a-dir-not-an-addr")
+    capi.initialize()
+    cfg = capi.config_create(
+        '{"config_version": 2, "solver": {"scope": "m",'
+        ' "solver": "PCG", "max_iters": 10, "tolerance": 1e-6}}'
+    )
+    res_h = capi.resources_create_simple(cfg)
+    slv = capi.solver_create(res_h, "dDDI", cfg)
+    s = capi._get(slv, capi._SolverHandle)
+    with pytest.raises(capi.AMGXError) as ei:
+        capi._ensure_batch_front(s)
+    assert ei.value.rc == capi.RC_BAD_CONFIGURATION
+    capi.solver_destroy(slv)
