@@ -31,7 +31,8 @@ from amgx_tpu.core.profiling import (
     setup_transfer,
 )
 from amgx_tpu.ops.blas import dot
-from amgx_tpu.ops.spmv import spmv
+from amgx_tpu.ops.spmv import op_pass_counter, spmv
+from amgx_tpu.ops.stencil import fused_cycle_leg
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.solvers.registry import (
     SolverRegistry,
@@ -91,7 +92,7 @@ def levels_bitwise_equal(amg_a, amg_b) -> str | None:
                         f"level {la.level_id} {field}.{arr} not "
                         "bitwise-identical"
                     )
-            for accel in ("dia_vals", "ell_vals"):
+            for accel in ("dia_vals", "ell_vals", "mf_coefs"):
                 va, vb = getattr(ma, accel), getattr(mb, accel)
                 if (va is None) != (vb is None):
                     return (
@@ -180,6 +181,15 @@ class AMGSolver(Solver):
         # rebuilds everything; k > 0 = the top k Galerkin products
         # re-evaluate on device (amg/spgemm.py plans); < 0 = all levels
         self.structure_reuse = int(g("structure_reuse_levels"))
+        # MATRIX_FREE stencil operators (ops/stencil.py): detect
+        # verified constant/axis-separable stencils at setup and store
+        # compact coefficient state instead of O(nnz) DIA planes; the
+        # fused_cycle knob additionally collapses each descent leg
+        # (smooth -> residual -> restrict) on matrix-free levels into
+        # one fine-grid pass.  fused_cycle=0 is the reference path the
+        # parity gates diff against.
+        self.matrix_free = bool(g("matrix_free"))
+        self.fused_cycle = bool(g("fused_cycle"))
         # per-level precision policy (the cheap-preconditioner mode,
         # ROADMAP item 3 / SParSH-AMG): hierarchy values cast to
         # hierarchy_dtype at _finalize_setup — COARSE casts levels >= 1
@@ -321,6 +331,42 @@ class AMGSolver(Solver):
             cs.setup(A)
         return cs
 
+    def _accel_formats(self):
+        """Acceleration formats hierarchy operators build with: the
+        matrix_free knob prepends the MATRIX_FREE stencil format (each
+        format still subject to its own gate — non-stencil operators
+        fall through to DIA/dense/ELL exactly as before)."""
+        if self.matrix_free:
+            return ("matrix_free", "dia", "dense", "ell")
+        return ("dia", "dense", "ell")
+
+    def _maybe_matrix_free(self, A: SparseMatrix, device: bool):
+        """Rebuild the finest operator with the MATRIX_FREE format when
+        the knob is on and detection succeeds; returns the ORIGINAL
+        object (identity, memos intact) otherwise.  User uploads build
+        with the default formats, so the upgrade re-runs ``from_csr``
+        over the host CSR triple — host-resident on the fast path so
+        the compact state rides the one batched finalize transfer."""
+        if (
+            not self.matrix_free
+            or A.block_size != 1
+            or not A.is_square
+            or A.partition is not None
+            or A.has_matrix_free
+        ):
+            return A
+        new = SparseMatrix.from_csr(
+            np.asarray(A.row_offsets),
+            np.asarray(A.col_indices),
+            np.asarray(A.values),
+            n_cols=A.n_cols,
+            views=A.views,
+            accel_formats=self._accel_formats(),
+            validate=False,
+            device=device,
+        )
+        return new if new.has_matrix_free else A
+
     def _setup_impl(self, A: SparseMatrix):
         from amgx_tpu.ops.diagonal import scalarized
 
@@ -331,6 +377,9 @@ class AMGSolver(Solver):
             # finalize transfer, keeping ≤1 batch per cold setup
             A = scalarized(A, "AMG",
                            device=not setup_fastpath_enabled())
+            A = self._maybe_matrix_free(
+                A, device=not setup_fastpath_enabled()
+            )
             self.levels = [AMGLevel(A, 0)]
             # fast path: read the finest operator back through the
             # construction-time host memo instead of a device->host
@@ -427,7 +476,14 @@ class AMGSolver(Solver):
                     )
             self.levels.append(
                 AMGLevel(
-                    SparseMatrix.from_scipy(Ac, device=not defer),
+                    # Galerkin products of constant stencils on
+                    # divisible grids stay constant stencils, so the
+                    # matrix-free format propagates down the hierarchy
+                    # (each level re-verified independently)
+                    SparseMatrix.from_scipy(
+                        Ac, device=not defer,
+                        accel_formats=self._accel_formats(),
+                    ),
                     len(self.levels),
                 )
             )
@@ -566,6 +622,50 @@ class AMGSolver(Solver):
                     f"config's precision policy wants {dt} — stale "
                     "artifact, counted as a miss"
                 )
+
+    def _check_restored_formats(self):
+        """Store-restore guardrail (sibling of
+        ``_check_restored_dtypes``): a persisted hierarchy whose
+        acceleration formats contradict the ``matrix_free`` knob is a
+        STALE artifact — it either carries matrix-free compact state
+        this config would never build (knob off), or stores O(nnz) DIA
+        planes for a finest operator this config's setup would verify
+        and compress (knob on — checked by re-running detection, an
+        O(nnz) host compare on bytes the restore already shipped).
+        Raises :class:`~amgx_tpu.core.errors.StoreError`, which every
+        store consumer counts as a miss."""
+        from amgx_tpu.core.errors import StoreError
+
+        if not self.matrix_free:
+            for lvl in self.levels:
+                if lvl.A.has_matrix_free:
+                    raise StoreError(
+                        f"persisted hierarchy level {lvl.level_id} "
+                        "carries MATRIX_FREE compact state but this "
+                        "config has matrix_free=0 — stale artifact, "
+                        "counted as a miss"
+                    )
+            return
+        A = self.levels[0].A
+        if (
+            A.has_matrix_free
+            or not A.has_dia
+            or A.dia_src is None
+            or A.block_size != 1
+        ):
+            return
+        from amgx_tpu.ops.stencil import detect_stencil_np
+
+        det = detect_stencil_np(
+            A.dia_offsets, np.asarray(A.dia_vals),
+            np.asarray(A.dia_src), A.n_rows,
+        )
+        if det is not None:
+            raise StoreError(
+                "persisted hierarchy finest level is a verified "
+                "stencil but stores DIA planes while this config has "
+                "matrix_free=1 — stale artifact, counted as a miss"
+            )
 
     def _refresh_smoother(self, lvl: AMGLevel):
         """Level-smoother refresh policy: a surviving smoother (the
@@ -737,6 +837,7 @@ class AMGSolver(Solver):
         # would silently "repair" wrong-dtype levels, turning a stale
         # payload into a wrong-provenance warm hit
         self._check_restored_dtypes()
+        self._check_restored_formats()
         self._restored_coarse = None
         cs_state = impl.get("coarse")
         if cs_state:
@@ -884,6 +985,13 @@ class AMGSolver(Solver):
         hierarchies (``_to_dtype``)."""
         n_levels = len(self.levels)
         lvl_dts = [lvl.A.values.dtype for lvl in self.levels]
+        # fused descent legs (ops/stencil.py): static per-level — only
+        # matrix-free operators qualify (the win is zero coefficient
+        # traffic; fusing a DIA leg would still stream the planes)
+        fused_lvls = [
+            self.fused_cycle and lvl.A.has_matrix_free
+            for lvl in self.levels
+        ]
         smooth_fns = [
             lvl.smoother.make_smooth() if lvl.smoother else None
             for lvl in self.levels
@@ -941,12 +1049,22 @@ class AMGSolver(Solver):
                         smp, b, x, self.coarsest_sweeps
                     )
             pre, post = self._level_sweeps(lvl_id)
-            if pre > 0:
-                with named_scope(f"amg_l{lvl_id}_presmooth"):
-                    x = smooth_fns[lvl_id](smp, b, x, pre)
-            with named_scope(f"amg_l{lvl_id}_restrict"):
-                r = b - spmv(A, x)
-                bc = _to_dtype(spmv(R, r), lvl_dts[lvl_id + 1])
+            if fused_lvls[lvl_id]:
+                # one fine-grid pass for the whole descent leg
+                # (identical arithmetic to the unfused sequence below
+                # — parity is bitwise; ops/stencil.py records the pass)
+                with named_scope(f"amg_l{lvl_id}_fused_leg"):
+                    x, r, bc = fused_cycle_leg(
+                        A, R, smooth_fns[lvl_id], smp, b, x, pre
+                    )
+                    bc = _to_dtype(bc, lvl_dts[lvl_id + 1])
+            else:
+                if pre > 0:
+                    with named_scope(f"amg_l{lvl_id}_presmooth"):
+                        x = smooth_fns[lvl_id](smp, b, x, pre)
+                with named_scope(f"amg_l{lvl_id}_restrict"):
+                    r = b - spmv(A, x)
+                    bc = _to_dtype(spmv(R, r), lvl_dts[lvl_id + 1])
             xc = jnp.zeros(
                 (R.n_rows * R.block_size,), dtype=lvl_dts[lvl_id + 1]
             )
@@ -1025,12 +1143,19 @@ class AMGSolver(Solver):
                         smp, b, x, self.coarsest_sweeps
                     )
             pre, post = self._level_sweeps(lvl_id)
-            if pre > 0:
-                with named_scope(f"amg_l{lvl_id}_presmooth"):
-                    x = smooth_fns[lvl_id](smp, b, x, pre)
-            with named_scope(f"amg_l{lvl_id}_restrict"):
-                r = b - spmv(A, x)
-                bc = _to_dtype(spmv(R, r), lvl_dts[lvl_id + 1])
+            if fused_lvls[lvl_id]:
+                with named_scope(f"amg_l{lvl_id}_fused_leg"):
+                    x, r, bc = fused_cycle_leg(
+                        A, R, smooth_fns[lvl_id], smp, b, x, pre
+                    )
+                    bc = _to_dtype(bc, lvl_dts[lvl_id + 1])
+            else:
+                if pre > 0:
+                    with named_scope(f"amg_l{lvl_id}_presmooth"):
+                        x = smooth_fns[lvl_id](smp, b, x, pre)
+                with named_scope(f"amg_l{lvl_id}_restrict"):
+                    r = b - spmv(A, x)
+                    bc = _to_dtype(spmv(R, r), lvl_dts[lvl_id + 1])
             xc = jnp.zeros(
                 (R.n_rows * R.block_size,), dtype=lvl_dts[lvl_id + 1]
             )
@@ -1082,6 +1207,38 @@ class AMGSolver(Solver):
     # make_apply: inherited — base Solver composes make_smooth over
     # make_step (= one cycle per iteration), matching the reference's
     # AMG-preconditioner usage with max_iters cycles.
+
+    def cycle_passes_per_iteration(self):
+        """Fine-grid operator passes one cycle executes, counted by
+        tracing ``make_cycle`` under
+        :data:`amgx_tpu.ops.spmv.op_pass_counter` — the number behind
+        the ``amgx_solver_cycle_passes_total`` telemetry family and
+        the ci/matrix_free_bench.py fused-leg gate (each fused
+        descent leg contributes exactly ONE pass; the unfused
+        reference leg contributes one per smoother sweep plus the
+        residual).  Cached per setup (``_jit_cache`` clears on
+        setup/resetup)."""
+        key = "__cycle_passes_per_iteration__"
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        try:
+            if not self.levels:
+                val = None
+            else:
+                cycle = self.make_cycle()
+                params = self.apply_params()
+                A0 = self.levels[0].A
+                spec = jax.ShapeDtypeStruct(
+                    (A0.n_rows * A0.block_size,),
+                    jnp.zeros((), A0.values.dtype).dtype,
+                )
+                with op_pass_counter() as c:
+                    jax.eval_shape(cycle, params, spec, spec)
+                val = c.count
+        except Exception:  # noqa: BLE001 — accounting must never fail
+            val = None
+        self._jit_cache[key] = val
+        return val
 
     # ------------------------------------------------------------------
 

@@ -195,6 +195,20 @@ register("finest_sweeps", I, -1, "finest-level sweeps (-1: presweeps)")
 register("coarsest_sweeps", I, 2, "coarsest-level smoothing iterations")
 register("cycle_iters", I, 2, "CG-cycle inner iterations")
 register("structure_reuse_levels", I, 0, "hierarchy structure reuse depth")
+register("matrix_free", I, 0,
+         "MATRIX_FREE accel format (ops/stencil.py): detect verified "
+         "constant / axis-separable stencil operators at setup and "
+         "replace their O(nnz) DIA value planes with O(1)/O(axis) "
+         "coefficient state regenerated on the fly — the SpMV streams "
+         "only x and y.  Detection is bitwise-verified against the CSR "
+         "values; non-stencil operators keep their formats (0: off)")
+register("fused_cycle", I, 1,
+         "fuse the smoother->residual->restrict descent leg on "
+         "MATRIX_FREE levels into ONE fine-grid pass (identical "
+         "arithmetic; the trace-time pass counter and "
+         "amgx_solver_cycle_passes_total prove the count).  No-op for "
+         "levels without the MATRIX_FREE format; 0 = reference "
+         "three-pass legs (parity gates)")
 register("error_scaling", I, 0, "coarse-correction scaling mode")
 register("reuse_scale", I, 0, "reuse correction scale for N iters")
 register("scaling_smoother_steps", I, 2, "")

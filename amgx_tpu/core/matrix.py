@@ -124,11 +124,22 @@ class SparseMatrix:
     diag_src: Optional[jnp.ndarray] = None
     dia_src: Optional[jnp.ndarray] = None
     ell_src: Optional[jnp.ndarray] = None
+    # MATRIX_FREE compact stencil state (ops/stencil.py): when the
+    # matrix is a verified constant / axis-separable stencil, the O(nnz)
+    # DIA planes are REPLACED by O(nd) / O(nd * axis) coefficients
+    # (mf_coefs) regenerated on the fly by the apply, plus a
+    # first-occurrence gather map into the CSR values (mf_src) so
+    # replace_values re-derives coefficients per value swap.
+    mf_coefs: Optional[jnp.ndarray] = None
+    mf_src: Optional[jnp.ndarray] = None
 
     n_rows: int = _static_field(default=0)
     n_cols: int = _static_field(default=0)
     block_size: int = _static_field(default=1)
     dia_offsets: Any = _static_field(default=None)  # tuple[int] | None
+    # static stencil description (ops.stencil.StencilMeta) of the
+    # MATRIX_FREE state; None = format not built
+    mf_meta: Any = _static_field(default=None)
     # windowed-ELL column-window width in lanes (static); None = no
     # windowed arrays
     ell_wwidth: Any = _static_field(default=None)
@@ -165,6 +176,10 @@ class SparseMatrix:
     @property
     def has_dense(self) -> bool:
         return self.dense is not None
+
+    @property
+    def has_matrix_free(self) -> bool:
+        return self.mf_meta is not None
 
     @property
     def is_square(self) -> bool:
@@ -258,6 +273,14 @@ class SparseMatrix:
             else:
                 dia_vals = _scatter_dia_vals(self, values)
             new = dataclasses.replace(new, dia_vals=dia_vals)
+        if self.has_matrix_free:
+            # re-derive the compact stencil coefficients from the new
+            # values; assumes the swap preserves the stencil class
+            # (same contract as sparsity: the serve/resetup callers
+            # refresh VALUES of the operator detection verified)
+            new = dataclasses.replace(
+                new, mf_coefs=_gather_src(self.mf_src, values)
+            )
         if self.has_dense:
             d = jnp.zeros_like(self.dense)
             d = d.at[self.row_ids, self.col_indices].add(values)
@@ -286,6 +309,8 @@ class SparseMatrix:
                 rep["ell_wvals"] = self.ell_wvals.astype(dtype)
         if self.has_dia:
             rep["dia_vals"] = self.dia_vals.astype(dtype)
+        if self.has_matrix_free:
+            rep["mf_coefs"] = self.mf_coefs.astype(dtype)
         if self.has_dense:
             rep["dense"] = self.dense.astype(dtype)
         # structure is unchanged (fingerprint excludes values/dtype);
@@ -391,6 +416,34 @@ class SparseMatrix:
                 row_offsets, col_indices, values, row_ids, n_rows
             )
 
+        mf_meta = mf_coefs = mf_src = None
+        if (
+            build_ell
+            and "matrix_free" in accel_formats
+            and b == 1
+            and n_rows == n_cols
+            and nnz
+            and partition is None
+        ):
+            # detection consumes DIA planes; build them transiently if
+            # the "dia" format wasn't requested / gated out
+            trio = (dia_offsets, dia_vals, dia_src)
+            if trio[0] is None:
+                trio = _try_build_dia_np(
+                    row_offsets, col_indices, values, row_ids, n_rows
+                )
+            if trio[0] is not None:
+                from amgx_tpu.ops.stencil import detect_stencil_np
+
+                det = detect_stencil_np(
+                    trio[0], trio[1], trio[2], n_rows
+                )
+                if det is not None:
+                    mf_meta, mf_coefs, mf_src = det
+                    # the compact state REPLACES the O(nnz) DIA
+                    # planes — that is the whole point of the format
+                    dia_offsets = dia_vals = dia_src = None
+
         dense = None
         dense_bytes = n_rows * n_cols * values.dtype.itemsize
         if (
@@ -398,6 +451,7 @@ class SparseMatrix:
             and "dense" in accel_formats
             and b == 1
             and dia_offsets is None
+            and mf_meta is None
             and 0 < n_rows <= _DENSE_MAX_ROWS
             and n_cols <= _DENSE_MAX_ROWS
             and dense_bytes <= 64 * 1024 * 1024
@@ -413,6 +467,7 @@ class SparseMatrix:
             and "ell" in accel_formats
             and n_rows > 0
             and dia_offsets is None
+            and mf_meta is None
             and dense is None
         ):
             w = int(row_lens.max()) if nnz else 0
@@ -456,10 +511,13 @@ class SparseMatrix:
             diag_src=None if diag_src is None else dev(diag_src),
             dia_src=None if dia_src is None else dev(dia_src),
             ell_src=None if ell_src is None else dev(ell_src),
+            mf_coefs=None if mf_coefs is None else dev(mf_coefs),
+            mf_src=None if mf_src is None else dev(mf_src),
             n_rows=int(n_rows),
             n_cols=int(n_cols),
             block_size=int(b),
             dia_offsets=dia_offsets,
+            mf_meta=mf_meta,
             views=views,
             partition=partition,
         )
@@ -477,7 +535,7 @@ class SparseMatrix:
                         row_offsets, col_indices, values, row_ids,
                         diag, ell_cols, ell_vals, ell_wcols, ell_wvals,
                         ell_wbase, dia_vals, dense, diag_src, dia_src,
-                        ell_src,
+                        ell_src, mf_coefs, mf_src,
                     )
                 )
                 profiling.count_setup_transfer(n_arr)

@@ -118,6 +118,7 @@ def maybe_reorder(A, mode: str = "AUTO"):
         or not A.is_square
         or A.n_rows <= _m._DENSE_MAX_ROWS
         or A.has_dia
+        or A.has_matrix_free
         or A.has_dense
     ):
         return A, None

@@ -5,6 +5,10 @@ Reference parity: multiply(A, B, C, view) with block-size dispatch
 
 TPU formulation: static-shape, jittable layouts ordered by speed.
 
+  * MATRIX_FREE (verified constant / axis-separable stencils): 3D
+    shift+FMA with on-the-fly coefficients (:mod:`amgx_tpu.ops.stencil`,
+    Pallas kernel in :mod:`amgx_tpu.ops.pallas_stencil`) — zero O(nnz)
+    coefficient traffic.
   * DIA (stencil matrices): Pallas shift-FMA kernel
     (:mod:`amgx_tpu.ops.pallas_dia`) with an XLA shift+FMA fallback.
   * dense (small unstructured): one MXU matmul.
@@ -27,6 +31,16 @@ import jax
 import jax.numpy as jnp
 
 from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.ops.blas import make_site_counter
+
+# Trace-time operator-pass counter (the reduction_counter /
+# psum_site_counter pattern, ops/blas.py): every SQUARE-operator SpMV
+# call site records one fine-grid pass while a counter context is
+# active.  The fused matrix-free cycle leg (ops/stencil.py) swallows
+# its internal records and reports exactly one — tracing a cycle under
+# ``op_pass_counter`` therefore PROVES the pass count per leg
+# (ci/matrix_free_bench.py gate; amgx_solver_cycle_passes_total).
+record_op_pass, op_pass_counter = make_site_counter("op_pass")
 
 
 def spmv(A: SparseMatrix, x: jnp.ndarray, n_rows: int | None = None):
@@ -36,6 +50,8 @@ def spmv(A: SparseMatrix, x: jnp.ndarray, n_rows: int | None = None):
     ``n_rows`` restricts output to a leading row window (the view
     mechanism); default all rows.
     """
+    if A.is_square:
+        record_op_pass()
     b = A.block_size
     nr = A.n_rows if n_rows is None else n_rows
     if b == 1:
@@ -48,6 +64,12 @@ def spmv(A: SparseMatrix, x: jnp.ndarray, n_rows: int | None = None):
 
 
 def _spmv_scalar(A, x):
+    if A.has_matrix_free:
+        # compact stencil state: coefficients regenerate on the fly,
+        # the only O(n) streams are x and y (ops/stencil.py)
+        from amgx_tpu.ops.stencil import stencil_spmv
+
+        return stencil_spmv(A, x)
     if A.has_dia:
         if A.values.dtype in (jnp.float32, jnp.bfloat16):
             from amgx_tpu.ops.pallas_dia import (

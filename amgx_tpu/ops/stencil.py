@@ -1,0 +1,291 @@
+"""MATRIX_FREE stencil operators: detection, compact state, apply.
+
+DIA SpMV (ops/spmv.py) already turned stencil matrices into shift+FMA,
+but it still streams the O(nnz) ``dia_vals`` arrays every apply — and
+BENCH r01-r05 put that path at 3-4% of the HBM roofline: the solve is
+utterly bandwidth-bound, so the biggest remaining lever for the
+structured family is to stop reading the matrix at all.  This module
+detects when a DIA matrix is a CONSTANT or AXIS-SEPARABLE stencil on an
+inferred (nx, ny, nz) grid (``infer_grid``, amg/aggregation.py) and
+replaces the (nd, n) value planes with O(nd) / O(nd * axis) coefficient
+state; the apply regenerates every coefficient on the fly.
+
+Bitwise contract (the parity gates depend on it):
+
+  * Detection VERIFIES the candidate coefficients against the actual
+    DIA values — tolerance zero means byte-identical reconstruction
+    (``tobytes`` compare), so Dirichlet-masked boundary rows are
+    represented exactly or the format is rejected.  A jittered stencil
+    (any coefficient off by one ulp) falls back to DIA.
+  * The apply accumulates per-diagonal in ``dia_offsets`` order from a
+    +0.0 accumulator, multiplying the SAME coefficient bits the DIA
+    plane stored, with zero-padding supplying the masked neighbors.
+    IEEE addition can never produce -0.0 from a +0.0 accumulator, so
+    the masked terms (+-0.0 either way) leave the sum byte-identical
+    to ``_spmv_dia`` — parity is structural, not probabilistic.
+
+The compact state lives on :class:`~amgx_tpu.core.matrix.SparseMatrix`
+as ``mf_coefs`` (traced, (nd,) or (nd, L)), ``mf_src`` (traced
+first-occurrence gather map into the CSR values — ``replace_values``
+re-derives coefficients per value swap, which is how vmapped serve
+groups and ``resetup_entry`` ride the format), and ``mf_meta`` (static
+:class:`StencilMeta`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+
+class StencilMeta(NamedTuple):
+    """Static (hashable) description of a detected stencil.
+
+    kind:    "const" (one coefficient per diagonal) or "axis"
+             (coefficients vary along ONE grid axis only)
+    grid:    (nx, ny, nz) with nx*ny*nz == n_rows; flat index
+             i = ix + nx*iy + nx*ny*iz (x fastest)
+    steps:   per-diagonal (dx, dy, dz) grid steps
+    offsets: per-diagonal flat offsets (== the DIA offsets the format
+             replaced; kept for bench models and debugging)
+    axis:    varying axis for kind == "axis" (0=x, 1=y, 2=z), else None
+    """
+
+    kind: str
+    grid: Tuple[int, int, int]
+    steps: Tuple[Tuple[int, int, int], ...]
+    offsets: Tuple[int, ...]
+    axis: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# host-side detection
+
+
+def _values_match(recon, ref, tol: float) -> bool:
+    """tol == 0.0 is the BITWISE mode (byte compare — rejects even a
+    signed-zero or ulp difference, which is what the parity gates
+    need); tol > 0 accepts |recon - ref| <= tol elementwise (NaN
+    rejects either way)."""
+    if tol == 0.0:
+        return recon.tobytes() == ref.tobytes()
+    d = np.abs(recon.astype(np.float64) - ref.astype(np.float64))
+    return bool(np.all(d <= tol))
+
+
+def decompose_offsets(offsets, grid):
+    """Per-diagonal (dx, dy, dz) grid steps for flat ``offsets`` on
+    ``grid``, or None when any offset does not decompose into in-range
+    steps.  A wrong-but-decomposing guess is caught downstream by the
+    value verification, never by the solve."""
+    nx, ny, nz = grid
+    steps = []
+    for off in offsets:
+        off = int(off)
+        dz = int(np.rint(off / max(nx * ny, 1)))
+        rem = off - dz * nx * ny
+        dy = int(np.rint(rem / max(nx, 1)))
+        dx = rem - dy * nx
+        if (
+            off != dx + nx * dy + nx * ny * dz
+            or abs(dx) >= nx
+            or abs(dy) >= ny
+            or abs(dz) >= nz
+        ):
+            return None
+        steps.append((dx, dy, dz))
+    return tuple(steps)
+
+
+def _step_masks(steps, grid, n):
+    """(nd, n) bool: entry (k, i) true when row i's neighbor at
+    steps[k] lies inside the grid (the Dirichlet boundary mask the DIA
+    planes encode as stored zeros / missing entries)."""
+    nx, ny, nz = grid
+    i = np.arange(n)
+    ix, iy, iz = i % nx, (i // nx) % ny, i // (nx * ny)
+    masks = np.empty((len(steps), n), dtype=bool)
+    for k, (dx, dy, dz) in enumerate(steps):
+        masks[k] = (
+            (ix + dx >= 0) & (ix + dx < nx)
+            & (iy + dy >= 0) & (iy + dy < ny)
+            & (iz + dz >= 0) & (iz + dz < nz)
+        )
+    return masks, (ix, iy, iz)
+
+
+def detect_stencil_np(dia_offsets, dia_vals, dia_src, n, tol: float = 0.0):
+    """Try to compress host DIA arrays into compact stencil state.
+
+    Returns ``(StencilMeta, mf_coefs, mf_src)`` host arrays, or None
+    when the matrix is not a verified constant / axis-separable
+    stencil.  ``mf_src`` maps each coefficient slot to the nnz index
+    of a representative CSR entry (-1 = coefficient is zero /
+    unwitnessed), so traced value swaps re-derive coefficients by
+    gather exactly like the DIA/ELL ``*_src`` maps.
+    """
+    from amgx_tpu.amg.aggregation import infer_grid
+
+    grid = infer_grid(dia_offsets, n)
+    if grid is None:
+        return None
+    steps = decompose_offsets(dia_offsets, grid)
+    if steps is None:
+        return None
+    dia_vals = np.asarray(dia_vals)
+    dia_src = np.asarray(dia_src)
+    nd = len(steps)
+    zero = dia_vals.dtype.type(0)
+    masks, coords = _step_masks(steps, grid, n)
+
+    # ---- constant stencil: one coefficient per diagonal -------------
+    coefs = np.zeros(nd, dtype=dia_vals.dtype)
+    src = np.full(nd, -1, dtype=np.int32)
+    ok = True
+    for k in range(nd):
+        witness = masks[k] & (dia_src[k] >= 0)
+        if witness.any():
+            i0 = int(np.argmax(witness))
+            coefs[k] = dia_vals[k][i0]
+            src[k] = dia_src[k][i0]
+        if not _values_match(
+            np.where(masks[k], coefs[k], zero), dia_vals[k], tol
+        ):
+            ok = False
+            break
+    if ok:
+        meta = StencilMeta(
+            kind="const",
+            grid=grid,
+            steps=steps,
+            offsets=tuple(int(o) for o in dia_offsets),
+        )
+        return meta, coefs, src
+
+    # ---- axis-separable: coefficients vary along ONE axis -----------
+    for axis in (0, 1, 2):
+        L = grid[axis]
+        if L <= 1:
+            continue
+        coord = coords[axis]
+        coefs = np.zeros((nd, L), dtype=dia_vals.dtype)
+        src = np.full((nd, L), -1, dtype=np.int32)
+        ok = True
+        for k in range(nd):
+            witness = masks[k] & (dia_src[k] >= 0)
+            widx = np.nonzero(witness)[0]
+            first = np.full(L, n, dtype=np.int64)
+            np.minimum.at(first, coord[widx], widx)
+            have = first < n
+            coefs[k][have] = dia_vals[k][first[have]]
+            src[k][have] = dia_src[k][first[have]]
+            if not _values_match(
+                np.where(masks[k], coefs[k][coord], zero),
+                dia_vals[k],
+                tol,
+            ):
+                ok = False
+                break
+        if ok:
+            meta = StencilMeta(
+                kind="axis",
+                grid=grid,
+                steps=steps,
+                offsets=tuple(int(o) for o in dia_offsets),
+                axis=axis,
+            )
+            return meta, coefs, src
+    return None
+
+
+# ---------------------------------------------------------------------------
+# apply
+
+
+def _pad_widths(steps):
+    """Per-axis (lo, hi) halo widths covering every stencil step."""
+    out = []
+    for a in range(3):
+        out.append((
+            max([0] + [-s[a] for s in steps]),
+            max([0] + [s[a] for s in steps]),
+        ))
+    return out
+
+
+def stencil_spmv_xla(meta: StencilMeta, coefs, x):
+    """y = A @ x from compact stencil state: 3D shift+FMA over a
+    zero-padded reshape, coefficients regenerated on the fly — the
+    only O(n) streams are x and y.  Accumulation order matches
+    ``_spmv_dia`` (per-diagonal, offsets order, +0.0 start) so the
+    result is byte-identical to the DIA plane product."""
+    nx, ny, nz = meta.grid
+    (pxl, pxh), (pyl, pyh), (pzl, pzh) = _pad_widths(meta.steps)
+    x3 = x.reshape(nz, ny, nx)
+    xp = jnp.pad(x3, ((pzl, pzh), (pyl, pyh), (pxl, pxh)))
+    y = jnp.zeros_like(x3)
+    for k, (dx, dy, dz) in enumerate(meta.steps):
+        s = lax.slice(
+            xp,
+            (pzl + dz, pyl + dy, pxl + dx),
+            (pzl + dz + nz, pyl + dy + ny, pxl + dx + nx),
+        )
+        c = coefs[k]
+        if meta.kind == "axis":
+            # broadcast the per-coordinate coefficient along the ROW's
+            # position on the varying axis (x is the last dim of x3)
+            shape = [1, 1, 1]
+            shape[2 - meta.axis] = c.shape[-1]
+            c = c.reshape(shape)
+        y = y + c * s
+    return y.reshape(x.shape)
+
+
+def stencil_spmv(A, x):
+    """Matrix-free SpMV dispatch: Pallas stencil kernel when eligible
+    and supported (TPU / interpret mode), XLA shift+FMA otherwise."""
+    if A.mf_meta.kind == "const" and A.values.dtype in (
+        jnp.float32,
+        jnp.bfloat16,
+    ):
+        from amgx_tpu.ops.pallas_stencil import (
+            pallas_stencil_spmv,
+            pallas_stencil_supported,
+            stencil_kernel_eligible,
+        )
+
+        if stencil_kernel_eligible(A) and pallas_stencil_supported():
+            return pallas_stencil_spmv(A, x)
+    return stencil_spmv_xla(A.mf_meta, A.mf_coefs, x)
+
+
+# ---------------------------------------------------------------------------
+# fused cycle leg
+
+
+def fused_cycle_leg(A, R, smooth_fn, smp, b, x, pre):
+    """Fused smoother -> residual -> restrict leg for matrix-free
+    levels: the whole leg is one fused-region pass over fine-grid data
+    (no O(nnz) coefficient stream anywhere inside), instead of the
+    three separate passes the unfused path makes (smooth, residual,
+    restrict).  Returns ``(x, r, bc)`` — identical arithmetic to the
+    reference sequence, so fused-vs-unfused parity is bitwise by
+    construction.
+
+    Pass accounting: the leg suppresses the operator-pass records its
+    internal smoother/residual applies would emit (nested counter
+    context) and records exactly ONE pass on the enclosing counter —
+    ``op_pass_counter`` traces prove one fine-grid pass per fused leg.
+    """
+    from amgx_tpu.ops.spmv import op_pass_counter, record_op_pass, spmv
+
+    with op_pass_counter():
+        if smooth_fn is not None and pre > 0:
+            x = smooth_fn(smp, b, x, pre)
+        r = b - spmv(A, x)
+        bc = spmv(R, r)
+    record_op_pass()
+    return x, r, bc

@@ -206,6 +206,54 @@ class HierarchyCache:
                 out[key] = out.get(key, 0) + int(leaf.nbytes)
         return out
 
+    def bytes_by_format(self) -> dict:
+        """Resident bytes of every cached hierarchy entry, summed per
+        accel format (``{"MATRIX_FREE": n, "DIA": m, ...}``) — the
+        observability surface of the matrix-free compression: a level
+        whose DIA value planes collapsed to O(1) stencil coefficients
+        shows up as mass moving from the DIA to the MATRIX_FREE family
+        (``amgx_cache_hierarchy_bytes{format=...}``).  Arrays not owned
+        by a SparseMatrix (vectors, smoother state) count as "other";
+        aliased leaves count once, on the first format seen."""
+        import jax
+
+        from amgx_tpu.core.matrix import SparseMatrix
+
+        with self._lock:
+            entries = list(self._entries.values())
+        out: dict = {}
+        seen: set = set()
+
+        def _fmt(m: SparseMatrix) -> str:
+            if m.has_matrix_free:
+                return "MATRIX_FREE"
+            if m.has_dia:
+                return "DIA"
+            if m.has_dense:
+                return "DENSE"
+            if m.has_ell:
+                return "ELL"
+            return "CSR"
+
+        def _tally(leaf, fmt: str):
+            if hasattr(leaf, "nbytes") and id(leaf) not in seen:
+                seen.add(id(leaf))
+                out[fmt] = out.get(fmt, 0) + int(leaf.nbytes)
+
+        for e in entries:
+            roots = [getattr(e.solver, "_params", None), e.template]
+            mats = jax.tree_util.tree_leaves(
+                roots, is_leaf=lambda x: isinstance(x, SparseMatrix)
+            )
+            for node in mats:
+                if isinstance(node, SparseMatrix):
+                    fmt = _fmt(node)
+                    for leaf in jax.tree_util.tree_leaves(node):
+                        _tally(leaf, fmt)
+                else:
+                    _tally(node, "other")
+        return out
+
     def clear(self):
         with self._lock:
             self._entries.clear()

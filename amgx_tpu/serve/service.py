@@ -947,6 +947,10 @@ class BatchedSolveService:
             snap["hierarchy_bytes"] = self.cache.bytes_by_dtype()
         except Exception:  # noqa: BLE001 — telemetry never fails
             pass
+        try:
+            snap["hierarchy_format_bytes"] = self.cache.bytes_by_format()
+        except Exception:  # noqa: BLE001 — telemetry never fails
+            pass
         return snap
 
     def _flight_record(self, **fields):

@@ -569,6 +569,14 @@ class Solver:
         self._jit_cache[key] = val
         return val
 
+    def cycle_passes_per_iteration(self):
+        """Fine-grid operator passes one iteration of this solver
+        executes (trace-time count under
+        :data:`amgx_tpu.ops.spmv.op_pass_counter`).  ``None`` for
+        solvers without a cycle notion — the AMG hierarchy overrides
+        this; the number feeds ``amgx_solver_cycle_passes_total``."""
+        return None
+
     def _count_iteration_reductions(self):
         """Trace one monitored-loop body (iterate + residual-norm
         monitor) and count the reduction sites."""
@@ -797,6 +805,7 @@ class Solver:
             # communication win observable: reductions/iterations
             # ~ 3 for classic monitored PCG, ~ 2/s for SSTEP_PCG
             red = self.reductions_per_iteration()
+            cp = self.cycle_passes_per_iteration()
             reg.record_solver(
                 self.registry_name,
                 setup_s=self.setup_time,
@@ -804,6 +813,7 @@ class Solver:
                 solve_s=self.solve_time,
                 iterations=int(res.iters) * int(self.iterations_scale),
                 reductions=(red or 0) * int(res.iters),
+                cycle_passes=(cp or 0) * int(res.iters),
                 setup_phases={
                     k: v for k, v in (setup_prof or {}).items()
                     if isinstance(v, float)

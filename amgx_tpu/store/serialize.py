@@ -41,6 +41,7 @@ _SMAT_ARRAY_FIELDS = (
     "row_offsets", "col_indices", "values", "row_ids", "diag",
     "ell_cols", "ell_vals", "ell_wcols", "ell_wvals", "ell_wbase",
     "dia_vals", "dense", "diag_src", "dia_src", "ell_src",
+    "mf_coefs", "mf_src",
 )
 
 
@@ -174,6 +175,7 @@ def _smat_spec(A, rec):
         ("diag", "diag_src"),
         ("ell_vals", "ell_src"),
         ("dia_vals", "dia_src"),
+        ("mf_coefs", "mf_src"),
     ):
         if getattr(A, src) is not None:
             rebuild[name] = {"t": "gather_rebuild", "src": src}
@@ -204,6 +206,28 @@ def _smat_spec(A, rec):
             ),
             "ell_wwidth": (
                 None if A.ell_wwidth is None else int(A.ell_wwidth)
+            ),
+            # optional key (schema v1 stays valid, like "dt"): the
+            # MATRIX_FREE stencil descriptor, JSON-flattened; the
+            # coefficient state itself rehydrates from (values, mf_src)
+            **(
+                {
+                    "mf_meta": {
+                        "kind": A.mf_meta.kind,
+                        "grid": [int(v) for v in A.mf_meta.grid],
+                        "steps": [
+                            [int(d) for d in s] for s in A.mf_meta.steps
+                        ],
+                        "offsets": [int(o) for o in A.mf_meta.offsets],
+                        "axis": (
+                            None
+                            if A.mf_meta.axis is None
+                            else int(A.mf_meta.axis)
+                        ),
+                    }
+                }
+                if A.mf_meta is not None
+                else {}
             ),
             "views": views,
         },
@@ -436,7 +460,32 @@ def unflatten(spec, arrays):
                     ViewType[name]: (int(off), int(size))
                     for name, off, size in st["views"]
                 }
+            mf_meta = None
+            if st.get("mf_meta") is not None:
+                from amgx_tpu.ops.stencil import StencilMeta
+
+                try:
+                    mm = st["mf_meta"]
+                    mf_meta = StencilMeta(
+                        kind=str(mm["kind"]),
+                        grid=tuple(int(v) for v in mm["grid"]),
+                        steps=tuple(
+                            tuple(int(d) for d in s)
+                            for s in mm["steps"]
+                        ),
+                        offsets=tuple(int(o) for o in mm["offsets"]),
+                        axis=(
+                            None
+                            if mm.get("axis") is None
+                            else int(mm["axis"])
+                        ),
+                    )
+                except (TypeError, ValueError, KeyError) as e:
+                    raise StoreError(
+                        f"malformed mf_meta in payload spec: {e}"
+                    ) from e
             A = SparseMatrix(
+                mf_meta=mf_meta,
                 n_rows=int(st["n_rows"]),
                 n_cols=int(st["n_cols"]),
                 block_size=int(st["block_size"]),

@@ -135,7 +135,7 @@ _CACHE_RENAME = {
 _SERVE_SKIP = {
     "buckets", "latency", "lanes", "profile",
     "ticket_p50_s", "ticket_p99_s", "tenant_device_s",
-    "hierarchy_bytes",
+    "hierarchy_bytes", "hierarchy_format_bytes",
 }
 
 
@@ -203,6 +203,13 @@ def serve_families(fams: FamilyTable, comp: str, snap: dict) -> None:
                  "hierarchy_dtype=FLOAT32 hierarchy moves value "
                  "bytes from the float64 to the float32 family)",
                  {**labels, "dtype": dt}, nb)
+    for fmt, nb in (snap.get("hierarchy_format_bytes") or {}).items():
+        fams.add("amgx_cache_hierarchy_bytes", "gauge",
+                 "resident hierarchy-cache bytes by accel format "
+                 "(MATRIX_FREE levels hold O(1) coefficient state "
+                 "where DIA holds O(nnz) value planes — this split "
+                 "shows the compression landing)",
+                 {**labels, "format": fmt}, nb)
     for stage, summ in (snap.get("latency") or {}).items():
         _quantile_samples(
             fams, "amgx_serve_ticket_latency_seconds",
@@ -335,6 +342,12 @@ def solver_families(fams: FamilyTable, comp: str, snap: dict) -> None:
                  "cross-chip psum sync points; ~3/iter for monitored "
                  "PCG, ~2/s per iter for SSTEP_PCG)", labels,
                  st.get("reductions", 0))
+        fams.add("amgx_solver_cycle_passes_total", "counter",
+                 "fine-grid operator passes across timed solves "
+                 "(trace-time op_pass counter; fused matrix-free "
+                 "cycle legs drop this from 3(L-1)+1 to 2(L-1)+1 "
+                 "per V-cycle)", labels,
+                 st.get("cycle_passes", 0))
         hist = st.get("iter_hist") or {}
         if hist:
             # histogram-shaped per-config iteration distribution:

@@ -124,23 +124,30 @@ class TelemetryRegistry:
     def record_solver(self, solver: str, setup_s: float = 0.0,
                       compile_s: float = 0.0, solve_s: float = 0.0,
                       iterations: int = 0, reductions: int = 0,
+                      cycle_passes: int = 0,
                       setup_phases: Optional[dict] = None) -> None:
         """Fold one timed solve's ``obtain_timings`` lines into the
         per-solver-class aggregate (the registry's ``solvers``
         component).  ``reductions`` counts the solve's global
         dot/norm reductions (``amgx_solver_reductions_total`` — the
         communication-free-inner-loop observability of PR 8);
-        ``iterations`` additionally feeds a per-solver iteration
-        histogram (``promtext.ITERATION_BUCKETS``)."""
+        ``cycle_passes`` counts fine-grid operator passes
+        (``amgx_solver_cycle_passes_total`` — the fused matrix-free
+        cycle-leg observability, ops/stencil.py; 0 for solvers
+        without a cycle notion); ``iterations`` additionally feeds a
+        per-solver iteration histogram
+        (``promtext.ITERATION_BUCKETS``)."""
         with self._solver_lock:
             st = self._solver_stats.setdefault(solver, {
                 "solves": 0, "iterations": 0, "reductions": 0,
+                "cycle_passes": 0,
                 "setup_s": 0.0, "compile_s": 0.0, "solve_s": 0.0,
                 "setup_phases": {}, "iter_hist": {},
             })
             st["solves"] += 1
             st["iterations"] += int(iterations)
             st["reductions"] += int(reductions)
+            st["cycle_passes"] += int(cycle_passes)
             hist = st["iter_hist"]
             for le in promtext.ITERATION_BUCKETS:
                 if iterations <= le:

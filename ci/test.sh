@@ -122,9 +122,19 @@ JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAG
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
     python ci/halo_bench.py
 
+# ---- matrix-free stencils: compression + fused-leg floors ------------
+# One JSON line; non-zero exit when the MATRIX_FREE SpMV on the 32^3
+# 7-point Poisson operator fails the 1.3x marginal per-SpMV speedup
+# floor over DIA (geomean of best 3 of 5 interleaved chained-timing
+# attempts), when the trace-time operator-pass counter does not show
+# exactly one fine-grid pass per fused V-cycle descent leg (unfused
+# 3(L-1)+1 vs fused 2(L-1)+1), or when the matrix-free / fused solves
+# are not bitwise identical to the DIA reference at equal iterations.
+JAX_PLATFORMS=cpu python ci/matrix_free_bench.py
+
 # ---- unified telemetry: exposition + tracing + overhead --------------
 # One JSON line; non-zero exit when the Prometheus exposition fails to
-# parse or exports fewer than 37 metric names across the serve /
+# parse or exports fewer than 38 metric names across the serve /
 # admission / store / cache / setup-phase / solver / session / mesh
 # placement / distributed placement sources,
 # when a sampled gateway request does not produce a connected

@@ -30,6 +30,30 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compile cache for the test tier: the suite is
+# compile-dominated (the 8-device mesh programs recompile identically
+# every run — single dist_block cases cost 40-120 s of pure XLA), so
+# repeat tier-1 runs skip the compile work.  Entries are keyed by HLO
+# hash, so a stale cache is unreachable, never wrong; the path lives
+# under gitignored ci/artifacts/.  AMGX_TPU_TEST_XLA_CACHE=0 disables
+# (e.g. to measure true cold-compile time).
+_xla_cache = os.environ.get(
+    "AMGX_TPU_TEST_XLA_CACHE",
+    os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "ci", "artifacts", "xla_test_cache",
+    ),
+)
+if _xla_cache and _xla_cache != "0":
+    try:
+        os.makedirs(_xla_cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _xla_cache)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5
+        )
+    except Exception:  # pragma: no cover — cache is best-effort
+        pass
+
 import numpy as np
 import pytest
 import scipy.sparse as sps

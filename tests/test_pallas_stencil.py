@@ -1,0 +1,123 @@
+"""Pallas MATRIX_FREE stencil SpMV kernel tests (interpret mode on
+CPU).
+
+Sibling of tests/test_pallas_dia.py: same window/lane-rotation
+geometry, but the matrix contributes ZERO bytes — coefficients ride
+in SMEM and the Dirichlet boundary masks regenerate from index
+arithmetic inside the kernel.  On real TPU the kernel is
+compile-probed by ops.pallas_stencil.pallas_stencil_supported before
+dispatch; parity gates run the XLA apply (the kernel is allclose, not
+bitwise, vs XLA's fused multiply-adds).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+from amgx_tpu.core.matrix import SparseMatrix
+from amgx_tpu.ops import pallas_stencil as ps
+
+MF_FORMATS = ("matrix_free", "dia", "dense", "ell")
+
+
+def _poisson_mf(nx, ny=None, nz=None, dtype=np.float32):
+    ny = nx if ny is None else ny
+    nz = nx if nz is None else nz
+    Tx = sps.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(nx, nx))
+    Ty = sps.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(ny, ny))
+    Tz = sps.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(nz, nz))
+    ix, iy, iz = sps.identity(nx), sps.identity(ny), sps.identity(nz)
+    A = (
+        sps.kron(sps.kron(iz, iy), Tx)
+        + sps.kron(sps.kron(iz, Ty), ix)
+        + sps.kron(sps.kron(Tz, iy), ix)
+    ).tocsr()
+    A.sort_indices()
+    return SparseMatrix.from_scipy(
+        A.astype(dtype), accel_formats=MF_FORMATS
+    ), A.astype(dtype)
+
+
+@pytest.mark.parametrize("n_side", [12, 24])
+def test_poisson3d_interpret(n_side):
+    A, sp = _poisson_mf(n_side)
+    assert A.has_matrix_free and A.mf_meta.kind == "const"
+    x = np.random.default_rng(3).standard_normal(A.n_rows)
+    x32 = x.astype(np.float32)
+    y = ps.pallas_stencil_spmv(A, x32, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y), sp @ x32, rtol=2e-5, atol=2e-5
+    )
+
+
+def test_multiblock_interpret():
+    """More rows than one row block: multi-step grid, windowed DMA."""
+    A, sp = _poisson_mf(64, 32, 16)  # 32768 rows
+    assert A.has_matrix_free
+    x = np.random.default_rng(5).standard_normal(A.n_rows)
+    x32 = x.astype(np.float32)
+    y = ps.pallas_stencil_spmv(A, x32, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y), sp @ x32, rtol=2e-5, atol=2e-5
+    )
+
+
+def test_unaligned_grid_interpret():
+    """nx not a multiple of 128 exercises the lane-seam select AND the
+    in-kernel boundary masks (the flat window wraps across grid rows
+    where the XLA path's 3D padding does not)."""
+    A, sp = _poisson_mf(17, 23, 31)  # 12121 rows, every offset odd
+    assert A.has_matrix_free
+    x = np.random.default_rng(7).standard_normal(A.n_rows)
+    x32 = x.astype(np.float32)
+    y = ps.pallas_stencil_spmv(A, x32, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y), sp @ x32, rtol=2e-5, atol=2e-5
+    )
+
+
+def test_matches_xla_apply_interpret():
+    from amgx_tpu.ops.stencil import stencil_spmv_xla
+
+    A, _ = _poisson_mf(24)
+    x = np.random.default_rng(9).standard_normal(A.n_rows)
+    x32 = np.asarray(x, dtype=np.float32)
+    y_k = np.asarray(ps.pallas_stencil_spmv(A, x32, interpret=True))
+    y_x = np.asarray(stencil_spmv_xla(A.mf_meta, A.mf_coefs, x32))
+    np.testing.assert_allclose(y_k, y_x, rtol=2e-5, atol=2e-5)
+
+
+def test_eligibility_gate():
+    small, _ = _poisson_mf(8)  # 512 rows < _MIN_ROWS
+    assert not ps.stencil_kernel_eligible(small)
+    big, _ = _poisson_mf(24)  # 13824 rows
+    assert ps.stencil_kernel_eligible(big)
+    # axis-separable stencils stay on the XLA apply
+    n = 16
+    sp = (
+        sps.kron(
+            sps.kron(sps.identity(n), sps.identity(n)),
+            sps.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n)),
+        )
+        + sps.kron(
+            sps.kron(sps.identity(n),
+                     sps.diags([-1.0, 2.0, -1.0], [-1, 0, 1],
+                               shape=(n, n))),
+            sps.identity(n),
+        )
+        + sps.kron(
+            sps.kron(sps.diags([-1.0, 2.0, -1.0], [-1, 0, 1],
+                               shape=(n, n)), sps.identity(n)),
+            sps.identity(n),
+        )
+    ).tocoo()
+    sp.data = sp.data * (1.0 + sp.row // (n * n))
+    ax = SparseMatrix.from_scipy(
+        sp.tocsr().astype(np.float32), accel_formats=MF_FORMATS
+    )
+    assert ax.mf_meta is not None and ax.mf_meta.kind == "axis"
+    assert not ps.stencil_kernel_eligible(ax)
+
+
+def test_cpu_backend_not_supported():
+    assert not ps.pallas_stencil_supported()

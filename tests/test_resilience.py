@@ -218,22 +218,24 @@ def test_real_xla_runtime_error_classified_as_device_loss(
     # real hardware surfaces a lost chip as a jaxlib XlaRuntimeError,
     # not our typed class: the fetch boundary must classify it and
     # run the same failover, without charging the fingerprint breaker
-    import amgx_tpu.serve.service as service_mod
-
     class XlaRuntimeError(RuntimeError):
         pass
 
     svc = BatchedSolveService(max_batch=2)
-    real_block = service_mod._block_ready
+    # patch the INSTANCE sync, not module _block_ready: an abandoned
+    # fetch-pool worker from the preceding watchdog test (hung 1.5s,
+    # watchdog gave up at 0.2s) wakes mid-test and would consume a
+    # module-level one-shot hook
+    real_watched = svc._watched_block
     fired = []
 
-    def failing_block(x):
+    def failing_watched(x, label=None):
         if not fired:
             fired.append(1)
             raise XlaRuntimeError("device halted")
-        return real_block(x)
+        return real_watched(x, label)
 
-    monkeypatch.setattr(service_mod, "_block_ready", failing_block)
+    monkeypatch.setattr(svc, "_watched_block", failing_watched)
     ts = _submit_batch(svc, sp8)
     svc.flush()
     res = [t.result() for t in ts]
